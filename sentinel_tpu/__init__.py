@@ -1,0 +1,118 @@
+"""sentinel_tpu — a TPU-native flow-control / traffic-shaping framework.
+
+A from-scratch re-design of the capabilities of alibaba/Sentinel v1.8.1
+(reference: /root/reference) for TPU hardware.  Where the reference guards
+each call site with a per-resource lock-free slot chain (CtSph.java:43,
+StatisticSlot.java:51), this framework micro-batches request events into
+tensors and runs ONE fused, jit-compiled decision kernel per tick:
+
+    {resource_id, origin, param_hash, ...}[B]  --->  verdict[B], wait_ms[B]
+
+All sliding-window statistics (the reference's LeapArray,
+slots/statistic/base/LeapArray.java:41) live as sharded ring-buffer tensors
+on device; all rule checks (FlowSlot, DegradeSlot, ParamFlowSlot,
+SystemSlot, AuthoritySlot) are vectorized over the batch.  Time is always
+an explicit kernel input (``now_ms``) — nothing under jit reads a clock —
+which makes the whole engine a pure function (the tensorized analog of the
+reference's AbstractTimeBasedTest virtual-time strategy).
+
+Public API mirrors the reference's facade (SphU.java:71 / SphO.java /
+Tracer.java / ContextUtil.java:45):
+
+    import sentinel_tpu as st
+
+    st.init(app_name="my-app")
+    st.load_flow_rules([st.FlowRule(resource="HelloWorld", count=20)])
+
+    try:
+        with st.entry("HelloWorld"):
+            do_work()
+    except st.BlockException:
+        handle_rejection()
+"""
+
+from sentinel_tpu.core.errors import (
+    AuthorityException,
+    BlockException,
+    DegradeException,
+    FlowException,
+    ParamFlowException,
+    PriorityWaitException,
+    SystemBlockException,
+)
+from sentinel_tpu.core.rules import (
+    AuthorityRule,
+    DegradeRule,
+    FlowRule,
+    ParamFlowRule,
+    SystemRule,
+    # enums
+    AUTHORITY_BLACK,
+    AUTHORITY_WHITE,
+    CB_STRATEGY_ERROR_COUNT,
+    CB_STRATEGY_ERROR_RATIO,
+    CB_STRATEGY_SLOW_REQUEST_RATIO,
+    CONTROL_DEFAULT,
+    CONTROL_RATE_LIMITER,
+    CONTROL_WARM_UP,
+    CONTROL_WARM_UP_RATE_LIMITER,
+    GRADE_QPS,
+    GRADE_THREAD,
+    STRATEGY_CHAIN,
+    STRATEGY_DIRECT,
+    STRATEGY_RELATE,
+)
+from sentinel_tpu.core.api import (
+    clear_rules,
+    context,
+    entry,
+    get_client,
+    init,
+    load_authority_rules,
+    load_degrade_rules,
+    load_flow_rules,
+    load_param_flow_rules,
+    load_system_rules,
+    reset,
+    trace,
+    try_entry,
+)
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    if name == "SentinelClient":
+        from sentinel_tpu.runtime.client import SentinelClient
+
+        return SentinelClient
+    raise AttributeError(name)
+
+__all__ = [
+    "AuthorityException",
+    "AuthorityRule",
+    "BlockException",
+    "DegradeException",
+    "DegradeRule",
+    "FlowException",
+    "FlowRule",
+    "ParamFlowException",
+    "ParamFlowRule",
+    "PriorityWaitException",
+    "SentinelClient",
+    "SystemBlockException",
+    "SystemRule",
+    "clear_rules",
+    "context",
+    "entry",
+    "get_client",
+    "init",
+    "load_authority_rules",
+    "load_degrade_rules",
+    "load_flow_rules",
+    "load_param_flow_rules",
+    "load_system_rules",
+    "reset",
+    "trace",
+    "try_entry",
+]

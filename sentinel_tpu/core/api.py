@@ -1,0 +1,115 @@
+"""Public facade — the analog of SphU/SphO/Tracer/ContextUtil.
+
+(Filled in alongside the host runtime; see sentinel_tpu/runtime/.)
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterable, Optional
+
+from sentinel_tpu.core import rules as R
+
+_client = None
+_client_lock = threading.Lock()
+
+
+def init(**kwargs):
+    """Create (or return) the process-wide SentinelClient.
+
+    Analog of Env.java:31-38 — the singleton CtSph + one-time init.
+    """
+    global _client
+    with _client_lock:
+        if _client is None:
+            from sentinel_tpu.runtime.client import SentinelClient
+
+            _client = SentinelClient(**kwargs)
+            _client.start()
+        return _client
+
+
+def get_client():
+    return init()
+
+
+def reset():
+    """Tear down the process-wide client (tests)."""
+    global _client
+    with _client_lock:
+        if _client is not None:
+            _client.stop()
+            _client = None
+
+
+def entry(resource: str, count: int = 1, prioritized: bool = False, args=None):
+    """Guard a code block; raises BlockException when rejected.
+
+    Analog of SphU.entry (SphU.java:84); usable as a context manager:
+
+        with st.entry("res") as e:
+            ...
+    """
+    return get_client().entry(resource, count=count, prioritized=prioritized, args=args)
+
+
+def try_entry(resource: str, count: int = 1, args=None):
+    """Boolean variant (SphO.java). Returns an Entry or None."""
+    return get_client().try_entry(resource, count=count, args=args)
+
+
+def trace(exc: BaseException, count: int = 1):
+    """Record a business exception on the current entry (Tracer.java)."""
+    return get_client().trace(exc, count)
+
+
+@contextmanager
+def context(name: str, origin: str = ""):
+    """Set the invocation context (ContextUtil.enter/exit)."""
+    client = get_client()
+    token = client.enter_context(name, origin)
+    try:
+        yield
+    finally:
+        client.exit_context(token)
+
+
+def load_flow_rules(rules: Iterable[R.FlowRule]):
+    get_client().flow_rules.load(list(rules))
+
+
+def load_degrade_rules(rules: Iterable[R.DegradeRule]):
+    get_client().degrade_rules.load(list(rules))
+
+
+def load_system_rules(rules: Iterable[R.SystemRule]):
+    get_client().system_rules.load(list(rules))
+
+
+def load_authority_rules(rules: Iterable[R.AuthorityRule]):
+    get_client().authority_rules.load(list(rules))
+
+
+def load_param_flow_rules(rules: Iterable[R.ParamFlowRule]):
+    get_client().param_flow_rules.load(list(rules))
+
+
+def clear_rules():
+    c = get_client()
+    for mgr in (
+        c.flow_rules,
+        c.degrade_rules,
+        c.system_rules,
+        c.authority_rules,
+        c.param_flow_rules,
+    ):
+        mgr.load([])
+
+
+def __getattr__(name):
+    if name == "SentinelClient":
+        from sentinel_tpu.runtime.client import SentinelClient
+
+        return SentinelClient
+    raise AttributeError(name)

@@ -1,0 +1,176 @@
+"""Static configuration layering.
+
+Equivalent of the reference's SentinelConfig/SentinelConfigLoader
+(sentinel-core/.../config/SentinelConfig.java:49-63,
+SentinelConfigLoader.java): values resolve, highest priority first, from
+
+  1. programmatic overrides (``set_config``)
+  2. environment variables  (``CSP_SENTINEL_*`` — dots become underscores)
+  3. a properties file      (``sentinel.properties`` in cwd, or the path in
+                             ``CSP_SENTINEL_CONFIG_FILE``)
+  4. built-in defaults
+
+Also holds the EngineConfig dataclass — the capacity/shape knobs of the
+device engine (the analog of Constants.MAX_SLOT_CHAIN_SIZE=6000 and the
+window-shape defaults in StatisticNode.java:96-103).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+_DEFAULTS: Dict[str, str] = {
+    "csp.sentinel.app.name": "sentinel-tpu-app",
+    "csp.sentinel.app.type": "0",
+    "csp.sentinel.metric.file.single.size": str(1024 * 1024 * 50),
+    "csp.sentinel.metric.file.total.count": "6",
+    "csp.sentinel.flow.cold.factor": "3",
+    "csp.sentinel.statistic.max.rt": "5000",  # SentinelConfig.java:63
+    "csp.sentinel.log.dir": os.path.expanduser("~/logs/csp/"),
+    "csp.sentinel.api.port": "8719",  # TransportConfig default
+    "csp.sentinel.dashboard.server": "",
+    "csp.sentinel.heartbeat.interval.ms": "10000",
+}
+
+_overrides: Dict[str, str] = {}
+_file_props: Optional[Dict[str, str]] = None
+
+
+def _load_file_props() -> Dict[str, str]:
+    global _file_props
+    if _file_props is not None:
+        return _file_props
+    path = os.environ.get("CSP_SENTINEL_CONFIG_FILE", "sentinel.properties")
+    props: Dict[str, str] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                k, _, v = line.partition("=")
+                props[k.strip()] = v.strip()
+    except OSError:
+        pass
+    _file_props = props
+    return props
+
+
+def get_config(key: str, default: Optional[str] = None) -> Optional[str]:
+    if key in _overrides:
+        return _overrides[key]
+    env_key = key.upper().replace(".", "_")
+    if env_key in os.environ:
+        return os.environ[env_key]
+    props = _load_file_props()
+    if key in props:
+        return props[key]
+    if key in _DEFAULTS:
+        return _DEFAULTS[key]
+    return default
+
+
+def get_int(key: str, default: int = 0) -> int:
+    v = get_config(key)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def set_config(key: str, value: Any) -> None:
+    _overrides[key] = str(value)
+
+
+def reset_overrides() -> None:
+    _overrides.clear()
+
+
+def app_name() -> str:
+    return get_config("csp.sentinel.app.name") or "sentinel-tpu-app"
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Capacity & window-shape configuration of the device engine.
+
+    Defaults mirror the reference where one exists:
+    - second window 2 x 500 ms, minute window 60 x 1 s
+      (StatisticNode.java:96-103)
+    - max_resources generalizes MAX_SLOT_CHAIN_SIZE (Constants.java:37)
+      from 6,000 to 2^17; beyond capacity new resources degrade to
+      pass-through, same as lookProcessChain returning null
+      (CtSph.java:200-205).
+    """
+
+    # id spaces
+    max_resources: int = 1 << 17  # rows [0, max_resources) = per-resource nodes
+    max_nodes: int = 1 << 18  # total stat rows incl. origin/context nodes
+    # rule capacity (structure-of-arrays tensors)
+    max_flow_rules: int = 4096
+    max_degrade_rules: int = 1024
+    max_param_rules: int = 32
+    flow_rules_per_resource: int = 4
+    degrade_rules_per_resource: int = 4
+    param_rules_per_resource: int = 2
+    authority_origins_per_resource: int = 8
+    # batch shape
+    batch_size: int = 2048
+    complete_batch_size: int = 2048
+    # windows
+    second_sample_count: int = 2
+    second_window_ms: int = 500
+    minute_sample_count: int = 60
+    minute_window_ms: int = 1000
+    enable_minute_window: bool = True
+    # circuit-breaker window buckets (per-rule interval / cb_sample_count)
+    cb_sample_count: int = 2
+    # param-flow count-min sketch
+    cms_depth: int = 4
+    cms_width: int = 4096
+    cms_sample_count: int = 2  # time buckets over each rule's duration
+    # top-k tracking for hot params
+    topk_k: int = 32
+    # statistic max RT clamp (SentinelConfig.java:63)
+    statistic_max_rt: int = 5000
+
+    # dtype policy: counters int32, rt sums float32
+    @property
+    def entry_node_row(self) -> int:
+        """Reserved stat row for the global inbound ENTRY_NODE
+        (Constants.ENTRY_NODE in the reference)."""
+        return 0
+
+    @property
+    def trash_row(self) -> int:
+        """Scatter target for padded/invalid items (always last row).
+
+        Using an explicit trash row (instead of out-of-bounds dropping)
+        keeps every gather/scatter index in range.
+        """
+        return self.max_nodes
+
+    @property
+    def node_rows(self) -> int:
+        return self.max_nodes + 1  # + trash row
+
+
+DEFAULT_ENGINE_CONFIG = EngineConfig()
+
+
+def small_engine_config(**kw) -> EngineConfig:
+    """A tiny config for tests."""
+    base = dict(
+        max_resources=64,
+        max_nodes=128,
+        max_flow_rules=64,
+        max_degrade_rules=32,
+        max_param_rules=8,
+        batch_size=64,
+        complete_batch_size=64,
+        cms_width=512,
+    )
+    base.update(kw)
+    return EngineConfig(**base)

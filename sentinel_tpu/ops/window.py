@@ -1,0 +1,207 @@
+"""Vectorized sliding-window statistics — the TPU-native LeapArray.
+
+The reference keeps one lock-free ring of buckets *per resource*
+(sentinel-core/.../slots/statistic/base/LeapArray.java:41): bucket index is
+``(timeMs / windowLengthInMs) % sampleCount`` (LeapArray.java:112-124), and a
+deprecated bucket is lazily reset when next written (LeapArray.java:149-248).
+Per-bucket counters are LongAdders over the event enum
+(MetricBucket.java:28, MetricEvent.java:21).
+
+Here ALL resources share one ring-buffer tensor:
+
+    counts : int32  [rows, nb, NE]   (PASS, BLOCK, EXCEPTION, SUCCESS, OCCUPIED)
+    rt_sum : float32[rows, nb]
+    rt_min : float32[rows, nb]
+    epochs : int32  [nb]             window-id currently held by each column
+
+and the per-resource CAS dance collapses into two vectorized rules:
+
+  * WRITE  (add_batch): all events in a micro-batch share one ``now_ms``,
+    so only column ``wid % nb`` is touched; if its epoch != wid the whole
+    column (all rows at once) is zeroed first — the batched form of
+    "reset deprecated bucket on wrap".
+  * READ: a column is valid iff ``epochs[b] > wid - nb`` — the batched form
+    of ``!isWindowDeprecated`` (LeapArray.java:241-245 clock-drift branch
+    included: columns from the future simply never exist because time is a
+    single host-stamped scalar).
+
+Everything is a pure function of (state, now_ms); nothing reads a clock.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Event enum — mirrors MetricEvent.java:21 (RT and minRt live in the float
+# planes; OCCUPIED_PASS is kept for future-occupancy parity).
+EV_PASS = 0
+EV_BLOCK = 1
+EV_EXCEPTION = 2
+EV_SUCCESS = 3
+EV_OCCUPIED = 4
+NUM_EVENTS = 5
+
+# rt_min initial value — requests never exceed statistic_max_rt (5000 ms,
+# SentinelConfig.java:63); this also matches StatisticNode minRt semantics.
+RT_MIN_INIT = 5000.0
+
+
+class WindowConfig(NamedTuple):
+    sample_count: int  # number of buckets (nb)
+    window_ms: int  # bucket length
+
+    @property
+    def interval_ms(self) -> int:
+        return self.sample_count * self.window_ms
+
+
+class WindowState(NamedTuple):
+    counts: jax.Array  # int32 [rows, nb, NUM_EVENTS]
+    rt_sum: jax.Array  # float32 [rows, nb]
+    rt_min: jax.Array  # float32 [rows, nb]
+    epochs: jax.Array  # int32 [nb]
+
+
+def init_window(rows: int, cfg: WindowConfig) -> WindowState:
+    nb = cfg.sample_count
+    return WindowState(
+        counts=jnp.zeros((rows, nb, NUM_EVENTS), dtype=jnp.int32),
+        rt_sum=jnp.zeros((rows, nb), dtype=jnp.float32),
+        rt_min=jnp.full((rows, nb), RT_MIN_INIT, dtype=jnp.float32),
+        # any epoch older than (0 - nb) is invalid from t=0
+        epochs=jnp.full((nb,), -(cfg.sample_count + 1), dtype=jnp.int32),
+    )
+
+
+def _wid(now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
+    return (now_ms // cfg.window_ms).astype(jnp.int32)
+
+
+def current_index(now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
+    return _wid(now_ms, cfg) % cfg.sample_count
+
+
+def refresh(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> WindowState:
+    """Lazily reset the current column if it holds an old window.
+
+    Batched analog of LeapArray.java:149-248 (CAS-create / reuse /
+    tryLock-reset), applied to all rows of the column at once.
+    """
+    wid = _wid(now_ms, cfg)
+    idx = wid % cfg.sample_count
+    stale = state.epochs[idx] != wid
+
+    def do_reset(s: WindowState) -> WindowState:
+        return WindowState(
+            counts=s.counts.at[:, idx, :].set(0),
+            rt_sum=s.rt_sum.at[:, idx].set(0.0),
+            rt_min=s.rt_min.at[:, idx].set(RT_MIN_INIT),
+            epochs=s.epochs.at[idx].set(wid),
+        )
+
+    return jax.lax.cond(stale, do_reset, lambda s: s, state)
+
+
+def add_batch(
+    state: WindowState,
+    now_ms: jax.Array,
+    rows: jax.Array,  # int32 [B] — row per event (trash row for padding)
+    deltas: jax.Array,  # int32 [B, NUM_EVENTS]
+    rt: Optional[jax.Array] = None,  # float32 [B] — RT contribution (0 if none)
+    cfg: WindowConfig = None,
+) -> WindowState:
+    """Scatter a micro-batch of events into the current bucket column.
+
+    Duplicate rows accumulate (scatter-add), which is the batched form of
+    the reference's LongAdder.add on the current WindowWrap.
+    """
+    state = refresh(state, now_ms, cfg)
+    idx = current_index(now_ms, cfg)
+    counts = state.counts.at[rows, idx, :].add(deltas, mode="drop")
+    if rt is not None:
+        rt_sum = state.rt_sum.at[rows, idx].add(rt, mode="drop")
+        # min only among events that actually carry an RT (rt > 0 marks them;
+        # use a large fill for non-carriers so they don't clobber the min)
+        rt_for_min = jnp.where(rt > 0, rt, jnp.float32(RT_MIN_INIT))
+        rt_min = state.rt_min.at[rows, idx].min(rt_for_min, mode="drop")
+    else:
+        rt_sum, rt_min = state.rt_sum, state.rt_min
+    return WindowState(counts=counts, rt_sum=rt_sum, rt_min=rt_min, epochs=state.epochs)
+
+
+def valid_mask(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
+    """bool [nb] — which columns fall inside [now - interval, now]."""
+    wid = _wid(now_ms, cfg)
+    return (state.epochs > wid - cfg.sample_count) & (state.epochs <= wid)
+
+
+def window_counts(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
+    """int32 [rows, NUM_EVENTS] — sum over valid buckets (ArrayMetric reads)."""
+    mask = valid_mask(state, now_ms, cfg)  # [nb]
+    return jnp.sum(state.counts * mask[None, :, None], axis=1)
+
+
+def window_event(
+    state: WindowState, now_ms: jax.Array, cfg: WindowConfig, event: int
+) -> jax.Array:
+    """int32 [rows] — windowed total of one event across all rows."""
+    mask = valid_mask(state, now_ms, cfg)
+    return jnp.sum(state.counts[:, :, event] * mask[None, :], axis=1)
+
+
+def window_rt(state: WindowState, now_ms: jax.Array, cfg: WindowConfig):
+    """(rt_total f32 [rows], rt_min f32 [rows]) over valid buckets."""
+    mask = valid_mask(state, now_ms, cfg)
+    rt_total = jnp.sum(state.rt_sum * mask[None, :], axis=1)
+    rt_min = jnp.min(
+        jnp.where(mask[None, :], state.rt_min, jnp.float32(RT_MIN_INIT)), axis=1
+    )
+    return rt_total, rt_min
+
+
+def gather_window_event(
+    state: WindowState,
+    now_ms: jax.Array,
+    rows: jax.Array,  # int32 [B]
+    cfg: WindowConfig,
+    event: int,
+) -> jax.Array:
+    """int32 [B] — windowed event total for selected rows only.
+
+    The decision path reads only the rows referenced by the batch, so this
+    is a [B, nb] gather instead of a full [rows, nb] reduction.
+    """
+    mask = valid_mask(state, now_ms, cfg)  # [nb]
+    vals = state.counts[rows, :, event]  # [B, nb] gather
+    return jnp.sum(vals * mask[None, :], axis=1)
+
+
+def gather_window_counts(
+    state: WindowState,
+    now_ms: jax.Array,
+    rows: jax.Array,
+    cfg: WindowConfig,
+) -> jax.Array:
+    """int32 [B, NUM_EVENTS] for selected rows."""
+    mask = valid_mask(state, now_ms, cfg)
+    vals = state.counts[rows, :, :]  # [B, nb, NE]
+    return jnp.sum(vals * mask[None, :, None], axis=1)
+
+
+def gather_window_rt(
+    state: WindowState,
+    now_ms: jax.Array,
+    rows: jax.Array,
+    cfg: WindowConfig,
+):
+    """(rt_total f32 [B], rt_min f32 [B]) for selected rows."""
+    mask = valid_mask(state, now_ms, cfg)
+    rt_total = jnp.sum(state.rt_sum[rows, :] * mask[None, :], axis=1)
+    rt_min = jnp.min(
+        jnp.where(mask[None, :], state.rt_min[rows, :], jnp.float32(RT_MIN_INIT)),
+        axis=1,
+    )
+    return rt_total, rt_min

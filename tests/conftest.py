@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
+so sharding tests exercise real SPMD partitioning without TPU hardware
+(the driver separately dry-run-compiles the multi-chip path).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def vt():
+    """Fresh virtual time source starting at a non-zero, non-aligned ms."""
+    from sentinel_tpu.utils.time_source import VirtualTimeSource
+
+    return VirtualTimeSource(start_ms=1_000)

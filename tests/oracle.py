@@ -1,0 +1,63 @@
+"""NumPy oracle reimplementing the reference's BucketLeapArray semantics.
+
+A deliberately naive, per-row, per-bucket Python model of
+sentinel-core/.../slots/statistic/base/LeapArray.java (bucket index
+``(t / windowLen) % n``, lazy reset on wrap) used to cross-check the
+vectorized window kernel.  Mirrors the role of BucketLeapArrayTest /
+LeapArrayTest in the reference test suite (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class OracleLeapArray:
+    NUM_EVENTS = 5
+
+    def __init__(self, rows: int, sample_count: int, window_ms: int):
+        self.nb = sample_count
+        self.wm = window_ms
+        self.rows = rows
+        self.counts = np.zeros((rows, self.nb, self.NUM_EVENTS), dtype=np.int64)
+        self.rt_sum = np.zeros((rows, self.nb), dtype=np.float64)
+        self.rt_min = np.full((rows, self.nb), 5000.0)
+        self.starts = np.full((self.nb,), -1, dtype=np.int64)  # window start ms
+
+    def _bucket(self, now_ms: int) -> int:
+        wid = now_ms // self.wm
+        idx = wid % self.nb
+        start = wid * self.wm
+        if self.starts[idx] != start:
+            # lazy reset (LeapArray.java:205-232)
+            self.counts[:, idx, :] = 0
+            self.rt_sum[:, idx] = 0.0
+            self.rt_min[:, idx] = 5000.0
+            self.starts[idx] = start
+        return idx
+
+    def add(self, now_ms: int, row: int, event: int, n: int = 1):
+        idx = self._bucket(now_ms)
+        self.counts[row, idx, event] += n
+
+    def add_rt(self, now_ms: int, row: int, rt: float):
+        idx = self._bucket(now_ms)
+        self.rt_sum[row, idx] += rt
+        self.rt_min[row, idx] = min(self.rt_min[row, idx], rt)
+
+    def _valid(self, now_ms: int) -> np.ndarray:
+        # !isWindowDeprecated: now - start < interval (LeapArray.java:241-245)
+        interval = self.nb * self.wm
+        return (self.starts >= 0) & (now_ms - self.starts < interval) & (
+            self.starts <= now_ms
+        )
+
+    def window_event(self, now_ms: int, event: int) -> np.ndarray:
+        v = self._valid(now_ms)
+        return (self.counts[:, :, event] * v[None, :]).sum(axis=1)
+
+    def window_rt(self, now_ms: int):
+        v = self._valid(now_ms)
+        total = (self.rt_sum * v[None, :]).sum(axis=1)
+        mn = np.where(v[None, :], self.rt_min, 5000.0).min(axis=1)
+        return total, mn

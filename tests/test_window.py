@@ -1,0 +1,144 @@
+"""Window kernel vs the NumPy LeapArray oracle.
+
+TPU-native counterpart of the reference's LeapArrayTest /
+BucketLeapArrayTest / ArrayMetricTest (SURVEY.md §4.2): randomized event
+streams over virtual time, exact equality of windowed aggregates.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentinel_tpu.ops import window as W
+from tests.oracle import OracleLeapArray
+
+ROWS = 8
+CFG = W.WindowConfig(sample_count=2, window_ms=500)  # second window default
+
+
+def make_delta(event, n=1):
+    d = np.zeros((W.NUM_EVENTS,), dtype=np.int32)
+    d[event] = n
+    return d
+
+
+def test_single_bucket_accumulates():
+    st = W.init_window(ROWS + 1, CFG)
+    now = 250
+    rows = jnp.array([3, 3, 5], dtype=jnp.int32)
+    deltas = jnp.array([make_delta(W.EV_PASS), make_delta(W.EV_PASS), make_delta(W.EV_BLOCK)])
+    st = W.add_batch(st, jnp.int32(now), rows, deltas, None, CFG)
+    passed = W.window_event(st, jnp.int32(now), CFG, W.EV_PASS)
+    blocked = W.window_event(st, jnp.int32(now), CFG, W.EV_BLOCK)
+    assert int(passed[3]) == 2
+    assert int(blocked[5]) == 1
+    assert int(passed[5]) == 0
+
+
+def test_window_slides_and_expires():
+    st = W.init_window(ROWS + 1, CFG)
+    one = jnp.array([0], dtype=jnp.int32)
+    d = jnp.array([make_delta(W.EV_PASS)])
+    st = W.add_batch(st, jnp.int32(100), one, d, None, CFG)
+    # still visible at 999 (interval = 1000ms)
+    assert int(W.window_event(st, jnp.int32(999), CFG, W.EV_PASS)[0]) == 1
+    # bucket [0,500) expires once now >= 1000
+    assert int(W.window_event(st, jnp.int32(1000), CFG, W.EV_PASS)[0]) == 0
+    # a write at 1100 lands in the recycled column; old data must be gone
+    st = W.add_batch(st, jnp.int32(1100), one, d, None, CFG)
+    assert int(W.window_event(st, jnp.int32(1100), CFG, W.EV_PASS)[0]) == 1
+
+
+def test_long_idle_gap_resets():
+    st = W.init_window(ROWS + 1, CFG)
+    one = jnp.array([2], dtype=jnp.int32)
+    d = jnp.array([make_delta(W.EV_PASS)])
+    st = W.add_batch(st, jnp.int32(0), one, d, None, CFG)
+    st = W.add_batch(st, jnp.int32(10), one, d, None, CFG)
+    # jump far into the future — everything stale
+    assert int(W.window_event(st, jnp.int32(100_000), CFG, W.EV_PASS)[2]) == 0
+    st = W.add_batch(st, jnp.int32(100_000), one, d, None, CFG)
+    assert int(W.window_event(st, jnp.int32(100_000), CFG, W.EV_PASS)[2]) == 1
+
+
+@pytest.mark.parametrize("sample_count,window_ms", [(2, 500), (4, 250), (10, 100)])
+def test_randomized_vs_oracle(sample_count, window_ms):
+    import functools
+
+    rng = np.random.default_rng(42 + sample_count)
+    cfg = W.WindowConfig(sample_count, window_ms)
+    B = 16  # fixed batch shape — one compile, many steps
+    trash = ROWS  # last row absorbs padding
+    st = W.init_window(ROWS + 1, cfg)
+    oracle = OracleLeapArray(ROWS + 1, sample_count, window_ms)
+
+    add = jax.jit(functools.partial(W.add_batch, cfg=cfg))
+    reads = jax.jit(
+        lambda s, now: (
+            W.window_event(s, now, cfg, W.EV_PASS),
+            W.window_event(s, now, cfg, W.EV_BLOCK),
+            W.window_event(s, now, cfg, W.EV_SUCCESS),
+            *W.window_rt(s, now, cfg),
+        )
+    )
+
+    now = 0
+    for step in range(60):
+        now += int(rng.integers(1, window_ms))
+        b = int(rng.integers(1, B))
+        rows = np.full((B,), trash, dtype=np.int32)
+        rows[:b] = rng.integers(0, ROWS, size=b)
+        events = rng.integers(0, W.NUM_EVENTS, size=B)
+        rts = rng.uniform(1.0, 50.0, size=B).astype(np.float32)
+        has_rt = (events == W.EV_SUCCESS) & (np.arange(B) < b)
+        deltas = np.zeros((B, W.NUM_EVENTS), dtype=np.int32)
+        deltas[np.arange(B), events] = 1
+        deltas[b:] = 0
+        st = add(
+            st,
+            jnp.int32(now),
+            jnp.asarray(rows),
+            jnp.asarray(deltas),
+            jnp.asarray(np.where(has_rt, rts, 0.0), dtype=jnp.float32),
+        )
+        for i in range(b):
+            oracle.add(now, rows[i], int(events[i]))
+            if has_rt[i]:
+                oracle.add_rt(now, rows[i], float(rts[i]))
+
+        if step % 7 == 0:
+            got_p, got_b, got_s, got_rt, got_min = reads(st, jnp.int32(now))
+            # trash row excluded from comparison
+            np.testing.assert_array_equal(
+                np.asarray(got_p)[:ROWS], oracle.window_event(now, W.EV_PASS)[:ROWS]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got_b)[:ROWS], oracle.window_event(now, W.EV_BLOCK)[:ROWS]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got_s)[:ROWS], oracle.window_event(now, W.EV_SUCCESS)[:ROWS]
+            )
+            want_rt, want_min = oracle.window_rt(now)
+            np.testing.assert_allclose(np.asarray(got_rt)[:ROWS], want_rt[:ROWS], rtol=1e-4)
+            np.testing.assert_allclose(np.asarray(got_min)[:ROWS], want_min[:ROWS], rtol=1e-5)
+
+
+def test_gather_matches_full_reduction():
+    import functools
+
+    rng = np.random.default_rng(7)
+    st = W.init_window(ROWS + 1, CFG)
+    add = jax.jit(functools.partial(W.add_batch, cfg=CFG))
+    now = 0
+    for _ in range(20):
+        now += int(rng.integers(1, 400))
+        b = 8
+        rows = jnp.asarray(rng.integers(0, ROWS, size=b), dtype=jnp.int32)
+        deltas = np.zeros((b, W.NUM_EVENTS), dtype=np.int32)
+        deltas[:, W.EV_PASS] = 1
+        st = add(st, jnp.int32(now), rows, jnp.asarray(deltas), None)
+    full = np.asarray(W.window_event(st, jnp.int32(now), CFG, W.EV_PASS))
+    sel = jnp.asarray([0, 3, 7, 2], dtype=jnp.int32)
+    got = np.asarray(W.gather_window_event(st, jnp.int32(now), sel, CFG, W.EV_PASS))
+    np.testing.assert_array_equal(got, full[np.asarray(sel)])
