@@ -1,0 +1,311 @@
+"""Rule compilation: rule objects → structure-of-arrays device tensors.
+
+The analog of FlowRuleUtil.buildFlowRuleMap/generateRater
+(slots/block/flow/FlowRuleUtil.java:45-136): when rules are (re)loaded, the
+whole rule set is recompiled into dense tensors indexed by *rule slot*, plus
+per-resource lookup tables ``res_* : int32[max_resources, K]`` mapping a
+resource id to its rule slots.  Controller state (warm-up token bucket,
+leaky-bucket latest-passed-time) is keyed by rule slot, mirroring the
+reference's one-controller-instance-per-rule design
+(TrafficShapingController per FlowRule).
+
+Every tensor family has one extra "trash" slot at index ``max_*`` with
+``enabled=False`` so lookups never need bounds branches.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from sentinel_tpu.core import rules as R
+from sentinel_tpu.core.config import EngineConfig
+
+# limit_app encodings
+LIMIT_ANY = -1  # "default" — matches every origin
+LIMIT_OTHER = -2  # "other" — matches origins not named by any sibling rule
+
+
+class FlowRuleTensors(NamedTuple):
+    enabled: np.ndarray  # bool [F+1]
+    res: np.ndarray  # int32 [F+1]
+    grade: np.ndarray  # int32 — 0 thread / 1 qps
+    count: np.ndarray  # float32 threshold
+    behavior: np.ndarray  # int32 control behavior
+    strategy: np.ndarray  # int32 direct/relate/chain
+    ref_node: np.ndarray  # int32 node row for RELATE (-1 = none)
+    ref_ctx: np.ndarray  # int32 interned context name for CHAIN (-1 = none)
+    limit_app: np.ndarray  # int32 (LIMIT_ANY / LIMIT_OTHER / origin id)
+    max_queue_ms: np.ndarray  # int32 (rate-limiter queueing budget)
+    cluster_mode: np.ndarray  # bool
+    # warm-up precomputation (WarmUpController.java:103-112)
+    warning_token: np.ndarray  # float32
+    max_token: np.ndarray  # float32
+    slope: np.ndarray  # float32
+    cold_factor: np.ndarray  # float32
+    res_rules: np.ndarray  # int32 [max_resources, K] rule slots (trash padded)
+
+
+class DegradeRuleTensors(NamedTuple):
+    enabled: np.ndarray  # bool [D+1]
+    res: np.ndarray  # int32
+    grade: np.ndarray  # int32 (0 slow-ratio, 1 error-ratio, 2 error-count)
+    count: np.ndarray  # float32 (max RT / ratio / count)
+    slow_ratio: np.ndarray  # float32
+    retry_timeout_ms: np.ndarray  # int32
+    min_request: np.ndarray  # int32
+    window_ms: np.ndarray  # int32 per-rule bucket length (= statInterval / nb)
+    res_cbs: np.ndarray  # int32 [max_resources, KD]
+
+
+class ParamRuleTensors(NamedTuple):
+    enabled: np.ndarray  # bool [P+1]
+    res: np.ndarray  # int32
+    grade: np.ndarray  # int32
+    threshold: np.ndarray  # float32 — count * duration + burst (window budget)
+    window_ms: np.ndarray  # int32 per-rule CMS bucket length
+    param_idx: np.ndarray  # int32
+    item_hash: np.ndarray  # int32 [P+1, KI] per-value exceptions
+    item_threshold: np.ndarray  # float32 [P+1, KI]
+    res_params: np.ndarray  # int32 [max_resources, KP]
+
+
+class AuthorityTensors(NamedTuple):
+    mode: np.ndarray  # int32 [max_resources] 0 none / 1 white / 2 black
+    origins: np.ndarray  # int32 [max_resources, KA] (-9 = empty)
+
+
+class SystemTensors(NamedTuple):
+    # scalar thresholds, negative = unset (SystemRuleManager.java:68-97)
+    load: np.ndarray  # float32 []
+    cpu: np.ndarray
+    qps: np.ndarray
+    avg_rt: np.ndarray
+    max_thread: np.ndarray
+
+
+AUTH_EMPTY = -9  # never a valid origin id (-1 means "no origin")
+
+_PARAM_ITEM_SLOTS = 8
+
+
+def compile_flow_rules(
+    rules: List[R.FlowRule], cfg: EngineConfig, registry
+) -> FlowRuleTensors:
+    F = cfg.max_flow_rules
+    K = cfg.flow_rules_per_resource
+    t = FlowRuleTensors(
+        enabled=np.zeros(F + 1, dtype=bool),
+        res=np.zeros(F + 1, dtype=np.int32),
+        grade=np.full(F + 1, R.GRADE_QPS, dtype=np.int32),
+        count=np.zeros(F + 1, dtype=np.float32),
+        behavior=np.zeros(F + 1, dtype=np.int32),
+        strategy=np.zeros(F + 1, dtype=np.int32),
+        ref_node=np.full(F + 1, -1, dtype=np.int32),
+        ref_ctx=np.full(F + 1, -1, dtype=np.int32),
+        limit_app=np.full(F + 1, LIMIT_ANY, dtype=np.int32),
+        max_queue_ms=np.full(F + 1, 500, dtype=np.int32),
+        cluster_mode=np.zeros(F + 1, dtype=bool),
+        warning_token=np.zeros(F + 1, dtype=np.float32),
+        max_token=np.zeros(F + 1, dtype=np.float32),
+        slope=np.zeros(F + 1, dtype=np.float32),
+        cold_factor=np.full(F + 1, 3.0, dtype=np.float32),
+        res_rules=np.full((cfg.max_resources + 1, K), F, dtype=np.int32),
+    )
+    slot = 0
+    per_res_count: dict = {}
+    for rule in rules:
+        if not rule.is_valid() or slot >= F:
+            continue
+        rid = registry.resource_id(rule.resource)
+        if rid is None:
+            continue
+        k = per_res_count.get(rid, 0)
+        if k >= K:
+            continue  # per-resource rule capacity
+        per_res_count[rid] = k + 1
+        t.res_rules[rid, k] = slot
+
+        t.enabled[slot] = True
+        t.res[slot] = rid
+        t.grade[slot] = rule.grade
+        t.count[slot] = rule.count
+        t.behavior[slot] = rule.control_behavior
+        t.strategy[slot] = rule.strategy
+        t.max_queue_ms[slot] = rule.max_queueing_time_ms
+        t.cluster_mode[slot] = rule.cluster_mode
+
+        if rule.strategy == R.STRATEGY_RELATE and rule.ref_resource:
+            ref = registry.resource_id(rule.ref_resource)
+            t.ref_node[slot] = ref if ref is not None else -1
+        elif rule.strategy == R.STRATEGY_CHAIN and rule.ref_resource:
+            # CHAIN: rule applies when the item's context name equals
+            # refResource (FlowRuleChecker.selectReferenceNode)
+            t.ref_ctx[slot] = registry.context_id(rule.ref_resource)
+
+        la = rule.limit_app or R.LIMIT_APP_DEFAULT
+        if la == R.LIMIT_APP_DEFAULT:
+            t.limit_app[slot] = LIMIT_ANY
+        elif la == R.LIMIT_APP_OTHER:
+            t.limit_app[slot] = LIMIT_OTHER
+        else:
+            t.limit_app[slot] = registry.origin_id(la)
+
+        # Guava-style warm-up precomputation (WarmUpController.java:103-112)
+        cf = max(float(rule.cold_factor), 2.0)
+        count = max(float(rule.count), 1e-9)
+        wp = max(int(rule.warm_up_period_sec), 1)
+        warning = (wp * count) / (cf - 1.0)
+        max_tok = warning + 2.0 * wp * count / (1.0 + cf)
+        slope_v = (cf - 1.0) / count / max(max_tok - warning, 1e-9)
+        t.warning_token[slot] = warning
+        t.max_token[slot] = max_tok
+        t.slope[slot] = slope_v
+        t.cold_factor[slot] = cf
+        slot += 1
+    return t
+
+
+def compile_degrade_rules(
+    rules: List[R.DegradeRule], cfg: EngineConfig, registry
+) -> DegradeRuleTensors:
+    D = cfg.max_degrade_rules
+    KD = cfg.degrade_rules_per_resource
+    nb = cfg.cb_sample_count
+    t = DegradeRuleTensors(
+        enabled=np.zeros(D + 1, dtype=bool),
+        res=np.zeros(D + 1, dtype=np.int32),
+        grade=np.zeros(D + 1, dtype=np.int32),
+        count=np.zeros(D + 1, dtype=np.float32),
+        slow_ratio=np.ones(D + 1, dtype=np.float32),
+        retry_timeout_ms=np.full(D + 1, 1000, dtype=np.int32),
+        min_request=np.full(D + 1, 5, dtype=np.int32),
+        window_ms=np.full(D + 1, 1000 // nb, dtype=np.int32),
+        res_cbs=np.full((cfg.max_resources + 1, KD), D, dtype=np.int32),
+    )
+    slot = 0
+    per_res_count: dict = {}
+    for rule in rules:
+        if not rule.is_valid() or slot >= D:
+            continue
+        rid = registry.resource_id(rule.resource)
+        if rid is None:
+            continue
+        k = per_res_count.get(rid, 0)
+        if k >= KD:
+            continue
+        per_res_count[rid] = k + 1
+        t.res_cbs[rid, k] = slot
+        t.enabled[slot] = True
+        t.res[slot] = rid
+        t.grade[slot] = rule.grade
+        t.count[slot] = rule.count
+        t.slow_ratio[slot] = rule.slow_ratio_threshold
+        t.retry_timeout_ms[slot] = rule.time_window * 1000
+        t.min_request[slot] = rule.min_request_amount
+        t.window_ms[slot] = max(rule.stat_interval_ms // nb, 1)
+        slot += 1
+    return t
+
+
+def hash_param(value) -> int:
+    """Stable 31-bit hash of a parameter value (int or str).
+
+    Kept host-side so the device only ever sees int32 hashes; the native
+    extension (sentinel_tpu/native) accelerates the str path.
+    """
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, int):
+        h = (value * 0x9E3779B1) & 0x7FFFFFFF
+    else:
+        h = 2166136261
+        for b in str(value).encode("utf-8"):
+            h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+        h &= 0x7FFFFFFF
+    return h if h != 0 else 1  # 0 is reserved for "no parameter"
+
+
+def compile_param_rules(
+    rules: List[R.ParamFlowRule], cfg: EngineConfig, registry
+) -> ParamRuleTensors:
+    P = cfg.max_param_rules
+    KP = cfg.param_rules_per_resource
+    KI = _PARAM_ITEM_SLOTS
+    nb = cfg.cms_sample_count
+    t = ParamRuleTensors(
+        enabled=np.zeros(P + 1, dtype=bool),
+        res=np.zeros(P + 1, dtype=np.int32),
+        grade=np.full(P + 1, R.GRADE_QPS, dtype=np.int32),
+        threshold=np.zeros(P + 1, dtype=np.float32),
+        window_ms=np.full(P + 1, 1000 // nb, dtype=np.int32),
+        param_idx=np.zeros(P + 1, dtype=np.int32),
+        item_hash=np.zeros((P + 1, KI), dtype=np.int32),
+        item_threshold=np.zeros((P + 1, KI), dtype=np.float32),
+        res_params=np.full((cfg.max_resources + 1, KP), P, dtype=np.int32),
+    )
+    slot = 0
+    per_res_count: dict = {}
+    for rule in rules:
+        if not rule.is_valid() or slot >= P:
+            continue
+        rid = registry.resource_id(rule.resource)
+        if rid is None:
+            continue
+        k = per_res_count.get(rid, 0)
+        if k >= KP:
+            continue
+        per_res_count[rid] = k + 1
+        t.res_params[rid, k] = slot
+        t.enabled[slot] = True
+        t.res[slot] = rid
+        t.grade[slot] = rule.grade
+        dur = max(int(rule.duration_in_sec), 1)
+        # windowed budget over the rule's duration (ParamFlowChecker token
+        # bucket capacity: count * duration + burst, :127-188)
+        t.threshold[slot] = rule.count * dur + rule.burst_count
+        t.window_ms[slot] = max(dur * 1000 // nb, 1)
+        t.param_idx[slot] = rule.param_idx
+        for i, item in enumerate(rule.param_flow_item_list[:KI]):
+            t.item_hash[slot, i] = hash_param(item.object)
+            t.item_threshold[slot, i] = item.count * dur
+        slot += 1
+    return t
+
+
+def compile_authority_rules(
+    rules: List[R.AuthorityRule], cfg: EngineConfig, registry
+) -> AuthorityTensors:
+    KA = cfg.authority_origins_per_resource
+    t = AuthorityTensors(
+        mode=np.zeros(cfg.max_resources + 1, dtype=np.int32),
+        origins=np.full((cfg.max_resources + 1, KA), AUTH_EMPTY, dtype=np.int32),
+    )
+    for rule in rules:
+        if not rule.is_valid():
+            continue
+        rid = registry.resource_id(rule.resource)
+        if rid is None:
+            continue
+        t.mode[rid] = 1 if rule.strategy == R.AUTHORITY_WHITE else 2
+        for i, o in enumerate(rule.origins()[:KA]):
+            t.origins[rid, i] = registry.origin_id(o)
+    return t
+
+
+def compile_system_rules(rules: List[R.SystemRule], cfg: EngineConfig) -> SystemTensors:
+    # fold multiple rules by taking the tightest threshold of each dimension,
+    # as SystemRuleManager.loadSystemConf does
+    def tightest(vals):
+        vals = [v for v in vals if v >= 0]
+        return np.float32(min(vals)) if vals else np.float32(-1.0)
+
+    return SystemTensors(
+        load=tightest([r.highest_system_load for r in rules]),
+        cpu=tightest([r.highest_cpu_usage for r in rules]),
+        qps=tightest([r.qps for r in rules]),
+        avg_rt=tightest([r.avg_rt for r in rules]),
+        max_thread=tightest([r.max_thread for r in rules]),
+    )

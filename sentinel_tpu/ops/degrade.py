@@ -1,0 +1,95 @@
+"""Circuit breakers as a vectorized state machine.
+
+The reference's AbstractCircuitBreaker.java:33-136 is a CAS state machine
+(CLOSED/OPEN/HALF_OPEN) per DegradeRule, with its own LeapArray of
+slow/error counts (ResponseTimeCircuitBreaker.java:162,
+ExceptionCircuitBreaker.java:37).  Here every degrade rule is a row in:
+
+    cb_state    : int32 [D+1]          (0 CLOSED, 1 OPEN, 2 HALF_OPEN)
+    cb_retry_ms : int32 [D+1]          next-probe deadline for OPEN rules
+    cb_counts   : int32 [D+1, nb, 3]   (TOTAL, ERROR, SLOW) ring buckets
+    cb_epochs   : int32 [D+1, nb]      per-rule epochs (rules have their own
+                                       statIntervalMs, so bucket lengths vary
+                                       per row — window_ms[D+1])
+
+Transitions per tick:
+  - completions scatter TOTAL/ERROR/SLOW into each rule's current bucket;
+  - a completion observed while HALF_OPEN resolves the probe:
+    error-or-slow → OPEN (regression, AbstractCircuitBreaker.java:136),
+    otherwise → CLOSED with stats reset;
+  - CLOSED rules re-evaluate their trip condition on windowed sums;
+  - the acquire path (in engine.py) elects one probe per OPEN rule whose
+    retry deadline passed, moving it to HALF_OPEN.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+CB_CLOSED = 0
+CB_OPEN = 1
+CB_HALF_OPEN = 2
+
+CBE_TOTAL = 0
+CBE_ERROR = 1
+CBE_SLOW = 2
+
+# DegradeRule grades (RuleConstant)
+GRADE_SLOW_RATIO = 0
+GRADE_ERROR_RATIO = 1
+GRADE_ERROR_COUNT = 2
+
+
+def refresh_columns(
+    counts: jax.Array,  # int32 [D+1, nb, 3]
+    epochs: jax.Array,  # int32 [D+1, nb]
+    window_ms: jax.Array,  # int32 [D+1]
+    now_ms: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Zero each rule's current bucket if stale. Returns (counts, epochs, cur_idx)."""
+    nb = counts.shape[1]
+    wid = (now_ms // jnp.maximum(window_ms, 1)).astype(jnp.int32)
+    idx = wid % nb
+    onehot = jax.nn.one_hot(idx, nb, dtype=jnp.int32)
+    cur_epoch = jnp.take_along_axis(epochs, idx[:, None], axis=1)[:, 0]
+    stale = (cur_epoch != wid).astype(jnp.int32)
+    keep = 1 - onehot * stale[:, None]
+    counts = counts * keep[:, :, None]
+    epochs = jnp.where((onehot == 1) & (stale[:, None] == 1), wid[:, None], epochs)
+    return counts, epochs, idx
+
+
+def window_sums(
+    counts: jax.Array, epochs: jax.Array, window_ms: jax.Array, now_ms: jax.Array
+) -> jax.Array:
+    """int32 [D+1, 3] — windowed totals per rule."""
+    nb = counts.shape[1]
+    wid = (now_ms // jnp.maximum(window_ms, 1)).astype(jnp.int32)
+    valid = (epochs > (wid[:, None] - nb)) & (epochs <= wid[:, None])
+    return jnp.sum(counts * valid[:, :, None], axis=1)
+
+
+def trip_condition(
+    sums: jax.Array,  # int32 [D+1, 3]
+    grade: jax.Array,  # int32 [D+1]
+    count: jax.Array,  # float32 [D+1] (maxRT / ratio / abs count)
+    slow_ratio: jax.Array,  # float32 [D+1]
+    min_request: jax.Array,  # int32 [D+1]
+) -> jax.Array:
+    """bool [D+1] — should a CLOSED breaker trip OPEN now?
+
+    Mirrors ResponseTimeCircuitBreaker.onRequestComplete:65-90 and
+    ExceptionCircuitBreaker threshold checks.
+    """
+    total = sums[:, CBE_TOTAL].astype(jnp.float32)
+    err = sums[:, CBE_ERROR].astype(jnp.float32)
+    slow = sums[:, CBE_SLOW].astype(jnp.float32)
+    enough = total >= min_request.astype(jnp.float32)
+    safe_total = jnp.maximum(total, 1.0)
+    trip_slow = (grade == GRADE_SLOW_RATIO) & enough & (slow / safe_total > slow_ratio)
+    trip_eratio = (grade == GRADE_ERROR_RATIO) & enough & (err / safe_total > count)
+    trip_ecount = (grade == GRADE_ERROR_COUNT) & enough & (err >= count)
+    return trip_slow | trip_eratio | trip_ecount

@@ -1,0 +1,744 @@
+"""The fused decision engine: one jitted tick per micro-batch.
+
+This is the TPU inversion of the reference's per-request slot chain
+(CtSph.java:43 → DefaultProcessorSlotChain → NodeSelector/ClusterBuilder/
+Log/Statistic/Authority/System/Flow/Degrade slots, SURVEY.md §3.1): instead
+of every request walking a pointer chain under CAS, a tick ingests
+
+    AcquireBatch  — entry attempts   (SphU.entry side)
+    CompleteBatch — exits            (Entry.exit + Tracer side)
+
+as fixed-shape int32/float32 tensors and produces a verdict per attempt.
+Rule evaluation order matches the reference slot order exactly
+(Authority −6000 → System −5000 → ParamFlow −3000 → Flow −2000 →
+Degrade −1000); the first failing check determines the verdict code.
+
+Within-tick contention is resolved by grouped prefix sums (ops/rank.py)
+instead of CAS loops: requests hitting the same decision node are ranked in
+arrival order, and each check sees the tokens consumed by its group
+predecessors.  This makes single-threshold admission bit-exact with
+sequential processing; the documented approximation is that two *different*
+rules watching the same node inside one tick each assume the other's
+candidates pass (error bounded by one batch).
+
+Everything below is a pure function of (state, rules, batch, now_ms) —
+time is an explicit input (see SURVEY.md §4.1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core import rule_tensors as RT
+from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.core.errors import (
+    BLOCK_AUTHORITY,
+    BLOCK_DEGRADE,
+    BLOCK_FLOW,
+    BLOCK_PARAM,
+    BLOCK_SYSTEM,
+    PASS,
+    PASS_WAIT,
+)
+from sentinel_tpu.core.rules import (
+    CONTROL_DEFAULT,
+    CONTROL_RATE_LIMITER,
+    CONTROL_WARM_UP,
+    CONTROL_WARM_UP_RATE_LIMITER,
+    GRADE_QPS,
+    STRATEGY_CHAIN,
+    STRATEGY_DIRECT,
+    STRATEGY_RELATE,
+)
+from sentinel_tpu.ops import degrade as D
+from sentinel_tpu.ops import param as P
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops.rank import grouped_exclusive_cumsum, grouped_first
+
+
+class EngineState(NamedTuple):
+    win_sec: W.WindowState  # [node_rows] second window (2 x 500 ms default)
+    win_min: W.WindowState  # [node_rows] minute window (60 x 1 s default)
+    concurrency: jax.Array  # int32 [node_rows] curThreadNum per node
+    # per flow-rule controller state
+    latest_passed_ms: jax.Array  # float32 [F+1] RateLimiterController.latestPassedTime
+    warmup_tokens: jax.Array  # float32 [F+1] WarmUpController.storedTokens
+    warmup_last_s: jax.Array  # int32 [F+1] lastFilledTime (seconds)
+    # per degrade-rule circuit breaker
+    cb_state: jax.Array  # int32 [D+1]
+    cb_retry_ms: jax.Array  # int32 [D+1]
+    cb_counts: jax.Array  # int32 [D+1, nbc, 3]
+    cb_epochs: jax.Array  # int32 [D+1, nbc]
+    # per param-rule count-min sketch
+    cms: jax.Array  # int32 [P+1, nbp, depth, width]
+    cms_epochs: jax.Array  # int32 [P+1, nbp]
+
+
+class RuleSet(NamedTuple):
+    flow: RT.FlowRuleTensors
+    degrade: RT.DegradeRuleTensors
+    param: RT.ParamRuleTensors
+    auth: RT.AuthorityTensors
+    system: RT.SystemTensors
+
+
+class AcquireBatch(NamedTuple):
+    """Entry attempts. Padding items carry res == trash_row."""
+
+    res: jax.Array  # int32 [B] resource id == cluster-node row
+    count: jax.Array  # int32 [B] tokens to acquire
+    prio: jax.Array  # int32 [B] prioritized flag
+    origin_id: jax.Array  # int32 [B] interned origin (-1 none)
+    origin_node: jax.Array  # int32 [B] origin stat row (trash if none)
+    ctx_node: jax.Array  # int32 [B] context DefaultNode row (trash if none)
+    ctx_name: jax.Array  # int32 [B] interned context name (-1 default)
+    inbound: jax.Array  # int32 [B] 1 = entrance context (EntranceNode)
+    param_hash: jax.Array  # int32 [B] hashed hot param (0 none)
+
+
+class CompleteBatch(NamedTuple):
+    """Exits. Padding items carry res == trash_row."""
+
+    res: jax.Array  # int32 [B2]
+    origin_node: jax.Array  # int32 [B2]
+    ctx_node: jax.Array  # int32 [B2]
+    inbound: jax.Array  # int32 [B2]
+    rt: jax.Array  # float32 [B2] response time ms
+    success: jax.Array  # int32 [B2] completions (usually 1)
+    error: jax.Array  # int32 [B2] business exceptions (Tracer.trace)
+
+
+class TickOutput(NamedTuple):
+    verdict: jax.Array  # int8 [B] PASS / BLOCK_* / PASS_WAIT
+    wait_ms: jax.Array  # int32 [B] pacing delay for PASS_WAIT
+
+
+# ---------------------------------------------------------------------------
+
+
+def init_state(cfg: EngineConfig) -> EngineState:
+    rows = cfg.node_rows
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
+    min_rows = rows if cfg.enable_minute_window else 1
+    F = cfg.max_flow_rules
+    Dn = cfg.max_degrade_rules
+    Pn = cfg.max_param_rules
+    return EngineState(
+        win_sec=W.init_window(rows, sec_cfg),
+        win_min=W.init_window(min_rows, min_cfg),
+        concurrency=jnp.zeros((rows,), dtype=jnp.int32),
+        latest_passed_ms=jnp.full((F + 1,), -1.0e9, dtype=jnp.float32),
+        warmup_tokens=jnp.zeros((F + 1,), dtype=jnp.float32),
+        warmup_last_s=jnp.full((F + 1,), -1, dtype=jnp.int32),
+        cb_state=jnp.zeros((Dn + 1,), dtype=jnp.int32),
+        cb_retry_ms=jnp.zeros((Dn + 1,), dtype=jnp.int32),
+        cb_counts=jnp.zeros((Dn + 1, cfg.cb_sample_count, 3), dtype=jnp.int32),
+        cb_epochs=jnp.full((Dn + 1, cfg.cb_sample_count), -10, dtype=jnp.int32),
+        cms=jnp.zeros(
+            (Pn + 1, cfg.cms_sample_count, cfg.cms_depth, cfg.cms_width),
+            dtype=jnp.int32,
+        ),
+        cms_epochs=jnp.full((Pn + 1, cfg.cms_sample_count), -10, dtype=jnp.int32),
+    )
+
+
+def empty_acquire(cfg: EngineConfig, b: Optional[int] = None) -> AcquireBatch:
+    b = b or cfg.batch_size
+    trash = cfg.trash_row
+    z = jnp.zeros((b,), dtype=jnp.int32)
+    return AcquireBatch(
+        res=jnp.full((b,), trash, dtype=jnp.int32),
+        count=z,
+        prio=z,
+        origin_id=jnp.full((b,), -1, dtype=jnp.int32),
+        origin_node=jnp.full((b,), trash, dtype=jnp.int32),
+        ctx_node=jnp.full((b,), trash, dtype=jnp.int32),
+        ctx_name=jnp.full((b,), -1, dtype=jnp.int32),
+        inbound=z,
+        param_hash=z,
+    )
+
+
+def empty_complete(cfg: EngineConfig, b: Optional[int] = None) -> CompleteBatch:
+    b = b or cfg.complete_batch_size
+    trash = cfg.trash_row
+    z = jnp.zeros((b,), dtype=jnp.int32)
+    return CompleteBatch(
+        res=jnp.full((b,), trash, dtype=jnp.int32),
+        origin_node=jnp.full((b,), trash, dtype=jnp.int32),
+        ctx_node=jnp.full((b,), trash, dtype=jnp.int32),
+        inbound=z,
+        rt=jnp.zeros((b,), dtype=jnp.float32),
+        success=z,
+        error=z,
+    )
+
+
+def _stat_rows(cfg: EngineConfig, res, ctx_node, origin_node, inbound):
+    """[4*N] stat rows an item writes to: cluster node, context DefaultNode,
+    origin node, and the global ENTRY node for inbound traffic
+    (StatisticSlot.java:54-123)."""
+    entry = jnp.where(
+        inbound > 0, jnp.int32(cfg.entry_node_row), jnp.int32(cfg.trash_row)
+    )
+    return jnp.concatenate([res, ctx_node, origin_node, entry])
+
+
+def _scatter_events(
+    cfg: EngineConfig,
+    state: EngineState,
+    now_ms,
+    rows4,  # [4N]
+    deltas,  # int32 [4N, NUM_EVENTS]
+    rt,  # float32 [4N] or None
+) -> EngineState:
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    win_sec = W.add_batch(state.win_sec, now_ms, rows4, deltas, rt, sec_cfg)
+    win_min = state.win_min
+    if cfg.enable_minute_window:
+        min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
+        win_min = W.add_batch(state.win_min, now_ms, rows4, deltas, rt, min_cfg)
+    return state._replace(win_sec=win_sec, win_min=win_min)
+
+
+# ---------------------------------------------------------------------------
+# tick phases
+# ---------------------------------------------------------------------------
+
+
+def _process_completions(
+    cfg: EngineConfig, state: EngineState, rules: RuleSet, comp: CompleteBatch, now_ms
+) -> EngineState:
+    """Exit path: RT/success/exception recording + circuit-breaker feedback
+    (StatisticSlot.exit:125-164, DegradeSlot.exit:60-75)."""
+    b = comp.res.shape[0]
+    valid = comp.res != cfg.trash_row
+
+    rows4 = _stat_rows(cfg, comp.res, comp.ctx_node, comp.origin_node, comp.inbound)
+    deltas1 = jnp.zeros((b, W.NUM_EVENTS), dtype=jnp.int32)
+    deltas1 = deltas1.at[:, W.EV_SUCCESS].set(comp.success)
+    deltas1 = deltas1.at[:, W.EV_EXCEPTION].set(comp.error)
+    deltas4 = jnp.tile(deltas1, (4, 1))
+    rt4 = jnp.tile(jnp.where(valid, comp.rt, 0.0), (4,))
+    state = _scatter_events(cfg, state, now_ms, rows4, deltas4, rt4)
+
+    # concurrency release on all touched rows
+    dec = jnp.tile(jnp.where(valid, comp.success, 0), (4,))
+    concurrency = state.concurrency.at[rows4].add(-dec, mode="drop")
+    concurrency = jnp.maximum(concurrency, 0)
+
+    # --- circuit-breaker windows -----------------------------------------
+    KD = cfg.degrade_rules_per_resource
+    res_l = jnp.minimum(comp.res, cfg.max_resources)
+    slots = rules.degrade.res_cbs[res_l]  # [B2, KD]
+    slots_f = slots.reshape(-1)
+    item = jnp.repeat(jnp.arange(b), KD)
+    enabled = rules.degrade.enabled[slots_f]
+    active = enabled & valid[item]
+
+    cb_counts, cb_epochs, cur_idx = D.refresh_columns(
+        state.cb_counts, state.cb_epochs, rules.degrade.window_ms, now_ms
+    )
+    is_err = (comp.error[item] > 0) & active
+    is_slow = (
+        (rules.degrade.grade[slots_f] == D.GRADE_SLOW_RATIO)
+        & (comp.rt[item] > rules.degrade.count[slots_f])
+        & active
+    )
+    upd = jnp.stack(
+        [
+            jnp.where(active, 1, 0),
+            jnp.where(is_err, 1, 0),
+            jnp.where(is_slow, 1, 0),
+        ],
+        axis=-1,
+    )  # [B2*KD, 3]
+    safe_slots = jnp.minimum(slots_f, cfg.max_degrade_rules)
+    cb_counts = cb_counts.at[safe_slots, cur_idx[safe_slots], :].add(upd, mode="drop")
+
+    # --- half-open probe resolution (AbstractCircuitBreaker.java:68-136) --
+    half_open = state.cb_state[safe_slots] == D.CB_HALF_OPEN
+    probe_done = active & half_open
+    probe_fail = probe_done & (is_err | is_slow)
+    Dn1 = cfg.max_degrade_rules + 1
+    seen = jnp.zeros((Dn1,), jnp.int32).at[safe_slots].max(probe_done.astype(jnp.int32))
+    failed = jnp.zeros((Dn1,), jnp.int32).at[safe_slots].max(probe_fail.astype(jnp.int32))
+    was_half = state.cb_state == D.CB_HALF_OPEN
+    to_open = was_half & (seen > 0) & (failed > 0)
+    to_close = was_half & (seen > 0) & (failed == 0)
+    cb_state = jnp.where(to_open, D.CB_OPEN, state.cb_state)
+    cb_state = jnp.where(to_close, D.CB_CLOSED, cb_state)
+    cb_retry = jnp.where(
+        to_open, now_ms + rules.degrade.retry_timeout_ms, state.cb_retry_ms
+    )
+    # closing resets the rule's stat window (fromHalfOpenToClose → resetStat)
+    cb_counts = jnp.where(to_close[:, None, None], 0, cb_counts)
+
+    # --- trip evaluation for CLOSED breakers ------------------------------
+    sums = D.window_sums(cb_counts, cb_epochs, rules.degrade.window_ms, now_ms)
+    trip = D.trip_condition(
+        sums,
+        rules.degrade.grade,
+        rules.degrade.count,
+        rules.degrade.slow_ratio,
+        rules.degrade.min_request,
+    )
+    newly_open = (cb_state == D.CB_CLOSED) & trip & rules.degrade.enabled
+    cb_state = jnp.where(newly_open, D.CB_OPEN, cb_state)
+    cb_retry = jnp.where(newly_open, now_ms + rules.degrade.retry_timeout_ms, cb_retry)
+
+    return state._replace(
+        concurrency=concurrency,
+        cb_counts=cb_counts,
+        cb_epochs=cb_epochs,
+        cb_state=cb_state,
+        cb_retry_ms=cb_retry,
+    )
+
+
+def _check_authority(cfg: EngineConfig, rules: RuleSet, acq: AcquireBatch):
+    """AuthoritySlot: origin allow/deny (AuthorityRuleChecker.java:28-54)."""
+    res_l = jnp.minimum(acq.res, cfg.max_resources)
+    mode = rules.auth.mode[res_l]  # [B]
+    origins = rules.auth.origins[res_l]  # [B, KA]
+    listed = ((origins == acq.origin_id[:, None]) & (origins != RT.AUTH_EMPTY)).any(
+        axis=1
+    )
+    white_block = (mode == 1) & ~listed
+    black_block = (mode == 2) & listed
+    return white_block | black_block
+
+
+def _check_system(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    now_ms,
+    sys_load,
+    sys_cpu,
+    eligible,
+):
+    """SystemSlot: global inbound-only adaptive gate incl. BBR check
+    (SystemRuleManager.checkSystem / checkBbr)."""
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    entry = jnp.array([cfg.entry_node_row], dtype=jnp.int32)
+    ec = W.gather_window_counts(state.win_sec, now_ms, entry, sec_cfg)[0]
+    ert, emin = W.gather_window_rt(state.win_sec, now_ms, entry, sec_cfg)
+    e_pass = ec[W.EV_PASS].astype(jnp.float32)
+    e_succ = ec[W.EV_SUCCESS].astype(jnp.float32)
+    e_rt_avg = jnp.where(e_succ > 0, ert[0] / jnp.maximum(e_succ, 1.0), 0.0)
+    e_conc = state.concurrency[cfg.entry_node_row].astype(jnp.float32)
+    # max single-bucket success * sample_count ≈ maxSuccessQps (StatisticNode)
+    mask = W.valid_mask(state.win_sec, now_ms, sec_cfg)
+    bucket_succ = state.win_sec.counts[cfg.entry_node_row, :, W.EV_SUCCESS]
+    max_succ_qps = (
+        jnp.max(jnp.where(mask, bucket_succ, 0)).astype(jnp.float32)
+        * cfg.second_sample_count
+    )
+    min_rt = emin[0]
+
+    inbound = (acq.inbound > 0) & eligible
+    cnt = acq.count.astype(jnp.float32)
+    (rank_q,) = grouped_exclusive_cumsum(
+        jnp.zeros_like(acq.res), [cnt], inbound
+    )
+    rank_t = rank_q  # one concurrent slot per inbound attempt (count≈1)
+
+    s = rules.system
+    blk = jnp.zeros_like(inbound)
+    blk |= (s.qps >= 0) & (e_pass + rank_q + cnt > s.qps)
+    blk |= (s.max_thread >= 0) & (e_conc + rank_t + 1 > s.max_thread)
+    blk |= (s.avg_rt >= 0) & (e_rt_avg > s.avg_rt)
+    # BBR: under high load only allow while concurrency fits the pipe
+    bbr_ok = (e_conc + rank_t + 1) <= jnp.maximum(max_succ_qps * min_rt / 1000.0, 1.0)
+    blk |= (s.load >= 0) & (sys_load > s.load) & ~bbr_ok
+    blk |= (s.cpu >= 0) & (sys_cpu > s.cpu)
+    return blk & inbound
+
+
+def _check_param(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    now_ms,
+    eligible,
+):
+    """ParamFlowSlot: per-parameter-value windowed CMS limiting
+    (ParamFlowChecker.passLocalCheck:78-188, token bucket → windowed budget).
+
+    Returns (blocked[B], cms, cms_epochs, cur_idx, pslots_f, p_applicable).
+    """
+    KP = cfg.param_rules_per_resource
+    b = acq.res.shape[0]
+    res_l = jnp.minimum(acq.res, cfg.max_resources)
+    slots = rules.param.res_params[res_l]  # [B, KP]
+    slots_f = slots.reshape(-1)
+    item = jnp.repeat(jnp.arange(b), KP)
+
+    cms, cms_epochs, cur_idx = P.refresh_columns(
+        state.cms, state.cms_epochs, rules.param.window_ms, now_ms
+    )
+
+    enabled = rules.param.enabled[slots_f]
+    ph = acq.param_hash[item]
+    applicable = enabled & (ph != 0)
+    est = P.estimate(cms, cms_epochs, rules.param.window_ms, slots_f, ph, now_ms)
+
+    # per-value exception items (ParamFlowItem)
+    ih = rules.param.item_hash[slots_f]  # [N, KI]
+    it = rules.param.item_threshold[slots_f]
+    is_item = (ih == ph[:, None]) & (ih != 0)
+    has_item = is_item.any(axis=1)
+    item_thr = jnp.max(jnp.where(is_item, it, 0.0), axis=1)
+    thr = jnp.where(has_item, item_thr, rules.param.threshold[slots_f])
+
+    cnt = acq.count[item].astype(jnp.float32)
+    elig_f = eligible[item] & applicable
+    key = ph * jnp.int32(KP + 1) + slots_f
+    (rank,) = grouped_exclusive_cumsum(key, [cnt], elig_f)
+    blocked_f = applicable & (est + rank + cnt > thr)
+    blocked = (blocked_f & elig_f).reshape(b, KP).any(axis=1)
+    return blocked, cms, cms_epochs, cur_idx, slots_f, applicable
+
+
+def _sync_warmup(
+    cfg: EngineConfig, state: EngineState, rules: RuleSet, now_ms
+) -> EngineState:
+    """Per-second warm-up token refill, vectorized over all flow rules
+    (WarmUpController.syncToken/coolDownTokens)."""
+    f = rules.flow
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    cur_s = (now_ms // 1000).astype(jnp.int32)
+    is_warm = (
+        (f.behavior == CONTROL_WARM_UP) | (f.behavior == CONTROL_WARM_UP_RATE_LIMITER)
+    ) & f.enabled
+    elapsed = cur_s - state.warmup_last_s
+    first = state.warmup_last_s < 0
+    do_sync = is_warm & ((elapsed > 0) | first)
+
+    node = f.res  # warm-up rules meter their resource's cluster node
+    pass_qps = W.gather_window_event(state.win_sec, now_ms, node, sec_cfg, W.EV_PASS)
+    pass_qps = pass_qps.astype(jnp.float32)
+
+    tokens = state.warmup_tokens
+    refill_ok = (tokens < f.warning_token) | (
+        pass_qps < f.count / jnp.maximum(f.cold_factor, 1.0)
+    )
+    dt = jnp.where(first, 1.0, jnp.minimum(elapsed.astype(jnp.float32), 1.0e6))
+    grown = jnp.minimum(tokens + dt * f.count, f.max_token)
+    new_tokens = jnp.where(refill_ok, grown, tokens)
+    # start cold: on first sync fill to max (cold system has full bucket)
+    new_tokens = jnp.where(first & is_warm, f.max_token, new_tokens)
+    new_tokens = jnp.maximum(new_tokens - pass_qps, 0.0)
+
+    tokens = jnp.where(do_sync, new_tokens, tokens)
+    last_s = jnp.where(do_sync, cur_s, state.warmup_last_s)
+    return state._replace(warmup_tokens=tokens, warmup_last_s=last_s)
+
+
+def _check_flow(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    now_ms,
+    eligible,
+):
+    """FlowSlot: per-resource QPS/thread limiting with the three traffic
+    shapers (FlowRuleChecker.java:42-176, Default/RateLimiter/WarmUp
+    controllers).  Returns (blocked[B], wait_ms[B], latest_passed_update)."""
+    K = cfg.flow_rules_per_resource
+    b = acq.res.shape[0]
+    f = rules.flow
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+
+    res_l = jnp.minimum(acq.res, cfg.max_resources)
+    slots = f.res_rules[res_l]  # [B, K]
+    slots_f = slots.reshape(-1)  # [N]
+    item = jnp.repeat(jnp.arange(b), K)
+
+    enabled = f.enabled[slots_f]
+    la = f.limit_app[slots_f]
+    origin = acq.origin_id[item]
+    la_all = f.limit_app[slots]  # [B, K]
+    named = ((la_all >= 0) & (la_all == acq.origin_id[:, None])).any(axis=1)  # [B]
+    match = (
+        (la == RT.LIMIT_ANY)
+        | ((la >= 0) & (la == origin))
+        | ((la == RT.LIMIT_OTHER) & (origin >= 0) & ~named[item])
+    )
+    applicable = enabled & match
+
+    # --- node selection (FlowRuleChecker.selectNodeByRequesterAndStrategy:115)
+    strategy = f.strategy[slots_f]
+    direct_node = jnp.where(la == RT.LIMIT_ANY, acq.res[item], acq.origin_node[item])
+    relate_node = f.ref_node[slots_f]
+    chain_ok = (f.ref_ctx[slots_f] >= 0) & (f.ref_ctx[slots_f] == acq.ctx_name[item])
+    chain_node = jnp.where(chain_ok, acq.ctx_node[item], -1)
+    node = jnp.where(
+        strategy == STRATEGY_DIRECT,
+        direct_node,
+        jnp.where(strategy == STRATEGY_RELATE, relate_node, chain_node),
+    )
+    node_ok = (node >= 0) & (node != cfg.trash_row)
+    applicable = applicable & node_ok
+    node_safe = jnp.where(node_ok, node, cfg.trash_row)
+
+    grade = f.grade[slots_f]
+    rcount = f.count[slots_f]
+    behavior = jnp.where(grade == GRADE_QPS, f.behavior[slots_f], CONTROL_DEFAULT)
+    cnt = acq.count[item].astype(jnp.float32)
+
+    # --- per-entry warm-up threshold (WarmUpController.canPass)
+    rest = state.warmup_tokens[slots_f]
+    warning = f.warning_token[slots_f]
+    above = jnp.maximum(rest - warning, 0.0)
+    warm_qps = jnp.floor(
+        1.0 / (above * f.slope[slots_f] + 1.0 / jnp.maximum(rcount, 1e-9)) + 0.5
+    )
+    warm_qps = jnp.where(rest >= warning, warm_qps, rcount)
+
+    is_warm = (behavior == CONTROL_WARM_UP) | (behavior == CONTROL_WARM_UP_RATE_LIMITER)
+    is_rl = (behavior == CONTROL_RATE_LIMITER) | (
+        behavior == CONTROL_WARM_UP_RATE_LIMITER
+    )
+    # pacing rate: plain RL paces at rule count, warm-up RL paces at the
+    # current warm-up threshold (WarmUpRateLimiterController)
+    pace_qps = jnp.where(
+        behavior == CONTROL_WARM_UP_RATE_LIMITER, warm_qps, jnp.maximum(rcount, 1e-9)
+    )
+    cost = jnp.where(is_rl, jnp.floor(1000.0 * cnt / pace_qps + 0.5), 0.0)
+
+    # --- within-tick ranks (key: decision node; RL keys by rule slot)
+    key = jnp.where(is_rl, jnp.int32(cfg.node_rows) + slots_f, node_safe)
+    elig_f = eligible[item] & applicable
+    rank_tok, rank_thr, rank_cost = grouped_exclusive_cumsum(
+        key, [cnt, jnp.ones_like(cnt), cost], elig_f
+    )
+
+    wp = W.gather_window_event(state.win_sec, now_ms, node_safe, sec_cfg, W.EV_PASS)
+    wp = wp.astype(jnp.float32)
+    conc = state.concurrency[node_safe].astype(jnp.float32)
+
+    # DefaultController.canPass:31-49
+    thr_eff = jnp.where(is_warm, warm_qps, rcount)
+    qps_block = wp + rank_tok + cnt > thr_eff
+    thread_block = conc + rank_thr + cnt > rcount
+    basic_block = jnp.where(grade == GRADE_QPS, qps_block, thread_block)
+
+    # RateLimiterController.canPass:50-105 (exact batched leaky bucket)
+    now_f = now_ms.astype(jnp.float32)
+    l0 = state.latest_passed_ms[slots_f]
+    csum_incl = rank_cost + cost
+    expected = jnp.maximum(l0 + csum_incl, now_f + csum_incl - cost)
+    wait = expected - now_f
+    rl_block = wait > f.max_queue_ms[slots_f].astype(jnp.float32)
+
+    entry_block = jnp.where(is_rl, rl_block, basic_block) & applicable
+    # warm-up RL blocks on either the pace or the warm-up threshold
+    entry_block = entry_block | (
+        (behavior == CONTROL_WARM_UP_RATE_LIMITER) & applicable & qps_block
+    )
+
+    blocked = (entry_block & elig_f).reshape(b, K).any(axis=1)
+
+    # pacing delay for admitted rate-limited entries
+    rl_ok = is_rl & applicable & ~entry_block & elig_f & ~blocked[item]
+    wait_ms_entry = jnp.where(rl_ok, jnp.maximum(wait, 0.0), 0.0)
+    wait_ms = jnp.max(wait_ms_entry.reshape(b, K), axis=1)
+
+    # advance latestPassedTime for admitted entries (even if a later slot
+    # blocks the request, matching the reference's side-effect order)
+    latest = state.latest_passed_ms.at[
+        jnp.where(rl_ok, slots_f, cfg.max_flow_rules)
+    ].max(jnp.where(rl_ok, expected, -1.0e9), mode="drop")
+
+    return blocked, wait_ms.astype(jnp.int32), latest
+
+
+def _check_degrade(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    now_ms,
+    eligible,
+):
+    """DegradeSlot entry: CB gate + half-open probe election
+    (DegradeSlot.java:37-53, AbstractCircuitBreaker.tryPass).
+    Returns (blocked[B], new_cb_state)."""
+    KD = cfg.degrade_rules_per_resource
+    b = acq.res.shape[0]
+    res_l = jnp.minimum(acq.res, cfg.max_resources)
+    slots = rules.degrade.res_cbs[res_l]  # [B, KD]
+    slots_f = slots.reshape(-1)
+    item = jnp.repeat(jnp.arange(b), KD)
+    enabled = rules.degrade.enabled[slots_f]
+
+    st = state.cb_state[slots_f]
+    retry_due = now_ms >= state.cb_retry_ms[slots_f]
+    open_wait = (st == D.CB_OPEN) & ~retry_due
+    open_due = (st == D.CB_OPEN) & retry_due
+    half = st == D.CB_HALF_OPEN
+
+    probe_cand = open_due & enabled & eligible[item]
+    probe = grouped_first(slots_f, probe_cand)  # one probe per rule
+
+    entry_block = enabled & (open_wait | (open_due & ~probe) | half)
+    blocked = (entry_block & eligible[item]).reshape(b, KD).any(axis=1)
+
+    # elected probes flip their breaker OPEN → HALF_OPEN; a probe whose item
+    # is blocked by another CB on the same resource must not flip
+    probe_ok = probe & ~blocked[item]
+    Dn1 = cfg.max_degrade_rules + 1
+    flip = (
+        jnp.zeros((Dn1,), jnp.int32)
+        .at[jnp.minimum(slots_f, cfg.max_degrade_rules)]
+        .max(probe_ok.astype(jnp.int32))
+    )
+    cb_state = jnp.where(
+        (flip > 0) & (state.cb_state == D.CB_OPEN), D.CB_HALF_OPEN, state.cb_state
+    )
+    return blocked, cb_state
+
+
+# ---------------------------------------------------------------------------
+
+
+def tick(
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    comp: CompleteBatch,
+    now_ms: jax.Array,  # int32 scalar, engine epoch ms
+    sys_load: jax.Array,  # float32 scalar — host-sampled load average
+    sys_cpu: jax.Array,  # float32 scalar — host-sampled CPU usage [0,1]
+    cfg: EngineConfig,
+) -> Tuple[EngineState, TickOutput]:
+    """One engine tick: completions, then batched decisions, then effects."""
+    b = acq.res.shape[0]
+    now_ms = now_ms.astype(jnp.int32)
+
+    # 1. exits first: they release concurrency and update breakers
+    state = _process_completions(cfg, state, rules, comp, now_ms)
+
+    # 2. warm-up token sync (per second, vectorized over rules)
+    state = _sync_warmup(cfg, state, rules, now_ms)
+
+    valid = acq.res != cfg.trash_row
+
+    # 3. rule checks in reference slot order; each stage's blocks remove
+    #    the item from later stages' rank accounting
+    auth_block = _check_authority(cfg, rules, acq) & valid
+    eligible = valid & ~auth_block
+
+    sys_block = _check_system(
+        cfg, state, rules, acq, now_ms, sys_load, sys_cpu, eligible
+    )
+    eligible = eligible & ~sys_block
+
+    param_block, cms, cms_epochs, cms_idx, pslots_f, p_applicable = _check_param(
+        cfg, state, rules, acq, now_ms, eligible
+    )
+    param_block = param_block & eligible
+    eligible = eligible & ~param_block
+
+    flow_block, wait_ms, latest_passed = _check_flow(
+        cfg, state, rules, acq, now_ms, eligible
+    )
+    flow_block = flow_block & eligible
+    eligible = eligible & ~flow_block
+    state = state._replace(latest_passed_ms=latest_passed)
+
+    degrade_block, cb_state = _check_degrade(cfg, state, rules, acq, now_ms, eligible)
+    degrade_block = degrade_block & eligible
+    state = state._replace(cb_state=cb_state)
+
+    passed = valid & ~(auth_block | sys_block | param_block | flow_block | degrade_block)
+
+    verdict = jnp.full((b,), PASS, dtype=jnp.int8)
+    verdict = jnp.where(auth_block, jnp.int8(BLOCK_AUTHORITY), verdict)
+    verdict = jnp.where(sys_block, jnp.int8(BLOCK_SYSTEM), verdict)
+    verdict = jnp.where(param_block, jnp.int8(BLOCK_PARAM), verdict)
+    verdict = jnp.where(flow_block, jnp.int8(BLOCK_FLOW), verdict)
+    verdict = jnp.where(degrade_block, jnp.int8(BLOCK_DEGRADE), verdict)
+    verdict = jnp.where(passed & (wait_ms > 0), jnp.int8(PASS_WAIT), verdict)
+    wait_ms = jnp.where(passed, wait_ms, 0)
+
+    # 4. effects: pass/block statistics (StatisticSlot.java:54-123)
+    rows4 = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, acq.inbound)
+    deltas1 = jnp.zeros((b, W.NUM_EVENTS), dtype=jnp.int32)
+    deltas1 = deltas1.at[:, W.EV_PASS].set(jnp.where(passed, acq.count, 0))
+    deltas1 = deltas1.at[:, W.EV_BLOCK].set(jnp.where(valid & ~passed, acq.count, 0))
+    deltas4 = jnp.tile(deltas1, (4, 1))
+    state = _scatter_events(cfg, state, now_ms, rows4, deltas4, None)
+
+    inc = jnp.tile(jnp.where(passed, acq.count, 0), (4,))
+    concurrency = state.concurrency.at[rows4].add(inc, mode="drop")
+    state = state._replace(concurrency=concurrency)
+
+    # param pass counting into the sketch (only admitted traffic consumes
+    # the per-value budget, like the token bucket decrement in
+    # ParamFlowChecker.passDefaultLocalCheck)
+    KP = cfg.param_rules_per_resource
+    item_p = jnp.repeat(jnp.arange(b), KP)
+    p_add = p_applicable & passed[item_p]
+    cms = P.add(
+        cms,
+        cms_epochs,
+        cms_idx,
+        jnp.where(p_add, pslots_f, cfg.max_param_rules),
+        acq.param_hash[item_p],
+        jnp.where(p_add, acq.count[item_p], 0),
+        cfg.max_param_rules,
+    )
+    state = state._replace(cms=cms, cms_epochs=cms_epochs)
+
+    return state, TickOutput(verdict=verdict, wait_ms=wait_ms)
+
+
+def compile_ruleset(
+    cfg: EngineConfig,
+    registry,
+    flow_rules=(),
+    degrade_rules=(),
+    param_rules=(),
+    authority_rules=(),
+    system_rules=(),
+) -> RuleSet:
+    """Host-side: compile rule objects into a device-resident RuleSet."""
+    rs = RuleSet(
+        flow=RT.compile_flow_rules(list(flow_rules), cfg, registry),
+        degrade=RT.compile_degrade_rules(list(degrade_rules), cfg, registry),
+        param=RT.compile_param_rules(list(param_rules), cfg, registry),
+        auth=RT.compile_authority_rules(list(authority_rules), cfg, registry),
+        system=RT.compile_system_rules(list(system_rules), cfg),
+    )
+    return jax.device_put(rs)
+
+
+_TICK_CACHE: dict = {}
+
+
+def make_tick(cfg: EngineConfig, donate: bool = True, jit: bool = True):
+    """Build the compiled tick for a given engine config.
+
+    Cached per (cfg, donate) — EngineConfig is frozen/hashable — so multiple
+    clients with the same config share one compiled executable (compile is
+    the expensive part, especially on the first call).
+    """
+    key = (cfg, donate, jit)
+    fn = _TICK_CACHE.get(key)
+    if fn is None:
+        fn = functools.partial(tick, cfg=cfg)
+        if jit:
+            fn = jax.jit(fn, donate_argnums=(0,) if donate else ())
+        _TICK_CACHE[key] = fn
+    return fn

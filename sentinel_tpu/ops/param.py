@@ -1,0 +1,108 @@
+"""Hot-parameter statistics: windowed count-min sketch.
+
+The reference tracks per-parameter-value token buckets in LRU CacheMaps
+capped at 4000×duration / 200k keys per rule (ParameterMetric.java:35-118).
+That design — pointer-chasing hash maps with per-key CAS — cannot batch.
+Here each param rule owns a time-bucketed count-min sketch:
+
+    cms    : int32 [P+1, nb, depth, width]
+    epochs : int32 [P+1, nb]
+
+Passes scatter-add into the current time bucket of the rule's sketch (one
+cell per depth row); the windowed estimate is  sum over valid time buckets
+of  min over depth.  Overestimation is bounded by the classic CMS (eps =
+e/width, delta = e^-depth) bound, so enforcement at threshold T admits at
+most T and may over-block by ~eps * window-mass — the conservative
+direction for a rate limiter.  (SALSA-style exact slots for hot keys are a
+planned refinement, see PAPERS.md.)
+
+Bucket rotation follows the same epoch scheme as ops/window.py, but with a
+PER-RULE bucket length (rules have independent durationInSec).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# depth-row hash multipliers (odd constants, splitmix-ish)
+_MULTS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F, 0x165667B1, 0x9E3779B9)
+
+
+def cms_cell(h: jax.Array, depth: int, width: int) -> jax.Array:
+    """int32 [N, depth] — column index per depth row for hashes h [N]."""
+    hu = h.astype(jnp.uint32)
+    cols = []
+    for d in range(depth):
+        x = hu * jnp.uint32(_MULTS[d % len(_MULTS)]) + jnp.uint32(
+            (d * 0x7F4A7C15) & 0xFFFFFFFF
+        )
+        x = x ^ (x >> 15)
+        x = x * jnp.uint32(0x2C1B3C6D)
+        x = x ^ (x >> 12)
+        cols.append((x % jnp.uint32(width)).astype(jnp.int32))
+    return jnp.stack(cols, axis=-1)
+
+
+def refresh_columns(
+    cms: jax.Array,  # int32 [P+1, nb, depth, width]
+    epochs: jax.Array,  # int32 [P+1, nb]
+    window_ms: jax.Array,  # int32 [P+1] per-rule bucket length
+    now_ms: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Zero each rule's current time bucket if it holds an old epoch.
+
+    Returns (cms, epochs, cur_idx[P+1]).
+    """
+    nb = cms.shape[1]
+    wid = (now_ms // jnp.maximum(window_ms, 1)).astype(jnp.int32)  # [P+1]
+    idx = wid % nb
+    onehot = jax.nn.one_hot(idx, nb, dtype=jnp.int32)  # [P+1, nb]
+    stale = (jnp.take_along_axis(epochs, idx[:, None], axis=1)[:, 0] != wid).astype(
+        jnp.int32
+    )
+    keep = 1 - onehot * stale[:, None]  # [P+1, nb] — 0 where a stale current bucket
+    cms = cms * keep[:, :, None, None]
+    epochs = jnp.where((onehot == 1) & (stale[:, None] == 1), wid[:, None], epochs)
+    return cms, epochs, idx
+
+
+def estimate(
+    cms: jax.Array,  # int32 [P+1, nb, depth, width]
+    epochs: jax.Array,  # int32 [P+1, nb]
+    window_ms: jax.Array,  # int32 [P+1]
+    slots: jax.Array,  # int32 [N] rule slot per query
+    hashes: jax.Array,  # int32 [N]
+    now_ms: jax.Array,
+) -> jax.Array:
+    """float32 [N] — windowed CMS estimate for (rule, value) pairs."""
+    nb, depth, width = cms.shape[1], cms.shape[2], cms.shape[3]
+    cols = cms_cell(hashes, depth, width)  # [N, depth]
+    # gather [N, nb, depth]
+    vals = cms[slots[:, None, None], jnp.arange(nb)[None, :, None], jnp.arange(depth)[None, None, :], cols[:, None, :]]
+    per_bucket = jnp.min(vals, axis=2)  # [N, nb] min over depth
+    wid = (now_ms // jnp.maximum(window_ms[slots], 1)).astype(jnp.int32)  # [N]
+    valid = (epochs[slots] > (wid[:, None] - nb)) & (epochs[slots] <= wid[:, None])
+    return jnp.sum(jnp.where(valid, per_bucket, 0), axis=1).astype(jnp.float32)
+
+
+def add(
+    cms: jax.Array,
+    epochs: jax.Array,  # already refreshed this tick
+    cur_idx: jax.Array,  # int32 [P+1] current bucket per rule
+    slots: jax.Array,  # int32 [N] (trash slot P for no-op)
+    hashes: jax.Array,  # int32 [N]
+    counts: jax.Array,  # int32 [N] (0 for no-op)
+    trash_slot: int,
+) -> jax.Array:
+    """Scatter-add counts into each rule's current time bucket."""
+    depth, width = cms.shape[2], cms.shape[3]
+    cols = cms_cell(hashes, depth, width)  # [N, depth]
+    bidx = cur_idx[slots]  # [N]
+    safe_slots = jnp.minimum(slots, trash_slot)
+    d_idx = jnp.broadcast_to(jnp.arange(depth)[None, :], cols.shape)
+    return cms.at[
+        safe_slots[:, None], bidx[:, None], d_idx, cols
+    ].add(counts[:, None], mode="drop")

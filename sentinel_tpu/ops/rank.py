@@ -1,0 +1,73 @@
+"""Within-tick sequencing: grouped exclusive prefix sums.
+
+The reference sequences concurrent requests through CAS loops
+(LeapArray.currentWindow, RateLimiterController.latestPassedTime CAS,
+DefaultController.tryOccupyNext).  In a micro-batched tick there is no CAS:
+requests for the same decision node must be *ranked* — request i's check
+sees the tokens consumed by requests 0..i-1 of the same group in this batch.
+
+Given group keys, per-item values and an eligibility mask, this module
+computes, for every item, the sum of values of eligible items that appear
+EARLIER in the batch with the SAME key — a grouped exclusive cumsum,
+implemented as stable-sort + segmented scan (O(B log B), no B×B mask).
+
+With a per-node quota q, admitting exactly the items whose exclusive rank
+plus own cost fits below q reproduces sequential first-come-first-served
+admission exactly (items rejected by the node check itself never consume
+quota, because their rank already exceeds q — see DefaultController.java:31).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG = jnp.float32(-3.0e38)
+
+
+def grouped_exclusive_cumsum(
+    keys: jax.Array,  # int32 [N] group key per item
+    values: Sequence[jax.Array],  # each float32/int32 [N]
+    eligible: jax.Array,  # bool [N] — ineligible items contribute 0 and read their own rank anyway
+) -> Tuple[jax.Array, ...]:
+    """For each item: sum over eligible earlier same-key items, per value array.
+
+    "Earlier" means smaller batch index (arrival order) — the sort is stable,
+    so within a key group the original order is preserved.
+    """
+    n = keys.shape[0]
+    order = jnp.argsort(keys, stable=True)
+    inv = jnp.argsort(order, stable=True)  # position of item i in sorted order
+    ks = keys[order]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), ks[1:] != ks[:-1]]
+    )  # [N]
+
+    outs = []
+    for v in values:
+        vs = jnp.where(eligible[order], v[order].astype(jnp.float32), 0.0)
+        csum_excl = jnp.cumsum(vs) - vs
+        # propagate each segment's starting csum to all its members
+        base = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(seg_start, csum_excl, _NEG)
+        )
+        rank_sorted = csum_excl - base
+        outs.append(rank_sorted[inv])
+    return tuple(outs)
+
+
+def grouped_first(
+    keys: jax.Array, eligible: jax.Array
+) -> jax.Array:
+    """bool [N]: True for the first eligible item of each key group.
+
+    Used to elect a single half-open probe per circuit breaker
+    (AbstractCircuitBreaker.java:68-127 lets exactly one request through on
+    the OPEN->HALF_OPEN transition).
+    """
+    (rank,) = grouped_exclusive_cumsum(
+        keys, [jnp.ones_like(keys, dtype=jnp.float32)], eligible
+    )
+    return eligible & (rank < 0.5)

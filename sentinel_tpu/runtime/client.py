@@ -1,0 +1,535 @@
+"""The host runtime: micro-batching client around the device engine.
+
+This layer replaces the reference's per-request machinery (CtSph.java:43,
+CtEntry, the slot-chain walk) with an accumulate→tick→fan-out loop:
+
+  entry("res")  ──► AcquireRequest + Future ──┐
+  entry.exit()  ──► completion record ────────┤  pending queues
+                                              ▼
+                         tick thread (every ~tick_interval_ms, or manual):
+                           drain queues → fixed-shape batches → jitted
+                           engine tick → resolve futures with verdicts
+
+Modes:
+  * ``sync``    — every entry() runs a tick inline (batch of whatever is
+                  queued).  Deterministic; pairs with VirtualTimeSource for
+                  tests (the AbstractTimeBasedTest analog, SURVEY.md §4.1).
+  * ``threaded``— a daemon tick loop services futures; entry() blocks.
+                  This is the serving configuration.
+
+Bulk path: ``check_batch`` submits N acquires in one call and ticks once —
+the native TPU API used by the cluster token server and the benchmark.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.core import rules as R
+from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.core.rule_tensors import hash_param
+from sentinel_tpu.ops import engine as E
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.runtime import context as CTX
+from sentinel_tpu.runtime.registry import Registry
+from sentinel_tpu.utils.system_status import SystemStatusSampler
+from sentinel_tpu.utils.time_source import TimeSource, VirtualTimeSource
+
+
+@dataclass
+class AcquireRequest:
+    res: int
+    count: int
+    prio: int
+    origin_id: int
+    origin_node: int
+    ctx_node: int
+    ctx_name: int
+    inbound: int
+    param_hash: int
+    future: Optional[Future] = None
+
+
+@dataclass
+class Completion:
+    res: int
+    origin_node: int
+    ctx_node: int
+    inbound: int
+    rt: float
+    success: int
+    error: int
+
+
+class Entry:
+    """Live entry handle (the reference's Entry/CtEntry).
+
+    ``exit()`` records RT + success; ``trace(exc)`` marks a business
+    exception for exception-ratio circuit breakers (Tracer.java).
+    """
+
+    __slots__ = (
+        "client",
+        "resource",
+        "res",
+        "origin_node",
+        "ctx_node",
+        "inbound",
+        "count",
+        "create_ms",
+        "wait_ms",
+        "_errors",
+        "_exited",
+    )
+
+    def __init__(self, client, resource, res, origin_node, ctx_node, inbound, count, create_ms, wait_ms=0):
+        self.client = client
+        self.resource = resource
+        self.res = res
+        self.origin_node = origin_node
+        self.ctx_node = ctx_node
+        self.inbound = inbound
+        self.count = count
+        self.create_ms = create_ms
+        self.wait_ms = wait_ms
+        self._errors = 0
+        self._exited = False
+
+    def trace(self, exc: Optional[BaseException] = None, count: int = 1) -> None:
+        if exc is not None and isinstance(exc, ERR.BlockException):
+            return  # block exceptions are not business errors (Tracer semantics)
+        self._errors += count
+
+    def exit(self, count: Optional[int] = None) -> None:
+        if self._exited:
+            return
+        self._exited = True
+        CTX.pop_entry(self)
+        if self.res is None:
+            return  # pass-through entry (capacity overflow)
+        now = self.client.time.now_ms()
+        rt = float(max(now - self.create_ms, 0))
+        self.client._submit_completion(
+            Completion(
+                res=self.res,
+                origin_node=self.origin_node,
+                ctx_node=self.ctx_node,
+                inbound=self.inbound,
+                rt=rt,
+                success=count if count is not None else self.count,
+                error=self._errors,
+            )
+        )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            self.trace(exc)
+        self.exit()
+        return False
+
+
+class _PassThroughEntry(Entry):
+    def __init__(self, client, resource):
+        super().__init__(client, resource, None, 0, 0, 0, 1, 0)
+
+
+class RuleManager:
+    """Typed rule holder with push-style listeners.
+
+    The analog of FlowRuleManager/DegradeRuleManager/...: ``load`` replaces
+    the full rule set and triggers engine recompilation
+    (FlowRuleManager.loadRules → property.updateValue → listener).
+    """
+
+    def __init__(self, client: "SentinelClient", kind: str):
+        self._client = client
+        self.kind = kind
+        self._rules: list = []
+        self._listeners: list = []
+
+    def load(self, rules: Sequence) -> None:
+        self._rules = list(rules)
+        self._client._recompile_rules()
+        for fn in list(self._listeners):
+            fn(self._rules)
+
+    def get(self) -> list:
+        return list(self._rules)
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+
+class SentinelClient:
+    def __init__(
+        self,
+        app_name: Optional[str] = None,
+        cfg: Optional[EngineConfig] = None,
+        time_source: Optional[TimeSource] = None,
+        mode: str = "threaded",  # "threaded" | "sync"
+        tick_interval_ms: float = 1.0,
+        entry_timeout_s: float = 5.0,
+    ):
+        from sentinel_tpu.core.config import app_name as cfg_app_name
+
+        self.app_name = app_name or cfg_app_name()
+        self.cfg = cfg or EngineConfig()
+        self.time = time_source or TimeSource()
+        self.mode = mode if not isinstance(self.time, VirtualTimeSource) else "sync"
+        self.tick_interval_ms = tick_interval_ms
+        self.entry_timeout_s = entry_timeout_s
+
+        self.registry = Registry(self.cfg)
+        self.flow_rules = RuleManager(self, "flow")
+        self.degrade_rules = RuleManager(self, "degrade")
+        self.system_rules = RuleManager(self, "system")
+        self.authority_rules = RuleManager(self, "authority")
+        self.param_flow_rules = RuleManager(self, "param-flow")
+
+        self._sys = SystemStatusSampler()
+        self._tick = E.make_tick(self.cfg, donate=True)
+        self._state = E.init_state(self.cfg)
+        self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
+        self._rules_dirty = False
+
+        self._lock = threading.Lock()  # guards queues
+        self._engine_lock = threading.Lock()  # guards state/tick execution
+        self._acquires: List[AcquireRequest] = []
+        self._completions: List[Completion] = []
+
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._started = False
+        self.stats = ClientStats(self)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        if self.mode == "threaded":
+            self._thread = threading.Thread(
+                target=self._tick_loop, name="sentinel-tpu-tick", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self._started = False
+
+    # -- rule compilation ---------------------------------------------------
+
+    def _recompile_rules(self) -> None:
+        with self._engine_lock:
+            self._rules_dev = E.compile_ruleset(
+                self.cfg,
+                self.registry,
+                flow_rules=self.flow_rules.get(),
+                degrade_rules=self.degrade_rules.get(),
+                param_rules=self.param_flow_rules.get(),
+                authority_rules=self.authority_rules.get(),
+                system_rules=self.system_rules.get(),
+            )
+
+    # -- public entry API ---------------------------------------------------
+
+    def entry(
+        self,
+        resource: str,
+        count: int = 1,
+        prioritized: bool = False,
+        args: Optional[Sequence[Any]] = None,
+        inbound: bool = False,
+        origin: Optional[str] = None,
+    ) -> Entry:
+        """Acquire; raises BlockException on rejection (SphU.entry)."""
+        ctx_name, ctx_origin = CTX.current()
+        origin = origin if origin is not None else ctx_origin
+        rid = self.registry.resource_id(resource)
+        if rid is None:
+            e = _PassThroughEntry(self, resource)
+            CTX.push_entry(e)
+            return e  # capacity overflow → pass-through (CtSph.java:200)
+
+        origin_id = self.registry.origin_id(origin) if origin else -1
+        origin_node = (
+            self.registry.origin_node_row(resource, origin)
+            if origin
+            else self.cfg.trash_row
+        )
+        if ctx_name != CTX.DEFAULT_CONTEXT_NAME:
+            ctx_node = self.registry.ctx_node_row(resource, ctx_name)
+            ctx_id = self.registry.context_id(ctx_name)
+        else:
+            ctx_node = self.cfg.trash_row
+            ctx_id = -1
+
+        param_hash = 0
+        if args:
+            # hot-param limiting keys off the configured param index; host
+            # hashes the first arg by convention, adapters pass the right one
+            param_hash = hash_param(args[0])
+
+        req = AcquireRequest(
+            res=rid,
+            count=count,
+            prio=1 if prioritized else 0,
+            origin_id=origin_id,
+            origin_node=origin_node,
+            ctx_node=ctx_node,
+            ctx_name=ctx_id,
+            inbound=1 if inbound else 0,
+            param_hash=param_hash,
+            future=Future(),
+        )
+        with self._lock:
+            self._acquires.append(req)
+
+        if self.mode == "sync":
+            self.tick_once()
+        verdict, wait_ms = req.future.result(timeout=self.entry_timeout_s)
+
+        if verdict not in (ERR.PASS, ERR.PASS_WAIT):
+            # record nothing extra here: the engine already counted the block
+            ERR.raise_for_verdict(verdict, resource)
+        if verdict == ERR.PASS_WAIT and wait_ms > 0:
+            self.time.sleep_ms(wait_ms)
+
+        e = Entry(
+            self,
+            resource,
+            rid,
+            origin_node,
+            ctx_node,
+            1 if inbound else 0,
+            count,
+            self.time.now_ms(),
+            wait_ms,
+        )
+        CTX.push_entry(e)
+        return e
+
+    def try_entry(self, resource: str, **kw) -> Optional[Entry]:
+        """SphO-style boolean variant."""
+        try:
+            return self.entry(resource, **kw)
+        except ERR.BlockException:
+            return None
+
+    def trace(self, exc: BaseException, count: int = 1) -> None:
+        e = CTX.current_entry()
+        if e is not None:
+            e.trace(exc, count)
+
+    def enter_context(self, name: str, origin: str = ""):
+        return CTX.enter(name, origin)
+
+    def exit_context(self, token) -> None:
+        CTX.exit_ctx(token)
+
+    # -- bulk API -----------------------------------------------------------
+
+    def check_batch(
+        self,
+        resources: Sequence[str],
+        counts: Optional[Sequence[int]] = None,
+        origins: Optional[Sequence[str]] = None,
+        params: Optional[Sequence[Any]] = None,
+        inbound: bool = False,
+    ) -> List[Tuple[int, int]]:
+        """Vector acquire: returns [(verdict, wait_ms)] per resource.
+
+        This is the TPU-native surface: N decisions in one tick.
+        """
+        futures = []
+        with self._lock:
+            for i, name in enumerate(resources):
+                rid = self.registry.resource_id(name)
+                if rid is None:
+                    futures.append(None)
+                    continue
+                origin = origins[i] if origins else ""
+                req = AcquireRequest(
+                    res=rid,
+                    count=counts[i] if counts else 1,
+                    prio=0,
+                    origin_id=self.registry.origin_id(origin) if origin else -1,
+                    origin_node=self.registry.origin_node_row(name, origin)
+                    if origin
+                    else self.cfg.trash_row,
+                    ctx_node=self.cfg.trash_row,
+                    ctx_name=-1,
+                    inbound=1 if inbound else 0,
+                    param_hash=hash_param(params[i]) if params else 0,
+                    future=Future(),
+                )
+                self._acquires.append(req)
+                futures.append(req.future)
+        if self.mode == "sync":
+            self.tick_once()
+        out = []
+        for f in futures:
+            if f is None:
+                out.append((ERR.PASS, 0))
+            else:
+                out.append(f.result(timeout=self.entry_timeout_s))
+        return out
+
+    def _submit_completion(self, c: Completion) -> None:
+        with self._lock:
+            self._completions.append(c)
+        if self.mode == "sync":
+            self.tick_once()
+
+    # -- tick machinery -----------------------------------------------------
+
+    def _tick_loop(self) -> None:
+        interval = self.tick_interval_ms / 1000.0
+        while not self._stop_evt.is_set():
+            t0 = _time.monotonic()
+            try:
+                self.tick_once()
+            except Exception:  # pragma: no cover - keep the loop alive
+                import traceback
+
+                traceback.print_exc()
+            dt = _time.monotonic() - t0
+            if dt < interval:
+                self._stop_evt.wait(interval - dt)
+
+    def tick_once(self, now_ms: Optional[int] = None) -> None:
+        """Drain queues and run engine ticks until empty."""
+        while True:
+            with self._lock:
+                acq = self._acquires[: self.cfg.batch_size]
+                self._acquires = self._acquires[self.cfg.batch_size :]
+                comp = self._completions[: self.cfg.complete_batch_size]
+                self._completions = self._completions[self.cfg.complete_batch_size :]
+            if not acq and not comp and now_ms is None:
+                return
+            self._run_tick(acq, comp, now_ms)
+            with self._lock:
+                more = bool(self._acquires) or bool(self._completions)
+            if not more:
+                return
+            now_ms = None  # subsequent drain loops use fresh time
+
+    def _run_tick(
+        self,
+        acq: List[AcquireRequest],
+        comp: List[Completion],
+        now_ms: Optional[int],
+    ) -> None:
+        cfg = self.cfg
+        B, B2 = cfg.batch_size, cfg.complete_batch_size
+        trash = cfg.trash_row
+
+        a = E.empty_acquire(cfg)
+        if acq:
+            n = len(acq)
+            arr = lambda f, fill, dt: np.asarray(
+                [getattr(r, f) for r in acq] + [fill] * (B - n), dtype=dt
+            )
+            a = E.AcquireBatch(
+                res=jnp.asarray(arr("res", trash, np.int32)),
+                count=jnp.asarray(arr("count", 0, np.int32)),
+                prio=jnp.asarray(arr("prio", 0, np.int32)),
+                origin_id=jnp.asarray(arr("origin_id", -1, np.int32)),
+                origin_node=jnp.asarray(arr("origin_node", trash, np.int32)),
+                ctx_node=jnp.asarray(arr("ctx_node", trash, np.int32)),
+                ctx_name=jnp.asarray(arr("ctx_name", -1, np.int32)),
+                inbound=jnp.asarray(arr("inbound", 0, np.int32)),
+                param_hash=jnp.asarray(arr("param_hash", 0, np.int32)),
+            )
+        c = E.empty_complete(cfg)
+        if comp:
+            n = len(comp)
+            arr = lambda f, fill, dt: np.asarray(
+                [getattr(r, f) for r in comp] + [fill] * (B2 - n), dtype=dt
+            )
+            c = E.CompleteBatch(
+                res=jnp.asarray(arr("res", trash, np.int32)),
+                origin_node=jnp.asarray(arr("origin_node", trash, np.int32)),
+                ctx_node=jnp.asarray(arr("ctx_node", trash, np.int32)),
+                inbound=jnp.asarray(arr("inbound", 0, np.int32)),
+                rt=jnp.asarray(arr("rt", 0.0, np.float32)),
+                success=jnp.asarray(arr("success", 0, np.int32)),
+                error=jnp.asarray(arr("error", 0, np.int32)),
+            )
+
+        load, cpu = self._sys.sample()
+        t = now_ms if now_ms is not None else self.time.now_ms()
+        with self._engine_lock:
+            self._state, out = self._tick(
+                self._state,
+                self._rules_dev,
+                a,
+                c,
+                jnp.int32(t),
+                jnp.float32(load),
+                jnp.float32(cpu),
+            )
+            verdict = np.asarray(out.verdict)
+            wait = np.asarray(out.wait_ms)
+        for i, r in enumerate(acq):
+            if r.future is not None:
+                r.future.set_result((int(verdict[i]), int(wait[i])))
+
+
+class ClientStats:
+    """Read-side node statistics (the ClusterNode/StatisticNode getters:
+    passQps/blockQps/successQps/exceptionQps/avgRt/curThreadNum)."""
+
+    def __init__(self, client: SentinelClient):
+        self._c = client
+
+    def _row_stats(self, row: int) -> Dict[str, float]:
+        c = self._c
+        sec_cfg = W.WindowConfig(c.cfg.second_sample_count, c.cfg.second_window_ms)
+        now = jnp.int32(c.time.now_ms())
+        with c._engine_lock:
+            st = c._state
+            rows = jnp.asarray([row], dtype=jnp.int32)
+            counts = np.asarray(W.gather_window_counts(st.win_sec, now, rows, sec_cfg))[0]
+            rt_tot, rt_min = W.gather_window_rt(st.win_sec, now, rows, sec_cfg)
+            conc = int(np.asarray(st.concurrency[row]))
+        interval_s = sec_cfg.interval_ms / 1000.0
+        succ = float(counts[W.EV_SUCCESS])
+        return {
+            "passQps": float(counts[W.EV_PASS]) / interval_s,
+            "blockQps": float(counts[W.EV_BLOCK]) / interval_s,
+            "successQps": succ / interval_s,
+            "exceptionQps": float(counts[W.EV_EXCEPTION]) / interval_s,
+            "avgRt": float(np.asarray(rt_tot)[0]) / succ if succ > 0 else 0.0,
+            "minRt": float(np.asarray(rt_min)[0]),
+            "curThreadNum": conc,
+        }
+
+    def resource(self, name: str) -> Optional[Dict[str, float]]:
+        rid = self.registry_peek(name)
+        if rid is None:
+            return None
+        return self._row_stats(rid)
+
+    def entry_node(self) -> Dict[str, float]:
+        return self._row_stats(self._c.cfg.entry_node_row)
+
+    def registry_peek(self, name: str) -> Optional[int]:
+        return self._c.registry.peek_resource_id(name)

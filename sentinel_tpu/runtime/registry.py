@@ -1,0 +1,148 @@
+"""String → dense-id interning for resources, origins, contexts and stat rows.
+
+The analog of the reference's copy-on-write resource→chain map
+(CtSph.lookProcessChain, CtSph.java:194-216) and the per-resource /
+per-origin node maps (ClusterBuilderSlot.java:69-88,
+ContextUtil.trueEnter:120).  Dense ids index the engine's structure-of-
+arrays tensors directly.
+
+Capacity semantics mirror the reference:
+- beyond ``max_resources`` new resources degrade to PASS-THROUGH
+  (returned id is None), exactly as lookProcessChain returns null past
+  MAX_SLOT_CHAIN_SIZE=6000 (Constants.java:37);
+- beyond ``max_nodes`` origin/context stat rows degrade to the trash row
+  (stats dropped, decisions still made on the resource node), akin to
+  MAX_CONTEXT_NAME_SIZE overflow returning NullContext
+  (ContextUtil.java:120).
+
+Thread-safe; reads are lock-free dict lookups (GIL-atomic).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from sentinel_tpu.core.config import EngineConfig
+
+
+class Registry:
+    def __init__(self, cfg: EngineConfig):
+        self.cfg = cfg
+        self._lock = threading.RLock()
+        # resource rows occupy [1, max_resources); row 0 is the ENTRY node
+        self._resources: Dict[str, int] = {}
+        self._resource_names: List[Optional[str]] = [None] * 1
+        self._next_res = 1
+        # extra stat rows (origin nodes, context default-nodes) live in
+        # [max_resources, max_nodes)
+        self._extra_rows: Dict[Tuple[str, str], int] = {}
+        self._next_extra = cfg.max_resources
+        # origins are a separate id space (matched against limitApp)
+        self._origins: Dict[str, int] = {}
+        self._origin_names: List[str] = []
+
+    # -- resources ----------------------------------------------------------
+
+    def resource_id(self, name: str) -> Optional[int]:
+        """Dense id for a resource, interning on first use.
+
+        Returns None when capacity is exhausted → caller passes through
+        (no stats, no rules), mirroring CtSph.java:200-205.
+        """
+        rid = self._resources.get(name)
+        if rid is not None:
+            return rid
+        with self._lock:
+            rid = self._resources.get(name)
+            if rid is not None:
+                return rid
+            if self._next_res >= self.cfg.max_resources:
+                return None
+            rid = self._next_res
+            self._next_res += 1
+            self._resources[name] = rid
+            self._resource_names.append(name)
+            return rid
+
+    def peek_resource_id(self, name: str) -> Optional[int]:
+        return self._resources.get(name)
+
+    def resource_name(self, rid: int) -> Optional[str]:
+        if 0 < rid < len(self._resource_names):
+            return self._resource_names[rid]
+        return None
+
+    @property
+    def num_resources(self) -> int:
+        return self._next_res
+
+    def resources(self) -> Dict[str, int]:
+        return dict(self._resources)
+
+    # -- origin / context stat rows ----------------------------------------
+
+    def extra_row(self, kind: str, key: str) -> int:
+        """Stat row for an origin node ('origin', '<res>|<origin>') or a
+        context DefaultNode ('ctx', '<res>|<ctx>').  Trash row on overflow."""
+        k = (kind, key)
+        row = self._extra_rows.get(k)
+        if row is not None:
+            return row
+        with self._lock:
+            row = self._extra_rows.get(k)
+            if row is not None:
+                return row
+            if self._next_extra >= self.cfg.max_nodes:
+                return self.cfg.trash_row
+            row = self._next_extra
+            self._next_extra += 1
+            self._extra_rows[k] = row
+            return row
+
+    def origin_node_row(self, resource: str, origin: str) -> int:
+        return self.extra_row("origin", f"{resource}\x00{origin}")
+
+    def ctx_node_row(self, resource: str, ctx: str) -> int:
+        return self.extra_row("ctx", f"{resource}\x00{ctx}")
+
+    def extra_rows(self) -> Dict[Tuple[str, str], int]:
+        return dict(self._extra_rows)
+
+    # -- origins ------------------------------------------------------------
+
+    def context_id(self, name: str) -> int:
+        """Intern a context name (for CHAIN-strategy matching). '' → -1."""
+        if not name:
+            return -1
+        cid = self._contexts.get(name)
+        if cid is not None:
+            return cid
+        with self._lock:
+            cid = self._contexts.get(name)
+            if cid is not None:
+                return cid
+            cid = len(self._contexts)
+            self._contexts[name] = cid
+            return cid
+
+    def origin_id(self, origin: str) -> int:
+        """Intern an origin string. '' (no origin) maps to -1."""
+        if not origin:
+            return -1
+        oid = self._origins.get(origin)
+        if oid is not None:
+            return oid
+        with self._lock:
+            oid = self._origins.get(origin)
+            if oid is not None:
+                return oid
+            oid = len(self._origin_names)
+            self._origins[origin] = oid
+            self._origin_names.append(origin)
+            return oid
+
+    def peek_origin_id(self, origin: str) -> int:
+        if not origin:
+            return -1
+        return self._origins.get(origin, -1)

@@ -1,18 +1,27 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
-so sharding tests exercise real SPMD partitioning without TPU hardware
-(the driver separately dry-run-compiles the multi-chip path).
+Forces JAX onto a virtual 8-device CPU mesh so sharding tests exercise real
+SPMD partitioning without TPU hardware.
+
+Note: this image's sitecustomize registers an `axon` TPU-tunnel backend and
+forces ``jax_platforms=axon`` at interpreter start (before conftest runs),
+so setting the env var here is not enough — we must override the live jax
+config.  Backends are still uninitialized at conftest-import time, so the
+override takes effect for every test.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
