@@ -1,0 +1,147 @@
+"""Benchmark: rule-check decisions/sec across 1M resources (BASELINE north star).
+
+Scenario ≈ BASELINE config #2 scaled to the north-star shape: 1M dense
+resources, Zipf-skewed traffic, QPS flow rules on the hot resources, full
+engine tick (stats + all rule slots + completions) per micro-batch.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "decisions/s", "vs_baseline": N/5e7, ...}
+
+Baseline: >= 50M decisions/sec @ 1M resources on one v5e-1, p99 < 2 ms
+(BASELINE.md).  The reference publishes no numbers; its envelope is a JMH
+harness and a 6,000-resource design cap (Constants.java:37).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def _tpu_available(timeout_s: float = 90.0) -> bool:
+    """Probe the axon TPU backend in a subprocess so a hung tunnel can't
+    wedge the benchmark."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; d=jax.devices(); print(d[0].platform)"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        return r.returncode == 0 and "cpu" not in r.stdout.lower()
+    except Exception:
+        return False
+
+
+def main() -> None:
+    use_tpu = _tpu_available()
+    import jax
+
+    if not use_tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.config import EngineConfig
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.runtime.registry import Registry
+
+    platform = jax.devices()[0].platform
+    n_res = 1 << 20  # 1M resources
+    B = 32768
+    cfg = EngineConfig(
+        max_resources=n_res,
+        max_nodes=n_res,
+        max_flow_rules=4096,
+        batch_size=B,
+        complete_batch_size=B,
+        enable_minute_window=False,
+    )
+
+    # rules on the 4k hottest resources (Zipf head); the remaining ~1M
+    # resources are tracked statistically but unruled, like the reference's
+    # default pass-through
+    reg = Registry(cfg)
+    rules = []
+    for i in range(4095):
+        name = f"res-{i+1}"
+        assert reg.resource_id(name) == i + 1
+        rules.append(FlowRule(resource=name, count=1000.0))
+    ruleset = E.compile_ruleset(cfg, reg, flow_rules=rules)
+
+    # Zipf-skewed traffic over the full 1M id space
+    rng = np.random.default_rng(0)
+    n_batches = 16
+    z = rng.zipf(1.3, size=(n_batches, B)).astype(np.int64)
+    res_ids = ((z - 1) % (n_res - 1) + 1).astype(np.int32)
+    acqs = []
+    comps = []
+    for i in range(n_batches):
+        ids = jnp.asarray(res_ids[i])
+        acqs.append(
+            E.empty_acquire(cfg)._replace(
+                res=ids, count=jnp.ones((B,), dtype=jnp.int32)
+            )
+        )
+        comps.append(
+            E.empty_complete(cfg)._replace(
+                res=ids,
+                rt=jnp.abs(jnp.asarray(rng.normal(3.0, 1.0, B), dtype=jnp.float32)),
+                success=jnp.ones((B,), dtype=jnp.int32),
+            )
+        )
+
+    tick = E.make_tick(cfg, donate=True)
+    state = E.init_state(cfg)
+    load = jnp.float32(0.0)
+    cpu = jnp.float32(0.0)
+
+    # warmup / compile
+    for w in range(3):
+        state, out = tick(state, ruleset, acqs[w % n_batches], comps[w % n_batches],
+                          jnp.int32(w), load, cpu)
+    out.verdict.block_until_ready()
+
+    # throughput: pipelined dispatch
+    n_ticks = 120
+    t0 = time.perf_counter()
+    for t in range(n_ticks):
+        state, out = tick(state, ruleset, acqs[t % n_batches], comps[t % n_batches],
+                          jnp.int32(1000 + t), load, cpu)
+    out.verdict.block_until_ready()
+    dt = time.perf_counter() - t0
+    decisions_per_sec = n_ticks * B / dt
+
+    # latency: blocking per tick
+    lat = []
+    for t in range(60):
+        t1 = time.perf_counter()
+        state, out = tick(state, ruleset, acqs[t % n_batches], comps[t % n_batches],
+                          jnp.int32(3000 + t), load, cpu)
+        out.verdict.block_until_ready()
+        lat.append((time.perf_counter() - t1) * 1000.0)
+    p50 = float(np.percentile(lat, 50))
+    p99 = float(np.percentile(lat, 99))
+
+    print(
+        json.dumps(
+            {
+                "metric": "rule_check_decisions_per_sec@1M_resources",
+                "value": round(decisions_per_sec),
+                "unit": "decisions/s",
+                "vs_baseline": round(decisions_per_sec / 50e6, 4),
+                "p50_tick_ms": round(p50, 3),
+                "p99_tick_ms": round(p99, 3),
+                "batch": B,
+                "platform": platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

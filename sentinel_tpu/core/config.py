@@ -145,7 +145,7 @@ class EngineConfig:
 
     @property
     def trash_row(self) -> int:
-        """Scatter target for padded/invalid items (always last row).
+        """Scatter target for padded/invalid items (first padding row).
 
         Using an explicit trash row (instead of out-of-bounds dropping)
         keeps every gather/scatter index in range.
@@ -154,7 +154,10 @@ class EngineConfig:
 
     @property
     def node_rows(self) -> int:
-        return self.max_nodes + 1  # + trash row
+        # max_nodes + 8 keeps the row axis divisible by typical mesh sizes
+        # (max_nodes is a power of two) so it shards evenly; rows
+        # [max_nodes, max_nodes+8) are trash/padding.
+        return self.max_nodes + 8
 
 
 DEFAULT_ENGINE_CONFIG = EngineConfig()

@@ -1,0 +1,91 @@
+"""Multi-chip SPMD: shard the resource/node axis over a device mesh.
+
+The reference scales horizontally by adding JVMs behind a token server
+(sentinel-cluster, SURVEY.md §2.5); intra-process it scales by striped
+LongAdders.  The TPU-native scale-out axis is the *resource cardinality*:
+all window/stat tensors are sharded on their node-row dimension across a
+``Mesh(('res',))``, batches stay replicated, and XLA inserts the gathers /
+reductions over ICI (the scaling-book recipe: annotate shardings, let the
+partitioner place collectives).
+
+Why this layout: per-tick the engine reads O(B·K) scattered rows and
+writes O(B) rows of a [node_rows, ...] table.  Sharding rows means each
+chip owns 1/n of the table (HBM capacity scales with the mesh — 8M
+resources on a v5e-8 at default shapes), while the replicated [B]-sized
+batch and verdict tensors ride ICI once per tick.
+
+Controller/rule-slot state (per-rule tensors) is replicated: it is small
+(O(rules)) and every chip derives identical updates from the replicated
+batch, so no communication is needed for it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.ops import engine as E
+from sentinel_tpu.ops import window as W
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()[: n_devices or len(jax.devices())]
+    return Mesh(np.asarray(devices), axis_names=("res",))
+
+
+def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
+    """Sharding pytree matching EngineState: node-row tensors split on
+    'res', per-rule tensors replicated."""
+    row = NamedSharding(mesh, PS("res"))
+    rep = NamedSharding(mesh, PS())
+
+    def win(ws_rows_sharded: bool) -> W.WindowState:
+        r = row if ws_rows_sharded else rep
+        return W.WindowState(counts=r, rt_sum=r, rt_min=r, epochs=rep)
+
+    return E.EngineState(
+        win_sec=win(True),
+        win_min=win(cfg.enable_minute_window),
+        concurrency=row,
+        latest_passed_ms=rep,
+        warmup_tokens=rep,
+        warmup_last_s=rep,
+        cb_state=rep,
+        cb_retry_ms=rep,
+        cb_counts=rep,
+        cb_epochs=rep,
+        cms=rep,
+        cms_epochs=rep,
+    )
+
+
+def shard_state(state: E.EngineState, cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
+    return jax.device_put(state, state_shardings(cfg, mesh))
+
+
+def make_sharded_tick(cfg: EngineConfig, mesh: Mesh, donate: bool = True):
+    """jit the engine tick with sharded-in/sharded-out state.
+
+    Batches and rule tensors are replicated; verdict outputs are
+    replicated (every host sees every verdict).  XLA partitions the
+    scatters/gathers over the row-sharded tables and inserts the ICI
+    collectives.
+    """
+    import functools
+
+    rep = NamedSharding(mesh, PS())
+    st_sh = state_shardings(cfg, mesh)
+
+    fn = functools.partial(E.tick, cfg=cfg)
+    # sharding pytree prefixes: `rep` covers whole RuleSet / batch subtrees
+    return jax.jit(
+        fn,
+        in_shardings=(st_sh, rep, rep, rep, rep, rep, rep),
+        out_shardings=(st_sh, rep),
+        donate_argnums=(0,) if donate else (),
+    )
