@@ -344,6 +344,20 @@ class SentinelClient:
     def exit_context(self, token) -> None:
         CTX.exit_ctx(token)
 
+    def context(self, name: str, origin: str = ""):
+        """Context-manager form of ContextUtil.enter/exit."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _cm():
+            token = CTX.enter(name, origin)
+            try:
+                yield
+            finally:
+                CTX.exit_ctx(token)
+
+        return _cm()
+
     # -- bulk API -----------------------------------------------------------
 
     def check_batch(

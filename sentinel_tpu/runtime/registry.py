@@ -41,6 +41,8 @@ class Registry:
         # origins are a separate id space (matched against limitApp)
         self._origins: Dict[str, int] = {}
         self._origin_names: List[str] = []
+        # context names (CHAIN-strategy matching)
+        self._contexts: Dict[str, int] = {}
 
     # -- resources ----------------------------------------------------------
 

@@ -1,0 +1,155 @@
+"""Circuit-breaker integration tests under virtual time.
+
+Counterpart of the reference's CircuitBreakingIntegrationTest and the
+ResponseTime/ExceptionCircuitBreaker unit tests (SURVEY.md §4.3): full
+entry/exit loops against DegradeRules, state transitions driven by the
+virtual clock.
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.runtime.client import SentinelClient
+
+
+@pytest.fixture()
+def client(vt):
+    c = SentinelClient(cfg=small_engine_config(), time_source=vt, mode="sync")
+    c.start()
+    yield c
+    c.stop()
+
+
+def _roundtrip(client, vt, resource, rt_ms, error=False):
+    """One entry+exit taking rt_ms of virtual time. Returns verdict ok."""
+    try:
+        e = client.entry(resource)
+    except st.BlockException:
+        return False
+    vt.advance(rt_ms)
+    if error:
+        e.trace(RuntimeError("biz"))
+    e.exit()
+    return True
+
+
+def test_slow_ratio_trips_and_recovers(client, vt):
+    client.degrade_rules.load(
+        [
+            st.DegradeRule(
+                resource="svc",
+                grade=st.CB_STRATEGY_SLOW_REQUEST_RATIO,
+                count=10,  # max RT ms
+                slow_ratio_threshold=0.5,
+                stat_interval_ms=1000,
+                time_window=2,  # retry after 2 s
+                min_request_amount=5,
+            )
+        ]
+    )
+    # 5 slow requests (60 > 10 ms) → at the 5th completion total=5 ≥
+    # minRequestAmount and ratio 1.0 > 0.5 → OPEN
+    for _ in range(5):
+        assert _roundtrip(client, vt, "svc", 60)
+    assert not _roundtrip(client, vt, "svc", 1)  # breaker open
+
+    # before the retry window: still open
+    vt.advance(1000)
+    assert not _roundtrip(client, vt, "svc", 1)
+
+    # after retry timeout: exactly one probe is let through
+    vt.advance(2500)
+    probe = client.try_entry("svc")
+    assert probe is not None
+    assert client.try_entry("svc") is None  # half-open: probe in flight
+    # fast probe completion closes the breaker
+    vt.advance(2)
+    probe.exit()
+    assert _roundtrip(client, vt, "svc", 1)
+
+
+def test_half_open_regression(client, vt):
+    client.degrade_rules.load(
+        [
+            st.DegradeRule(
+                resource="svc2",
+                grade=st.CB_STRATEGY_SLOW_REQUEST_RATIO,
+                count=10,
+                slow_ratio_threshold=0.5,
+                stat_interval_ms=1000,
+                time_window=1,
+                min_request_amount=3,
+            )
+        ]
+    )
+    for _ in range(3):
+        assert _roundtrip(client, vt, "svc2", 50)
+    assert not _roundtrip(client, vt, "svc2", 1)
+    vt.advance(1500)
+    # probe admitted but SLOW again → breaker re-opens
+    assert _roundtrip(client, vt, "svc2", 80)
+    assert not _roundtrip(client, vt, "svc2", 1)
+
+
+def test_error_ratio(client, vt):
+    client.degrade_rules.load(
+        [
+            st.DegradeRule(
+                resource="err",
+                grade=st.CB_STRATEGY_ERROR_RATIO,
+                count=0.5,
+                stat_interval_ms=1000,
+                time_window=5,
+                min_request_amount=4,
+            )
+        ]
+    )
+    for _ in range(3):
+        assert _roundtrip(client, vt, "err", 1, error=True)
+    assert _roundtrip(client, vt, "err", 1, error=False)
+    # 3/4 errors > 0.5 → open
+    assert not _roundtrip(client, vt, "err", 1)
+
+
+def test_error_count(client, vt):
+    client.degrade_rules.load(
+        [
+            st.DegradeRule(
+                resource="ec",
+                grade=st.CB_STRATEGY_ERROR_COUNT,
+                count=3,
+                stat_interval_ms=1000,
+                time_window=5,
+                min_request_amount=1,
+            )
+        ]
+    )
+    assert _roundtrip(client, vt, "ec", 1, error=True)
+    assert _roundtrip(client, vt, "ec", 1, error=True)
+    assert _roundtrip(client, vt, "ec", 1, error=True)
+    assert not _roundtrip(client, vt, "ec", 1)
+
+
+def test_window_expiry_resets_ratio(client, vt):
+    client.degrade_rules.load(
+        [
+            st.DegradeRule(
+                resource="w",
+                grade=st.CB_STRATEGY_SLOW_REQUEST_RATIO,
+                count=10,
+                slow_ratio_threshold=0.5,
+                stat_interval_ms=1000,
+                time_window=1,
+                min_request_amount=5,
+            )
+        ]
+    )
+    # 4 slow requests — under minRequestAmount, no trip
+    for _ in range(4):
+        assert _roundtrip(client, vt, "w", 30)
+    # window slides past them
+    vt.advance(2000)
+    # fresh fast traffic keeps it closed
+    for _ in range(6):
+        assert _roundtrip(client, vt, "w", 1)
