@@ -1,0 +1,47 @@
+"""Dynamic config / property layer (SURVEY.md L4).
+
+Push-based dynamic rules: a ``SentinelProperty`` fans values out to typed
+listeners; datasources (file poll, in-memory push, external stores) feed
+properties; ``RuleManager.register_property`` subscribes a rule manager so
+rule updates flow  datasource → property → manager → engine recompilation
+(the reference's tail at DynamicSentinelProperty.java:49 →
+FlowPropertyListener.configUpdate).
+"""
+
+from sentinel_tpu.datasource.property import (
+    DynamicSentinelProperty,
+    NoOpSentinelProperty,
+    PropertyListener,
+    SentinelProperty,
+    SimplePropertyListener,
+)
+from sentinel_tpu.datasource.base import (
+    AbstractDataSource,
+    AutoRefreshDataSource,
+    Converter,
+    FileRefreshableDataSource,
+    FileWritableDataSource,
+    ReadableDataSource,
+    WritableDataSource,
+)
+from sentinel_tpu.datasource.converters import (
+    json_rule_converter,
+    json_rule_encoder,
+)
+
+__all__ = [
+    "SentinelProperty",
+    "DynamicSentinelProperty",
+    "NoOpSentinelProperty",
+    "PropertyListener",
+    "SimplePropertyListener",
+    "ReadableDataSource",
+    "WritableDataSource",
+    "AbstractDataSource",
+    "AutoRefreshDataSource",
+    "FileRefreshableDataSource",
+    "FileWritableDataSource",
+    "Converter",
+    "json_rule_converter",
+    "json_rule_encoder",
+]

@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 import time as _time
 from concurrent.futures import Future
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -159,9 +160,10 @@ class RuleManager:
         self.kind = kind
         self._rules: list = []
         self._listeners: list = []
+        self._property = None
 
     def load(self, rules: Sequence) -> None:
-        self._rules = list(rules)
+        self._rules = list(rules) if rules else []
         self._client._recompile_rules()
         for fn in list(self._listeners):
             fn(self._rules)
@@ -171,6 +173,22 @@ class RuleManager:
 
     def add_listener(self, fn) -> None:
         self._listeners.append(fn)
+
+    def register_property(self, prop) -> None:
+        """Subscribe this manager to a SentinelProperty so datasource pushes
+        drive rule reloads (FlowRuleManager.register2Property analog)."""
+        from sentinel_tpu.datasource.property import SimplePropertyListener
+
+        if self._property is not None:
+            self._property.remove_listener(self._prop_listener)
+        self._property = prop
+        # None means "property not populated yet" — keep existing rules
+        # (FlowPropertyListener.configLoad null-check); an empty list is a
+        # real "clear all rules" push.
+        self._prop_listener = SimplePropertyListener(
+            lambda rules: None if rules is None else self.load(rules)
+        )
+        prop.add_listener(self._prop_listener)
 
 
 class SentinelClient:
@@ -221,9 +239,17 @@ class SentinelClient:
         if self._started:
             return
         self._started = True
+        self._stop_evt = threading.Event()  # allow stop() → start() restart
         if self.mode == "threaded":
+            # Warm the compile cache before serving: the first jitted tick
+            # can take tens of seconds; without this, early entry() futures
+            # hit entry_timeout_s while XLA compiles.
+            self._run_tick([], [], self.time.now_ms())
             self._thread = threading.Thread(
-                target=self._tick_loop, name="sentinel-tpu-tick", daemon=True
+                target=self._tick_loop,
+                args=(self._stop_evt,),
+                name="sentinel-tpu-tick",
+                daemon=True,
             )
             self._thread.start()
 
@@ -344,19 +370,14 @@ class SentinelClient:
     def exit_context(self, token) -> None:
         CTX.exit_ctx(token)
 
+    @contextmanager
     def context(self, name: str, origin: str = ""):
         """Context-manager form of ContextUtil.enter/exit."""
-        from contextlib import contextmanager
-
-        @contextmanager
-        def _cm():
-            token = CTX.enter(name, origin)
-            try:
-                yield
-            finally:
-                CTX.exit_ctx(token)
-
-        return _cm()
+        token = CTX.enter(name, origin)
+        try:
+            yield
+        finally:
+            CTX.exit_ctx(token)
 
     # -- bulk API -----------------------------------------------------------
 
@@ -414,9 +435,12 @@ class SentinelClient:
 
     # -- tick machinery -----------------------------------------------------
 
-    def _tick_loop(self) -> None:
+    def _tick_loop(self, stop_evt: threading.Event) -> None:
+        # stop_evt is captured by argument: a restart swaps self._stop_evt,
+        # and an old loop still draining a slow tick must keep observing the
+        # event that stop() actually set, not the fresh one.
         interval = self.tick_interval_ms / 1000.0
-        while not self._stop_evt.is_set():
+        while not stop_evt.is_set():
             t0 = _time.monotonic()
             try:
                 self.tick_once()
@@ -426,7 +450,7 @@ class SentinelClient:
                 traceback.print_exc()
             dt = _time.monotonic() - t0
             if dt < interval:
-                self._stop_evt.wait(interval - dt)
+                stop_evt.wait(interval - dt)
 
     def tick_once(self, now_ms: Optional[int] = None) -> None:
         """Drain queues and run engine ticks until empty."""

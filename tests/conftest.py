@@ -32,3 +32,32 @@ def vt():
     from sentinel_tpu.utils.time_source import VirtualTimeSource
 
     return VirtualTimeSource(start_ms=1_000)
+
+
+@pytest.fixture()
+def client_factory(vt):
+    """Builds sync-mode clients on the small engine config + virtual time;
+    stops them all at teardown."""
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    made = []
+
+    def factory(**kw):
+        kw.setdefault("cfg", small_engine_config())
+        kw.setdefault("time_source", vt)
+        kw.setdefault("mode", "sync")
+        c = SentinelClient(**kw)
+        c.start()
+        made.append(c)
+        return c
+
+    yield factory
+    for c in made:
+        c.stop()
+
+
+@pytest.fixture()
+def client(client_factory):
+    """Shared sync-mode client on virtual time (the common fixture)."""
+    return client_factory()

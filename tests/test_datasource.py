@@ -1,0 +1,99 @@
+"""Datasource / property layer tests (SURVEY.md §2.3, L4).
+
+Mirrors the reference's datasource-extension tests: property fan-out and
+skip-unchanged semantics, file poll with mtime detection, writable
+round-trip, and the datasource→RuleManager→engine wiring end-to-end.
+"""
+
+import json
+import os
+import time
+
+
+from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.core.rules import FlowRule
+from sentinel_tpu.datasource import (
+    DynamicSentinelProperty,
+    FileRefreshableDataSource,
+    FileWritableDataSource,
+    SimplePropertyListener,
+    json_rule_converter,
+    json_rule_encoder,
+)
+
+
+def test_dynamic_property_fanout_and_skip_unchanged():
+    prop = DynamicSentinelProperty()
+    seen = []
+    prop.add_listener(SimplePropertyListener(lambda v: seen.append(v)))
+    assert seen == [None]  # config_load replay on subscribe
+
+    assert prop.update_value(1) is True
+    assert prop.update_value(1) is False  # unchanged → no fan-out
+    assert prop.update_value(2) is True
+    assert seen == [None, 1, 2]
+
+
+def test_property_late_listener_gets_current_value():
+    prop = DynamicSentinelProperty()
+    prop.update_value("x")
+    seen = []
+    prop.add_listener(SimplePropertyListener(seen.append))
+    assert seen == ["x"]
+
+
+def test_file_refreshable_datasource(tmp_path):
+    p = tmp_path / "flow-rules.json"
+    p.write_text(json.dumps([{"resource": "a", "count": 10}]))
+    ds = FileRefreshableDataSource(str(p), json_rule_converter("flow"), refresh_ms=60_000)
+    try:
+        got = ds.get_property().get_value()
+        assert len(got) == 1 and got[0].resource == "a" and got[0].count == 10
+
+        # unchanged mtime → no reload
+        assert ds.refresh() is False
+
+        p.write_text(json.dumps([{"resource": "b", "count": 5}]))
+        os.utime(str(p), (time.time() + 5, time.time() + 5))
+        assert ds.refresh() is True
+        got = ds.get_property().get_value()
+        assert got[0].resource == "b"
+    finally:
+        ds.close()
+
+
+def test_file_writable_datasource_roundtrip(tmp_path):
+    p = tmp_path / "out.json"
+    w = FileWritableDataSource(str(p), json_rule_encoder)
+    w.write([FlowRule(resource="hello", count=20.0)])
+    back = json_rule_converter("flow")(p.read_text())
+    assert back[0].resource == "hello" and back[0].count == 20.0
+
+
+def test_datasource_drives_engine(client_factory, tmp_path):
+    """File push → property → FlowRuleManager → engine recompile → enforcement."""
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([{"resource": "svc", "count": 2}]))
+
+    client = client_factory()
+    ds = FileRefreshableDataSource(str(p), json_rule_converter("flow"), refresh_ms=60_000)
+    try:
+        client.flow_rules.register_property(ds.get_property())
+        assert len(client.flow_rules.get()) == 1
+
+        passed = blocked = 0
+        for _ in range(6):
+            try:
+                with client.entry("svc"):
+                    passed += 1
+            except ERR.FlowException:
+                blocked += 1
+        assert passed == 2 and blocked == 4
+
+        # push a higher limit through the file
+        p.write_text(json.dumps([{"resource": "svc", "count": 100}]))
+        os.utime(str(p), (time.time() + 5, time.time() + 5))
+        assert ds.refresh() is True
+        assert client.flow_rules.get()[0].count == 100
+    finally:
+        ds.close()

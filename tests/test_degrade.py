@@ -6,19 +6,7 @@ entry/exit loops against DegradeRules, state transitions driven by the
 virtual clock.
 """
 
-import pytest
-
 import sentinel_tpu as st
-from sentinel_tpu.core.config import small_engine_config
-from sentinel_tpu.runtime.client import SentinelClient
-
-
-@pytest.fixture()
-def client(vt):
-    c = SentinelClient(cfg=small_engine_config(), time_source=vt, mode="sync")
-    c.start()
-    yield c
-    c.stop()
 
 
 def _roundtrip(client, vt, resource, rt_ms, error=False):

@@ -5,20 +5,9 @@ SystemGuardIntegrationTest and AuthoritySlotTest (SURVEY.md §4.3),
 exercised through the public API with virtual time.
 """
 
-import pytest
 
 import sentinel_tpu as st
-from sentinel_tpu.core.config import small_engine_config
 from sentinel_tpu.core.rules import ParamFlowItem
-from sentinel_tpu.runtime.client import SentinelClient
-
-
-@pytest.fixture()
-def client(vt):
-    c = SentinelClient(cfg=small_engine_config(), time_source=vt, mode="sync")
-    c.start()
-    yield c
-    c.stop()
 
 
 # ---------------- param flow ----------------
