@@ -1,0 +1,41 @@
+"""Cluster flow control: the distributed token backend (SURVEY.md §2.5).
+
+A TPU-native re-design of the reference's `sentinel-cluster` modules:
+the token *decisions* run on the same batched device engine as local rules
+(flowIds interned as resources on a dedicated decision client), while the
+host provides the wire protocol, connection bookkeeping, namespace guard,
+and concurrent-token TTL cache.
+
+Modules:
+  constants      — wire message types / status codes (ClusterConstants.java)
+  protocol       — length-prefixed binary frame codec (default transport)
+  rules          — ClusterFlowRuleManager / ClusterParamFlowRuleManager /
+                   server+client config managers
+  token_service  — TokenService interface + DefaultTokenService on the engine
+  server         — asyncio TCP token server + ConnectionManager
+  client         — ClusterTokenClient (xid-correlated, auto-reconnect)
+  state          — ClusterStateManager (NOT_STARTED / CLIENT / SERVER flips)
+"""
+
+from sentinel_tpu.cluster.constants import (  # noqa: F401
+    MSG_TYPE_PING,
+    MSG_TYPE_FLOW,
+    MSG_TYPE_PARAM_FLOW,
+    MSG_TYPE_CONCURRENT_ACQUIRE,
+    MSG_TYPE_CONCURRENT_RELEASE,
+    STATUS_OK,
+    STATUS_BLOCKED,
+    STATUS_SHOULD_WAIT,
+    STATUS_FAIL,
+    STATUS_NO_RULE,
+    STATUS_TOO_MANY_REQUEST,
+    STATUS_BAD_REQUEST,
+    STATUS_RELEASE_OK,
+    STATUS_ALREADY_RELEASE,
+)
+from sentinel_tpu.cluster.token_service import (  # noqa: F401
+    TokenResult,
+    TokenService,
+    DefaultTokenService,
+)
+from sentinel_tpu.cluster.state import ClusterStateManager  # noqa: F401

@@ -1,0 +1,211 @@
+"""Cluster token client: xid-correlated requests with auto-reconnect.
+
+The reference pairs a Netty channel with a xid→promise map
+(DefaultClusterTokenClient.java:45, TokenClientPromiseHolder); here a plain
+socket plus a daemon reader thread resolves per-request Futures.  Failures
+degrade, never break: a dead server yields STATUS_FAIL results and the
+runtime falls back to local rule checking
+(FlowRuleChecker.fallbackToLocalOrPass:166 — see runtime/client.py wiring).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+import time as _time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.utils.record_log import record_log
+
+
+class ClusterTokenClient(TokenService):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        namespace: str = C.DEFAULT_NAMESPACE,
+        timeout_ms: int = C.DEFAULT_REQUEST_TIMEOUT_MS,
+        reconnect_interval_s: float = 2.0,
+    ):
+        self.host = host
+        self.port = port
+        self.namespace = namespace
+        self.timeout_ms = timeout_ms
+        self.reconnect_interval_s = reconnect_interval_s
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._xid_counter = itertools.count(0)
+        self._reader: Optional[threading.Thread] = None
+        self._closed = False
+        self._last_attempt = 0.0
+
+    def _next_xid(self) -> int:
+        # xid is an int32 on the wire; wrap within the positive range
+        return next(self._xid_counter) % 0x7FFFFFFF + 1
+
+    # -- connection management ----------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def start(self) -> None:
+        self._ensure_connected()
+
+    def close(self) -> None:
+        self._closed = True
+        self._teardown()
+
+    def _ensure_connected(self) -> bool:
+        if self._sock is not None:
+            return True
+        if self._closed:
+            return False
+        with self._lock:
+            if self._sock is not None:
+                return True
+            now = _time.monotonic()
+            if now - self._last_attempt < self.reconnect_interval_s:
+                return False
+            self._last_attempt = now
+            try:
+                s = socket.create_connection((self.host, self.port), timeout=2.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                return False
+            self._sock = s
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(s,), name="sentinel-token-client", daemon=True
+            )
+            self._reader.start()
+        # announce namespace so the server's census counts us (PING)
+        try:
+            self._send_nowait(
+                P.ClusterRequest(self._next_xid(), C.MSG_TYPE_PING, namespace=self.namespace)
+            )
+        except OSError:
+            self._teardown()
+            return False
+        return True
+
+    def _teardown(self) -> None:
+        with self._lock:
+            s, self._sock = self._sock, None
+            pending, self._pending = self._pending, {}
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for f in pending.values():
+            if not f.done():
+                f.set_result(None)
+
+    def _read_loop(self, s: socket.socket) -> None:
+        frames = P.FrameReader()
+        try:
+            while True:
+                data = s.recv(4096)
+                if not data:
+                    break
+                for body in frames.feed(data):
+                    try:
+                        rsp = P.decode_response(body)
+                    except Exception:
+                        continue
+                    f = self._pending.pop(rsp.xid, None)
+                    if f is not None and not f.done():
+                        f.set_result(rsp)
+        except OSError:
+            pass
+        finally:
+            if self._sock is s:
+                self._teardown()
+
+    def _send_nowait(self, req: P.ClusterRequest) -> None:
+        s = self._sock
+        if s is None:
+            raise OSError("not connected")
+        s.sendall(P.encode_request(req))
+
+    def _roundtrip(self, req: P.ClusterRequest) -> Optional[P.ClusterResponse]:
+        if not self._ensure_connected():
+            return None
+        try:
+            raw = P.encode_request(req)
+        except Exception:
+            # oversized payload / codec error → STATUS_FAIL, socket stays up
+            return None
+        f: Future = Future()
+        self._pending[req.xid] = f
+        try:
+            s = self._sock
+            if s is None:
+                raise OSError("not connected")
+            s.sendall(raw)
+        except OSError:
+            self._pending.pop(req.xid, None)
+            self._teardown()
+            return None
+        try:
+            return f.result(timeout=self.timeout_ms / 1000.0)
+        except Exception:
+            self._pending.pop(req.xid, None)
+            return None
+
+    # -- TokenService --------------------------------------------------------
+
+    def request_token(self, flow_id: int, count: int = 1, prioritized: bool = False) -> TokenResult:
+        rsp = self._roundtrip(
+            P.ClusterRequest(
+                self._next_xid(), C.MSG_TYPE_FLOW, flow_id=flow_id, count=count, priority=prioritized
+            )
+        )
+        if rsp is None:
+            return TokenResult(C.STATUS_FAIL)
+        return TokenResult(rsp.status, remaining=rsp.remaining, wait_ms=rsp.wait_ms)
+
+    def request_token_batch(self, flow_id: int, units: int) -> TokenResult:
+        rsp = self._roundtrip(
+            P.ClusterRequest(
+                self._next_xid(), C.MSG_TYPE_FLOW_BATCH, flow_id=flow_id, count=units
+            )
+        )
+        if rsp is None:
+            return TokenResult(C.STATUS_FAIL)
+        return TokenResult(rsp.status, remaining=rsp.remaining, wait_ms=rsp.wait_ms)
+
+    def request_param_token(self, flow_id: int, count: int, params: List[Any]) -> TokenResult:
+        rsp = self._roundtrip(
+            P.ClusterRequest(
+                self._next_xid(), C.MSG_TYPE_PARAM_FLOW, flow_id=flow_id, count=count, params=params
+            )
+        )
+        if rsp is None:
+            return TokenResult(C.STATUS_FAIL)
+        return TokenResult(rsp.status, remaining=rsp.remaining, wait_ms=rsp.wait_ms)
+
+    def request_concurrent_token(self, flow_id: int, count: int = 1) -> TokenResult:
+        rsp = self._roundtrip(
+            P.ClusterRequest(
+                self._next_xid(), C.MSG_TYPE_CONCURRENT_ACQUIRE, flow_id=flow_id, count=count
+            )
+        )
+        if rsp is None:
+            return TokenResult(C.STATUS_FAIL)
+        return TokenResult(rsp.status, token_id=rsp.token_id)
+
+    def release_concurrent_token(self, token_id: int) -> TokenResult:
+        rsp = self._roundtrip(
+            P.ClusterRequest(self._next_xid(), C.MSG_TYPE_CONCURRENT_RELEASE, token_id=token_id)
+        )
+        if rsp is None:
+            return TokenResult(C.STATUS_FAIL)
+        return TokenResult(rsp.status)

@@ -1,0 +1,46 @@
+"""Cluster wire constants.
+
+Message-type and status values mirror the reference's public wire contract
+(`sentinel-core/.../cluster/ClusterConstants.java` and
+`TokenResultStatus.java`) so a reference client could in principle talk to
+this token server after swapping the transport framing for ours.
+"""
+
+# -- message types (ClusterConstants.MSG_TYPE_*) -----------------------------
+MSG_TYPE_PING = 0
+MSG_TYPE_FLOW = 1
+MSG_TYPE_PARAM_FLOW = 2
+MSG_TYPE_CONCURRENT_ACQUIRE = 3
+MSG_TYPE_CONCURRENT_RELEASE = 4
+# extension beyond the reference protocol: partial-grant batch acquire —
+# request n units, response carries granted k (0..n) in `remaining`.  The
+# TPU server answers it with n unit-acquires in ONE engine tick.
+MSG_TYPE_FLOW_BATCH = 10
+
+# -- token result status (TokenResultStatus.java) ----------------------------
+STATUS_BAD_REQUEST = -4
+STATUS_TOO_MANY_REQUEST = -2  # namespace guard tripped
+STATUS_FAIL = -1  # transport / unexpected failure
+STATUS_OK = 0
+STATUS_BLOCKED = 2
+STATUS_SHOULD_WAIT = 4
+STATUS_NO_RULE = 5
+STATUS_NO_REF_RULE = 6
+STATUS_NOT_AVAILABLE = 7
+STATUS_RELEASE_OK = 8
+STATUS_ALREADY_RELEASE = 9
+
+# -- defaults (ServerFlowConfig.java:26-40, ClusterConstants) ----------------
+DEFAULT_PORT = 18730
+DEFAULT_IDLE_SECONDS = 600
+DEFAULT_MAX_ALLOWED_QPS = 30_000.0  # per-namespace guard
+DEFAULT_EXCEED_COUNT = 1.0
+DEFAULT_MAX_OCCUPY_RATIO = 1.0
+DEFAULT_SAMPLE_COUNT = 10
+DEFAULT_INTERVAL_MS = 1000
+DEFAULT_NAMESPACE = "default"
+DEFAULT_REQUEST_TIMEOUT_MS = 200
+
+# cluster threshold types (ClusterRuleConstant)
+FLOW_THRESHOLD_AVG_LOCAL = 0
+FLOW_THRESHOLD_GLOBAL = 1

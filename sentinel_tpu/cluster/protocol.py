@@ -1,0 +1,194 @@
+"""Binary frame codec for the cluster token protocol.
+
+Framing: every frame is ``[len:uint16 BE][body]`` — the shape the
+reference's Netty pipeline decodes with
+``LengthFieldBasedFrameDecoder(1024, 0, 2, 0, 2)`` + ``LengthFieldPrepender(2)``
+(NettyTransportServer.java:88-93).
+
+Request body:  ``[xid:int32][type:uint8][payload]``
+Response body: ``[xid:int32][type:uint8][status:int8][payload]``
+
+Payloads (big-endian, mirroring the reference entity writers):
+  PING               → [namespace:utf8]               (registers the connection)
+  FLOW               → [flowId:int64][count:int32][priority:uint8]
+                       (FlowRequestData.java:24-26)
+  PARAM_FLOW         → [flowId:int64][count:int32][params…] with each param
+                       type-tagged (ParamFlowRequestDataWriter semantics:
+                       only primitives/strings serialize; others dropped)
+  CONCURRENT_ACQUIRE → [flowId:int64][count:int32][prioritized:uint8]
+  CONCURRENT_RELEASE → [tokenId:int64]
+
+  flow/param response       → [remaining:int32][waitMs:int32]
+  concurrent acquire resp   → [tokenId:int64]
+  others                    → empty
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from sentinel_tpu.cluster import constants as C
+
+MAX_FRAME = 1024
+
+# param type tags
+_T_INT = 0
+_T_LONG = 1
+_T_DOUBLE = 2
+_T_STRING = 3
+_T_BOOL = 4
+
+
+@dataclass
+class ClusterRequest:
+    xid: int
+    type: int
+    flow_id: int = 0
+    count: int = 1
+    priority: bool = False
+    token_id: int = 0
+    namespace: str = ""
+    params: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class ClusterResponse:
+    xid: int
+    type: int
+    status: int
+    remaining: int = 0
+    wait_ms: int = 0
+    token_id: int = 0
+
+
+def _pack_params(params: List[Any]) -> bytes:
+    out = bytearray()
+    for p in params:
+        # bool before int: bool is an int subclass in Python
+        if isinstance(p, bool):
+            out += struct.pack(">BB", _T_BOOL, 1 if p else 0)
+        elif isinstance(p, int):
+            if -(2**31) <= p < 2**31:
+                out += struct.pack(">Bi", _T_INT, p)
+            else:
+                out += struct.pack(">Bq", _T_LONG, p)
+        elif isinstance(p, float):
+            out += struct.pack(">Bd", _T_DOUBLE, p)
+        elif isinstance(p, str):
+            b = p.encode("utf-8")
+            out += struct.pack(">BH", _T_STRING, len(b)) + b
+        # unsupported types are silently dropped (ParamFlowRequestDataWriter)
+    return bytes(out)
+
+
+def _unpack_params(buf: bytes) -> List[Any]:
+    out: List[Any] = []
+    i = 0
+    while i < len(buf):
+        tag = buf[i]
+        i += 1
+        if tag == _T_INT:
+            out.append(struct.unpack_from(">i", buf, i)[0])
+            i += 4
+        elif tag == _T_LONG:
+            out.append(struct.unpack_from(">q", buf, i)[0])
+            i += 8
+        elif tag == _T_DOUBLE:
+            out.append(struct.unpack_from(">d", buf, i)[0])
+            i += 8
+        elif tag == _T_STRING:
+            (n,) = struct.unpack_from(">H", buf, i)
+            i += 2
+            out.append(buf[i : i + n].decode("utf-8"))
+            i += n
+        elif tag == _T_BOOL:
+            out.append(buf[i] != 0)
+            i += 1
+        else:
+            raise ValueError(f"bad param tag {tag}")
+    return out
+
+
+def encode_request(req: ClusterRequest) -> bytes:
+    head = struct.pack(">iB", req.xid, req.type)
+    t = req.type
+    if t == C.MSG_TYPE_PING:
+        payload = req.namespace.encode("utf-8")
+    elif t == C.MSG_TYPE_FLOW or t == C.MSG_TYPE_FLOW_BATCH:
+        payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0)
+    elif t == C.MSG_TYPE_PARAM_FLOW:
+        payload = struct.pack(">qi", req.flow_id, req.count) + _pack_params(req.params)
+    elif t == C.MSG_TYPE_CONCURRENT_ACQUIRE:
+        payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0)
+    elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
+        payload = struct.pack(">q", req.token_id)
+    else:
+        raise ValueError(f"bad request type {t}")
+    body = head + payload
+    if len(body) > MAX_FRAME:
+        raise ValueError("frame too large")
+    return struct.pack(">H", len(body)) + body
+
+
+def decode_request(body: bytes) -> ClusterRequest:
+    xid, t = struct.unpack_from(">iB", body, 0)
+    p = body[5:]
+    req = ClusterRequest(xid=xid, type=t)
+    if t == C.MSG_TYPE_PING:
+        req.namespace = p.decode("utf-8") if p else C.DEFAULT_NAMESPACE
+    elif t in (C.MSG_TYPE_FLOW, C.MSG_TYPE_FLOW_BATCH, C.MSG_TYPE_CONCURRENT_ACQUIRE):
+        req.flow_id, req.count, prio = struct.unpack_from(">qiB", p, 0)
+        req.priority = prio != 0
+    elif t == C.MSG_TYPE_PARAM_FLOW:
+        req.flow_id, req.count = struct.unpack_from(">qi", p, 0)
+        req.params = _unpack_params(p[12:])
+    elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
+        (req.token_id,) = struct.unpack_from(">q", p, 0)
+    else:
+        raise ValueError(f"bad request type {t}")
+    return req
+
+
+def encode_response(rsp: ClusterResponse) -> bytes:
+    head = struct.pack(">iBb", rsp.xid, rsp.type, rsp.status)
+    if rsp.type in (C.MSG_TYPE_FLOW, C.MSG_TYPE_PARAM_FLOW, C.MSG_TYPE_FLOW_BATCH):
+        payload = struct.pack(">ii", rsp.remaining, rsp.wait_ms)
+    elif rsp.type == C.MSG_TYPE_CONCURRENT_ACQUIRE:
+        payload = struct.pack(">q", rsp.token_id)
+    else:
+        payload = b""
+    body = head + payload
+    return struct.pack(">H", len(body)) + body
+
+
+def decode_response(body: bytes) -> ClusterResponse:
+    xid, t, status = struct.unpack_from(">iBb", body, 0)
+    p = body[6:]
+    rsp = ClusterResponse(xid=xid, type=t, status=status)
+    if t in (C.MSG_TYPE_FLOW, C.MSG_TYPE_PARAM_FLOW, C.MSG_TYPE_FLOW_BATCH) and len(p) >= 8:
+        rsp.remaining, rsp.wait_ms = struct.unpack_from(">ii", p, 0)
+    elif t == C.MSG_TYPE_CONCURRENT_ACQUIRE and len(p) >= 8:
+        (rsp.token_id,) = struct.unpack_from(">q", p, 0)
+    return rsp
+
+
+class FrameReader:
+    """Incremental 2-byte-length-prefixed frame splitter."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf += data
+        frames = []
+        while True:
+            if len(self._buf) < 2:
+                break
+            (n,) = struct.unpack_from(">H", self._buf, 0)
+            if len(self._buf) < 2 + n:
+                break
+            frames.append(bytes(self._buf[2 : 2 + n]))
+            del self._buf[: 2 + n]
+        return frames

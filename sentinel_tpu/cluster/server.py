@@ -1,0 +1,251 @@
+"""Cluster token server: TCP front door over the decision engine.
+
+The reference's Netty server (NettyTransportServer.java:88-93 pipeline →
+TokenServerHandler.java:61-75 dispatch) becomes an asyncio TCP server in a
+daemon thread: frames decode on the event loop, token decisions execute in a
+small thread pool (the decision client's check_batch blocks on the engine
+tick, which must not stall the loop).
+
+Connection bookkeeping mirrors ConnectionManager/ConnectionGroup: a client's
+first PING carries its namespace; the per-namespace connected count scales
+AVG_LOCAL thresholds (DefaultTokenService.refresh_connected_count).  Idle
+connections are reaped on a timer (ScanIdleConnectionTask).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
+from sentinel_tpu.utils.record_log import record_log
+
+
+class ConnectionManager:
+    """namespace → live connection census (ConnectionManager/ConnectionGroup)."""
+
+    def __init__(self, on_change=None):
+        self._lock = threading.Lock()
+        self._groups: Dict[str, set] = {}
+        self._conn_ns: Dict[int, str] = {}
+        self._on_change = on_change
+
+    def register(self, conn_id: int, namespace: str) -> None:
+        with self._lock:
+            old = self._conn_ns.get(conn_id)
+            if old is not None:
+                self._groups.get(old, set()).discard(conn_id)
+            self._conn_ns[conn_id] = namespace
+            self._groups.setdefault(namespace, set()).add(conn_id)
+        if self._on_change:
+            self._on_change()
+
+    def remove(self, conn_id: int) -> None:
+        with self._lock:
+            ns = self._conn_ns.pop(conn_id, None)
+            if ns is not None:
+                self._groups.get(ns, set()).discard(conn_id)
+        if ns is not None and self._on_change:
+            self._on_change()
+
+    def connected_count(self, namespace: str) -> int:
+        return len(self._groups.get(namespace, ()))
+
+
+class ClusterTokenServer:
+    """Standalone token server (SentinelDefaultTokenServer analog).
+
+    ``start()`` spins the asyncio loop in a daemon thread and returns once
+    the socket is listening; ``port`` may be 0 to bind an ephemeral port
+    (tests) — the bound port is then available as ``.port``.
+    """
+
+    def __init__(
+        self,
+        token_service: DefaultTokenService,
+        host: str = "0.0.0.0",
+        port: Optional[int] = None,
+        idle_seconds: Optional[int] = None,
+        workers: int = 8,
+    ):
+        self.service = token_service
+        self.host = host
+        cfg = token_service.config.transport
+        self.port = cfg.port if port is None else port
+        self.idle_seconds = cfg.idle_seconds if idle_seconds is None else idle_seconds
+        self.connections = ConnectionManager(
+            on_change=token_service.refresh_connected_count
+        )
+        self.service.connected_count_fn = self.connections.connected_count
+        self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="tok")
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
+        self._conn_seq = 0
+        self._last_active: Dict[int, float] = {}
+        self._writers: Dict[int, asyncio.StreamWriter] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run_loop, name="sentinel-token-server", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("token server failed to start")
+
+    def stop(self) -> None:
+        loop = self._loop
+        if loop is not None and loop.is_running():
+            loop.call_soon_threadsafe(loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._pool.shutdown(wait=False)
+
+    def _run_loop(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def _boot():
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self.port
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            loop.create_task(self._idle_scan())
+            loop.create_task(self._expire_scan())
+            self._started.set()
+
+        loop.run_until_complete(_boot())
+        try:
+            loop.run_forever()
+        finally:
+            if self._server is not None:
+                self._server.close()
+            pending = asyncio.all_tasks(loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            loop.close()
+
+    # -- periodic tasks ------------------------------------------------------
+
+    async def _idle_scan(self) -> None:
+        # close idle sockets (ScanIdleConnectionTask): the census entry is
+        # removed by the handler's finally-block, and a still-alive client
+        # reconnects + re-PINGs, so connectedCount stays truthful
+        while True:
+            await asyncio.sleep(min(self.idle_seconds, 30))
+            cutoff = _time.monotonic() - self.idle_seconds
+            for cid, last in list(self._last_active.items()):
+                if last < cutoff:
+                    w = self._writers.get(cid)
+                    if w is not None:
+                        try:
+                            w.close()
+                        except Exception:
+                            pass
+
+    async def _expire_scan(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            self.service.concurrent.expire(self.service.client.time.now_ms())
+
+    # -- per-connection protocol --------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._conn_seq += 1
+        cid = self._conn_seq
+        frames = P.FrameReader()
+        self._last_active[cid] = _time.monotonic()
+        self._writers[cid] = writer
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                data = await reader.read(4096)
+                if not data:
+                    break
+                self._last_active[cid] = _time.monotonic()
+                for body in frames.feed(data):
+                    try:
+                        req = P.decode_request(body)
+                    except Exception:
+                        continue  # malformed frame — drop (server stays up)
+                    if req.type == C.MSG_TYPE_PING:
+                        self.connections.register(cid, req.namespace or C.DEFAULT_NAMESPACE)
+                        writer.write(
+                            P.encode_response(
+                                P.ClusterResponse(req.xid, req.type, C.STATUS_OK)
+                            )
+                        )
+                        continue
+                    # one task per request: pipelined requests on a single
+                    # connection run concurrently in the pool so they
+                    # coalesce into engine micro-batches (xid correlation
+                    # makes out-of-order replies safe); awaiting inline
+                    # would serialize a connection at one tick per request
+                    loop.create_task(self._process_and_reply(req, writer))
+                await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            record_log().exception("token server connection error")
+        finally:
+            self._last_active.pop(cid, None)
+            self._writers.pop(cid, None)
+            self.connections.remove(cid)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _process_and_reply(
+        self, req: P.ClusterRequest, writer: asyncio.StreamWriter
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        rsp = await loop.run_in_executor(self._pool, self._process, req)
+        try:
+            writer.write(P.encode_response(rsp))
+            await writer.drain()
+        except (ConnectionResetError, OSError):
+            pass  # peer vanished mid-reply
+
+    def _process(self, req: P.ClusterRequest) -> P.ClusterResponse:
+        try:
+            t = req.type
+            if t == C.MSG_TYPE_FLOW:
+                r = self.service.request_token(req.flow_id, req.count, req.priority)
+            elif t == C.MSG_TYPE_FLOW_BATCH:
+                r = self.service.request_token_batch(req.flow_id, req.count)
+            elif t == C.MSG_TYPE_PARAM_FLOW:
+                r = self.service.request_param_token(req.flow_id, req.count, req.params)
+            elif t == C.MSG_TYPE_CONCURRENT_ACQUIRE:
+                r = self.service.request_concurrent_token(req.flow_id, req.count)
+            elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
+                r = self.service.release_concurrent_token(req.token_id)
+            else:
+                r = TokenResult(C.STATUS_BAD_REQUEST)
+        except Exception:
+            record_log().exception("token request processing failed")
+            r = TokenResult(C.STATUS_FAIL)
+        return P.ClusterResponse(
+            xid=req.xid,
+            type=req.type,
+            status=r.status,
+            remaining=r.remaining,
+            wait_ms=r.wait_ms,
+            token_id=r.token_id,
+        )

@@ -1,0 +1,111 @@
+"""Cluster role management (ClusterStateManager.java:38-137).
+
+A process is NOT_STARTED, a token CLIENT (remote server), or a token SERVER
+(embedded: serves the network *and* its own in-process traffic).  Roles flip
+at runtime; the manager owns the lifecycle of the underlying client/server
+objects and exposes the TokenService the local runtime should consult for
+cluster-mode rules.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.rules import ClusterClientConfigManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenService
+
+CLUSTER_NOT_STARTED = -1
+CLUSTER_CLIENT = 0
+CLUSTER_SERVER = 1
+
+
+class ClusterStateManager:
+    def __init__(self, client_config: Optional[ClusterClientConfigManager] = None):
+        self.mode = CLUSTER_NOT_STARTED
+        self.client_config = client_config or ClusterClientConfigManager()
+        self._lock = threading.Lock()
+        self._token_client: Optional[ClusterTokenClient] = None
+        self._server: Optional[ClusterTokenServer] = None
+        self._embedded: Optional[DefaultTokenService] = None
+
+    # -- queries -------------------------------------------------------------
+
+    def token_service(self) -> Optional[TokenService]:
+        """The TokenService local cluster-mode rules should consult."""
+        if self.mode == CLUSTER_CLIENT:
+            return self._token_client
+        if self.mode == CLUSTER_SERVER:
+            return self._embedded
+        return None
+
+    def is_available(self) -> bool:
+        svc = self.token_service()
+        if svc is None:
+            return False
+        if isinstance(svc, ClusterTokenClient):
+            return svc.connected or svc._ensure_connected()
+        return True
+
+    # -- transitions ---------------------------------------------------------
+
+    def set_to_client(
+        self,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+        namespace: str = C.DEFAULT_NAMESPACE,
+    ) -> None:
+        with self._lock:
+            self._stop_server_locked()
+            if host is not None:
+                self.client_config.apply_assign(host, port or C.DEFAULT_PORT)
+            a = self.client_config.assign
+            if self._token_client is not None:
+                self._token_client.close()
+            self._token_client = ClusterTokenClient(
+                a.host,
+                a.port,
+                namespace=namespace,
+                timeout_ms=self.client_config.request_timeout_ms,
+            )
+            self._token_client.start()
+            self.mode = CLUSTER_CLIENT
+
+    def set_to_server(
+        self,
+        token_service: DefaultTokenService,
+        port: Optional[int] = None,
+        serve_network: bool = True,
+    ) -> None:
+        """Become an (embedded) token server: local traffic consults the
+        in-process service directly (DefaultEmbeddedTokenServer)."""
+        with self._lock:
+            if self._token_client is not None:
+                self._token_client.close()
+                self._token_client = None
+            self._embedded = token_service
+            if serve_network:
+                self._server = ClusterTokenServer(token_service, port=port)
+                self._server.start()
+            self.mode = CLUSTER_SERVER
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._token_client is not None:
+                self._token_client.close()
+                self._token_client = None
+            self._stop_server_locked()
+            self._embedded = None
+            self.mode = CLUSTER_NOT_STARTED
+
+    def _stop_server_locked(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    @property
+    def server(self) -> Optional[ClusterTokenServer]:
+        return self._server

@@ -98,6 +98,11 @@ class AcquireBatch(NamedTuple):
     ctx_name: jax.Array  # int32 [B] interned context name (-1 default)
     inbound: jax.Array  # int32 [B] 1 = entrance context (EntranceNode)
     param_hash: jax.Array  # int32 [B] hashed hot param (0 none)
+    # host-decided verdict override (0 = none): a cluster token denial is
+    # injected here so the device still records the block into the stat
+    # windows (the reference counts cluster blocks through StatisticSlot the
+    # same way — FlowRuleChecker.passClusterCheck → BlockException path)
+    pre_verdict: jax.Array  # int32 [B]
 
 
 class CompleteBatch(NamedTuple):
@@ -161,6 +166,7 @@ def empty_acquire(cfg: EngineConfig, b: Optional[int] = None) -> AcquireBatch:
         ctx_name=jnp.full((b,), -1, dtype=jnp.int32),
         inbound=z,
         param_hash=z,
+        pre_verdict=z,
     )
 
 
@@ -633,11 +639,12 @@ def tick(
     state = _sync_warmup(cfg, state, rules, now_ms)
 
     valid = acq.res != cfg.trash_row
+    forced = valid & (acq.pre_verdict > 0)
 
     # 3. rule checks in reference slot order; each stage's blocks remove
     #    the item from later stages' rank accounting
-    auth_block = _check_authority(cfg, rules, acq) & valid
-    eligible = valid & ~auth_block
+    auth_block = _check_authority(cfg, rules, acq) & valid & ~forced
+    eligible = valid & ~auth_block & ~forced
 
     sys_block = _check_system(
         cfg, state, rules, acq, now_ms, sys_load, sys_cpu, eligible
@@ -661,9 +668,12 @@ def tick(
     degrade_block = degrade_block & eligible
     state = state._replace(cb_state=cb_state)
 
-    passed = valid & ~(auth_block | sys_block | param_block | flow_block | degrade_block)
+    passed = valid & ~forced & ~(
+        auth_block | sys_block | param_block | flow_block | degrade_block
+    )
 
     verdict = jnp.full((b,), PASS, dtype=jnp.int8)
+    verdict = jnp.where(forced, acq.pre_verdict.astype(jnp.int8), verdict)
     verdict = jnp.where(auth_block, jnp.int8(BLOCK_AUTHORITY), verdict)
     verdict = jnp.where(sys_block, jnp.int8(BLOCK_SYSTEM), verdict)
     verdict = jnp.where(param_block, jnp.int8(BLOCK_PARAM), verdict)

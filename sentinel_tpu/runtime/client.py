@@ -58,6 +58,7 @@ class AcquireRequest:
     ctx_name: int
     inbound: int
     param_hash: int
+    pre_verdict: int = 0  # host-decided verdict (cluster denial) to record
     future: Optional[Future] = None
 
 
@@ -217,6 +218,21 @@ class SentinelClient:
         self.authority_rules = RuleManager(self, "authority")
         self.param_flow_rules = RuleManager(self, "param-flow")
 
+        # cluster-mode wiring (FlowRuleChecker.passClusterCheck analog):
+        # cluster rules are checked against a TokenService; on token-server
+        # loss the client degrades to local enforcement for rules that allow
+        # it (fallbackToLocalOrPass:166) and re-probes after a cooldown.
+        self.cluster = None  # Optional[ClusterStateManager]
+        self._cluster_flow_by_res: Dict[str, R.FlowRule] = {}
+        self._cluster_param_by_res: Dict[str, R.ParamFlowRule] = {}
+        self._param_idx_by_res: Dict[str, int] = {}
+        self._cluster_degraded_active = False
+        self._cluster_degraded_until = 0.0
+        # guards degrade-state transitions AND every ruleset recompile, so
+        # the degraded flag each compile reads matches the ruleset committed
+        self._cluster_lock = threading.RLock()
+        self.cluster_retry_interval_s = 5.0
+
         self._sys = SystemStatusSampler()
         self._tick = E.make_tick(self.cfg, donate=True)
         self._state = E.init_state(self.cfg)
@@ -263,16 +279,220 @@ class SentinelClient:
     # -- rule compilation ---------------------------------------------------
 
     def _recompile_rules(self) -> None:
+        # cluster-mode rules are enforced via the TokenService, not the local
+        # engine — except while degraded, when fallback-enabled cluster rules
+        # are compiled in as local rules (fallbackToLocalOrPass semantics)
+        with self._cluster_lock:
+            self._recompile_rules_locked()
+
+    def _recompile_rules_locked(self) -> None:
+        flow = self.flow_rules.get()
+        local_flow = [r for r in flow if not r.cluster_mode]
+        cluster_flow = [r for r in flow if r.cluster_mode]
+        self._cluster_flow_by_res = {r.resource: r for r in cluster_flow}
+
+        param = self.param_flow_rules.get()
+        local_param = [r for r in param if not r.cluster_mode]
+        cluster_param = [r for r in param if r.cluster_mode]
+        self._cluster_param_by_res = {r.resource: r for r in cluster_param}
+        # one param index per resource drives the host-side hash, so healthy
+        # (token-service) and degraded (local-engine) modes key off the SAME
+        # argument; first rule wins when several disagree
+        idx_map: Dict[str, int] = {}
+        for r in param:
+            idx_map.setdefault(r.resource, r.param_idx)
+        self._param_idx_by_res = idx_map
+
+        if self._cluster_degraded_active:
+            local_flow += [r for r in cluster_flow if r.cluster_fallback_to_local]
+            local_param += cluster_param
+
         with self._engine_lock:
             self._rules_dev = E.compile_ruleset(
                 self.cfg,
                 self.registry,
-                flow_rules=self.flow_rules.get(),
+                flow_rules=local_flow,
                 degrade_rules=self.degrade_rules.get(),
-                param_rules=self.param_flow_rules.get(),
+                param_rules=local_param,
                 authority_rules=self.authority_rules.get(),
                 system_rules=self.system_rules.get(),
             )
+
+    # -- cluster consultation -----------------------------------------------
+
+    def set_cluster(self, cluster_state_manager) -> None:
+        """Attach a ClusterStateManager; cluster-mode rules consult its
+        token service (client or embedded server role)."""
+        self.cluster = cluster_state_manager
+
+    def _enter_cluster_degraded(self) -> None:
+        """Token service unreachable: enforce fallback-enabled cluster rules
+        locally until a probe succeeds.  Idempotent — extends the cooldown
+        without recompiling if already degraded.  The flag flip and the
+        recompile are atomic under _cluster_lock so a concurrent exit/enter
+        pair can't commit a stale ruleset for the winning state."""
+        with self._cluster_lock:
+            self._cluster_degraded_until = (
+                _time.monotonic() + self.cluster_retry_interval_s
+            )
+            if not self._cluster_degraded_active:
+                self._cluster_degraded_active = True
+                self._recompile_rules()
+
+    def _exit_cluster_degraded(self) -> None:
+        with self._cluster_lock:
+            if self._cluster_degraded_active:
+                self._cluster_degraded_active = False
+                self._recompile_rules()
+
+    def _cluster_check(
+        self, resource: str, count: int, prioritized: bool, param_value
+    ) -> Tuple[int, int]:
+        """Consult the token service for cluster-mode rules on `resource`.
+
+        Returns (pre_verdict, wait_ms): pre_verdict > 0 forces a recorded
+        block; wait_ms > 0 means SHOULD_WAIT pacing before proceeding.
+
+        Degrade protocol: on transport failure (or namespace-guard overload,
+        which the reference also routes to fallbackToLocalOrPass), flip to
+        local enforcement of fallback-enabled cluster rules.  The fallback
+        rules STAY compiled through re-probes — only a successful probe
+        response drops them — so the token server being down never opens an
+        unenforced window.
+
+        Known divergence from the reference: this check runs before the
+        device-side authority/system gates (the reference's cluster check
+        sits inside FlowSlot, after them), so a request the engine will
+        block anyway still consumes a cluster token.  Cost is bounded by the
+        locally-blocked traffic share; folding the cluster verdict into the
+        tick would need a device round-trip per phase.
+        """
+        from sentinel_tpu.cluster import constants as CC
+
+        frule = self._cluster_flow_by_res.get(resource)
+        prule = self._cluster_param_by_res.get(resource)
+        if frule is None and prule is None:
+            return 0, 0
+        degraded = self._cluster_degraded_active
+        if degraded and _time.monotonic() < self._cluster_degraded_until:
+            return 0, 0  # cooling down; local fallback rules enforce
+        svc = self.cluster.token_service() if self.cluster is not None else None
+        if svc is None:
+            self._enter_cluster_degraded()
+            return 0, 0
+
+        wait_total = 0
+        responded = False
+        if frule is not None:
+            try:
+                r = svc.request_token(frule.cluster_flow_id, count, prioritized)
+            except Exception:
+                # any service failure degrades, never escapes to the caller
+                # (reference wraps acquisition → fallbackToLocalOrPass)
+                if frule.cluster_fallback_to_local:
+                    self._enter_cluster_degraded()
+                return 0, 0
+            if r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
+                # unreachable or overloaded server → local fallback
+                if frule.cluster_fallback_to_local:
+                    self._enter_cluster_degraded()
+                return 0, 0
+            responded = True
+            if r.status == CC.STATUS_BLOCKED:
+                if degraded:
+                    self._exit_cluster_degraded()
+                return ERR.BLOCK_FLOW, 0
+            if r.status == CC.STATUS_SHOULD_WAIT:
+                wait_total += r.wait_ms
+            # OK / NO_RULE → proceed
+
+        if prule is not None and param_value is not None:
+            try:
+                r = svc.request_param_token(prule.cluster_flow_id, count, [param_value])
+            except Exception:
+                self._enter_cluster_degraded()
+                return 0, wait_total
+            if r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
+                self._enter_cluster_degraded()
+                return 0, wait_total
+            responded = True
+            if r.status == CC.STATUS_BLOCKED:
+                if degraded:
+                    self._exit_cluster_degraded()
+                return ERR.BLOCK_PARAM, 0
+
+        if degraded and responded:
+            self._exit_cluster_degraded()  # probe succeeded: back to remote
+        return 0, wait_total
+
+    def _cluster_check_bulk(
+        self, resource: str, item_counts: List[int], param_value
+    ) -> Tuple[List[int], List[int]]:
+        """Bulk-path cluster consultation with partial grant: ONE
+        request_token_batch roundtrip covers all items of a (resource,
+        param) group; granted units are assigned to items greedily in
+        order.  Falls back to the same degrade protocol as _cluster_check.
+        """
+        from sentinel_tpu.cluster import constants as CC
+
+        n = len(item_counts)
+        verdicts, waits = [0] * n, [0] * n
+        frule = self._cluster_flow_by_res.get(resource)
+        prule = self._cluster_param_by_res.get(resource)
+        if frule is None and prule is None:
+            return verdicts, waits
+        degraded = self._cluster_degraded_active
+        if degraded and _time.monotonic() < self._cluster_degraded_until:
+            return verdicts, waits
+        svc = self.cluster.token_service() if self.cluster is not None else None
+        if svc is None:
+            self._enter_cluster_degraded()
+            return verdicts, waits
+
+        responded = False
+        if frule is not None:
+            total = sum(item_counts)
+            try:
+                r = svc.request_token_batch(frule.cluster_flow_id, total)
+            except Exception:
+                r = None
+            if r is None or r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
+                if frule.cluster_fallback_to_local:
+                    self._enter_cluster_degraded()
+                return verdicts, waits
+            responded = True
+            if r.status in (CC.STATUS_OK, CC.STATUS_SHOULD_WAIT, CC.STATUS_BLOCKED):
+                granted = r.remaining if r.status != CC.STATUS_BLOCKED else 0
+                acc = 0
+                for i, c in enumerate(item_counts):
+                    if acc + c <= granted:
+                        acc += c
+                        waits[i] = r.wait_ms
+                    else:
+                        verdicts[i] = ERR.BLOCK_FLOW
+            # NO_RULE → proceed
+
+        if prule is not None and param_value is not None:
+            live = [i for i in range(n) if verdicts[i] == 0]
+            if live:
+                total = sum(item_counts[i] for i in live)
+                try:
+                    r = svc.request_param_token(
+                        prule.cluster_flow_id, total, [param_value]
+                    )
+                except Exception:
+                    r = None
+                if r is None or r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
+                    self._enter_cluster_degraded()
+                    return verdicts, waits
+                responded = True
+                if r.status == CC.STATUS_BLOCKED:
+                    for i in live:
+                        verdicts[i] = ERR.BLOCK_PARAM
+
+        if degraded and responded:
+            self._exit_cluster_degraded()
+        return verdicts, waits
 
     # -- public entry API ---------------------------------------------------
 
@@ -308,10 +528,25 @@ class SentinelClient:
             ctx_id = -1
 
         param_hash = 0
+        param_value = None
         if args:
-            # hot-param limiting keys off the configured param index; host
-            # hashes the first arg by convention, adapters pass the right one
-            param_hash = hash_param(args[0])
+            # hot-param limiting keys off the rule's param index
+            # (ParamFlowRule.paramIdx); same index feeds both the engine
+            # hash and the cluster token request so healthy and degraded
+            # modes throttle the same argument
+            idx = self._param_idx_by_res.get(resource, 0)
+            if 0 <= idx < len(args):
+                param_value = args[idx]
+                param_hash = hash_param(param_value)
+
+        pre_verdict, cluster_wait = 0, 0
+        if self._cluster_flow_by_res or self._cluster_param_by_res:
+            pre_verdict, cluster_wait = self._cluster_check(
+                resource, count, prioritized, param_value
+            )
+        if cluster_wait > 0:
+            # SHOULD_WAIT: pace before entering (TokenResultStatus.SHOULD_WAIT)
+            self.time.sleep_ms(cluster_wait)
 
         req = AcquireRequest(
             res=rid,
@@ -323,6 +558,7 @@ class SentinelClient:
             ctx_name=ctx_id,
             inbound=1 if inbound else 0,
             param_hash=param_hash,
+            pre_verdict=pre_verdict,
             future=Future(),
         )
         with self._lock:
@@ -393,6 +629,24 @@ class SentinelClient:
 
         This is the TPU-native surface: N decisions in one tick.
         """
+        has_cluster = bool(self._cluster_flow_by_res or self._cluster_param_by_res)
+        # cluster consultation happens OUTSIDE self._lock (it may block on a
+        # token-server roundtrip, which must not stall the tick thread) and
+        # is AGGREGATED: one request_token per distinct (resource, param)
+        # group carrying the summed count — the protocol's count field exists
+        # exactly for this — instead of one roundtrip per item
+        pre_verdicts = [0] * len(resources)
+        pre_waits = [0] * len(resources)
+        if has_cluster:
+            groups: Dict[Tuple[str, Any], List[int]] = {}
+            for i, name in enumerate(resources):
+                if name in self._cluster_flow_by_res or name in self._cluster_param_by_res:
+                    groups.setdefault((name, params[i] if params else None), []).append(i)
+            for (name, pv), idxs in groups.items():
+                item_counts = [counts[i] if counts else 1 for i in idxs]
+                vs, ws = self._cluster_check_bulk(name, item_counts, pv)
+                for j, i in enumerate(idxs):
+                    pre_verdicts[i], pre_waits[i] = vs[j], ws[j]
         futures = []
         with self._lock:
             for i, name in enumerate(resources):
@@ -401,6 +655,7 @@ class SentinelClient:
                     futures.append(None)
                     continue
                 origin = origins[i] if origins else ""
+                pv = params[i] if params else None
                 req = AcquireRequest(
                     res=rid,
                     count=counts[i] if counts else 1,
@@ -412,7 +667,8 @@ class SentinelClient:
                     ctx_node=self.cfg.trash_row,
                     ctx_name=-1,
                     inbound=1 if inbound else 0,
-                    param_hash=hash_param(params[i]) if params else 0,
+                    param_hash=hash_param(pv) if pv is not None else 0,
+                    pre_verdict=pre_verdicts[i],
                     future=Future(),
                 )
                 self._acquires.append(req)
@@ -420,11 +676,15 @@ class SentinelClient:
         if self.mode == "sync":
             self.tick_once()
         out = []
-        for f in futures:
+        for i, f in enumerate(futures):
             if f is None:
                 out.append((ERR.PASS, 0))
-            else:
-                out.append(f.result(timeout=self.entry_timeout_s))
+                continue
+            v, w = f.result(timeout=self.entry_timeout_s)
+            if pre_waits[i] > 0 and v == ERR.PASS:
+                # cluster SHOULD_WAIT pacing surfaces to bulk callers too
+                v, w = ERR.PASS_WAIT, w + pre_waits[i]
+            out.append((v, w))
         return out
 
     def _submit_completion(self, c: Completion) -> None:
@@ -495,6 +755,7 @@ class SentinelClient:
                 ctx_name=jnp.asarray(arr("ctx_name", -1, np.int32)),
                 inbound=jnp.asarray(arr("inbound", 0, np.int32)),
                 param_hash=jnp.asarray(arr("param_hash", 0, np.int32)),
+                pre_verdict=jnp.asarray(arr("pre_verdict", 0, np.int32)),
             )
         c = E.empty_complete(cfg)
         if comp:

@@ -1,0 +1,353 @@
+"""Cluster token backend tests.
+
+Mirrors the reference's cluster test strategy (SURVEY.md §4.4): checker
+logic against in-memory state, codec round-trips, connection bookkeeping —
+plus a real localhost TCP server/client end-to-end loop the reference never
+had.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.rules import ClusterServerConfigManager, ServerFlowConfig
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.state import ClusterStateManager
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.core import rules as R
+from sentinel_tpu.utils.host_window import HostWindow
+
+
+def cluster_flow_rule(flow_id=101, count=5.0, threshold_type=C.FLOW_THRESHOLD_GLOBAL):
+    return R.FlowRule(
+        resource=f"res-{flow_id}",
+        count=count,
+        cluster_mode=True,
+        cluster_flow_id=flow_id,
+        cluster_threshold_type=threshold_type,
+    )
+
+
+# ---------------------------------------------------------------------------
+# codec round-trips (ParamFlowRequestDataWriterTest / FlowResponseDataDecoderTest)
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_flow_roundtrip():
+    req = P.ClusterRequest(xid=7, type=C.MSG_TYPE_FLOW, flow_id=12345678901, count=3, priority=True)
+    frames = P.FrameReader().feed(P.encode_request(req))
+    assert len(frames) == 1
+    got = P.decode_request(frames[0])
+    assert (got.xid, got.type, got.flow_id, got.count, got.priority) == (
+        7, C.MSG_TYPE_FLOW, 12345678901, 3, True,
+    )
+
+
+def test_protocol_param_roundtrip():
+    params = [42, 2**40, 3.5, "user-x", True]
+    req = P.ClusterRequest(xid=9, type=C.MSG_TYPE_PARAM_FLOW, flow_id=5, count=1, params=params)
+    got = P.decode_request(P.FrameReader().feed(P.encode_request(req))[0])
+    assert got.params == params
+
+
+def test_protocol_response_and_partial_frames():
+    rsp = P.ClusterResponse(xid=3, type=C.MSG_TYPE_FLOW, status=C.STATUS_SHOULD_WAIT,
+                            remaining=10, wait_ms=250)
+    raw = P.encode_response(rsp)
+    r = P.FrameReader()
+    assert r.feed(raw[:3]) == []  # partial frame buffers
+    frames = r.feed(raw[3:])
+    got = P.decode_response(frames[0])
+    assert (got.status, got.wait_ms, got.remaining) == (C.STATUS_SHOULD_WAIT, 250, 10)
+
+
+def test_protocol_concurrent_roundtrip():
+    req = P.ClusterRequest(xid=1, type=C.MSG_TYPE_CONCURRENT_RELEASE, token_id=99)
+    assert P.decode_request(P.FrameReader().feed(P.encode_request(req))[0]).token_id == 99
+    rsp = P.ClusterResponse(xid=1, type=C.MSG_TYPE_CONCURRENT_ACQUIRE,
+                            status=C.STATUS_OK, token_id=77)
+    assert P.decode_response(P.FrameReader().feed(P.encode_response(rsp))[0]).token_id == 77
+
+
+# ---------------------------------------------------------------------------
+# host window / namespace guard
+# ---------------------------------------------------------------------------
+
+
+def test_host_window_try_pass_and_expiry():
+    w = HostWindow(10, 1000)
+    t = 10_000
+    for _ in range(5):
+        assert w.try_pass(t, limit_qps=5.0)
+    assert not w.try_pass(t, limit_qps=5.0)
+    # window slides: 1.1 s later all buckets expired
+    assert w.try_pass(t + 1100, limit_qps=5.0)
+
+
+# ---------------------------------------------------------------------------
+# token service decisions (ClusterFlowCheckerTest analog)
+# ---------------------------------------------------------------------------
+
+
+def test_request_token_blocks_over_global_threshold(client, vt):
+    svc = DefaultTokenService(client)
+    svc.flow_rules.load("default", [cluster_flow_rule(count=5.0)])
+    got = [svc.request_token(101).status for _ in range(7)]
+    assert got.count(C.STATUS_OK) == 5
+    assert got.count(C.STATUS_BLOCKED) == 2
+    vt.advance(1100)  # window rolls → tokens replenish
+    assert svc.request_token(101).status == C.STATUS_OK
+
+
+def test_request_token_no_rule(client):
+    svc = DefaultTokenService(client)
+    assert svc.request_token(999).status == C.STATUS_NO_RULE
+
+
+def test_avg_local_threshold_scales_with_connections(client):
+    svc = DefaultTokenService(client)
+    svc.connected_count_fn = lambda ns: 3
+    svc.flow_rules.load(
+        "default", [cluster_flow_rule(count=2.0, threshold_type=C.FLOW_THRESHOLD_AVG_LOCAL)]
+    )
+    svc.refresh_connected_count()
+    got = [svc.request_token(101).status for _ in range(8)]
+    assert got.count(C.STATUS_OK) == 6  # 2 × 3 connections
+
+
+def test_namespace_guard_too_many(client):
+    cfgm = ClusterServerConfigManager()
+    cfgm.set_flow_config("default", ServerFlowConfig(max_allowed_qps=3.0))
+    svc = DefaultTokenService(client, config=cfgm)
+    svc.flow_rules.load("default", [cluster_flow_rule(count=100.0)])
+    got = [svc.request_token(101).status for _ in range(5)]
+    assert got.count(C.STATUS_OK) == 3
+    assert got.count(C.STATUS_TOO_MANY_REQUEST) == 2
+
+
+def test_param_token(client, vt):
+    svc = DefaultTokenService(client)
+    rule = R.ParamFlowRule(
+        resource="p", count=2.0, cluster_mode=True, cluster_flow_id=55, duration_in_sec=1
+    )
+    svc.param_rules.load("default", [rule])
+    assert svc.request_param_token(55, 1, ["alice"]).status == C.STATUS_OK
+    assert svc.request_param_token(55, 1, ["alice"]).status == C.STATUS_OK
+    assert svc.request_param_token(55, 1, ["alice"]).status == C.STATUS_BLOCKED
+    # different value has its own budget
+    assert svc.request_param_token(55, 1, ["bob"]).status == C.STATUS_OK
+
+
+def test_concurrent_tokens_and_expiry(client, vt):
+    svc = DefaultTokenService(client, concurrent_ttl_ms=1000)
+    svc.flow_rules.load("default", [cluster_flow_rule(count=2.0)])
+    r1 = svc.request_concurrent_token(101)
+    r2 = svc.request_concurrent_token(101)
+    assert r1.ok and r2.ok and r1.token_id != r2.token_id
+    assert svc.request_concurrent_token(101).blocked
+    assert svc.release_concurrent_token(r1.token_id).status == C.STATUS_RELEASE_OK
+    assert svc.release_concurrent_token(r1.token_id).status == C.STATUS_ALREADY_RELEASE
+    assert svc.request_concurrent_token(101).ok
+    # TTL sweep frees leaked tokens (RegularExpireStrategy)
+    vt.advance(1500)
+    svc.concurrent.expire(vt.now_ms())
+    assert svc.concurrent.current(101) == 0
+    assert svc.request_concurrent_token(101).ok
+
+
+# ---------------------------------------------------------------------------
+# TCP end-to-end (server + client over localhost)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tcp_cluster(client_factory):
+    decision = client_factory()
+    svc = DefaultTokenService(decision)
+    svc.flow_rules.load("default", [cluster_flow_rule(count=3.0)])
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0)
+    server.start()
+    tok = ClusterTokenClient("127.0.0.1", server.port, namespace="default", timeout_ms=5000)
+    tok.start()
+    yield server, tok, svc
+    tok.close()
+    server.stop()
+
+
+def test_tcp_token_roundtrip(tcp_cluster):
+    server, tok, svc = tcp_cluster
+    got = [tok.request_token(101).status for _ in range(5)]
+    assert got.count(C.STATUS_OK) == 3
+    assert got.count(C.STATUS_BLOCKED) == 2
+    assert tok.request_token(31337).status == C.STATUS_NO_RULE
+
+
+def test_tcp_connection_census(tcp_cluster):
+    server, tok, svc = tcp_cluster
+    deadline = time.monotonic() + 2
+    while server.connections.connected_count("default") < 1:
+        assert time.monotonic() < deadline, "PING registration not observed"
+        time.sleep(0.01)
+
+
+def test_tcp_token_batch_partial_grant(tcp_cluster):
+    """FLOW_BATCH: one roundtrip, server grants k of n units (limit 3)."""
+    server, tok, svc = tcp_cluster
+    r = tok.request_token_batch(101, 5)
+    assert r.status == C.STATUS_OK and r.remaining == 3
+    r2 = tok.request_token_batch(101, 5)
+    assert r2.status == C.STATUS_BLOCKED and r2.remaining == 0
+
+
+def test_tcp_concurrent_roundtrip(tcp_cluster):
+    server, tok, svc = tcp_cluster
+    r = tok.request_concurrent_token(101)
+    assert r.ok and r.token_id > 0
+    assert tok.release_concurrent_token(r.token_id).status == C.STATUS_RELEASE_OK
+
+
+def test_client_fail_fast_when_server_down():
+    tok = ClusterTokenClient("127.0.0.1", 1, timeout_ms=100, reconnect_interval_s=0.0)
+    assert tok.request_token(1).status == C.STATUS_FAIL
+
+
+# ---------------------------------------------------------------------------
+# runtime integration: embedded server + degrade-to-local
+# ---------------------------------------------------------------------------
+
+
+def test_embedded_cluster_entry_flow(client_factory):
+    app = client_factory()
+    decision = client_factory()
+    svc = DefaultTokenService(decision)
+    svc.flow_rules.load("default", [cluster_flow_rule(flow_id=101, count=2.0)])
+
+    mgr = ClusterStateManager()
+    mgr.set_to_server(svc, serve_network=False)
+    app.set_cluster(mgr)
+    rule = cluster_flow_rule(flow_id=101, count=2.0)
+    app.flow_rules.load([rule])
+
+    ok = blocked = 0
+    for _ in range(5):
+        try:
+            e = app.entry("res-101")
+            e.exit()
+            ok += 1
+        except ERR.FlowException:
+            blocked += 1
+    assert ok == 2 and blocked == 3
+    # blocks were recorded into the app's own stat windows (pre_verdict path)
+    s = app.stats.resource("res-101")
+    assert s["blockQps"] > 0
+
+
+def test_cluster_degrades_to_local_when_unavailable(client_factory):
+    app = client_factory()
+    mgr = ClusterStateManager()  # NOT_STARTED: no token service
+    app.set_cluster(mgr)
+    app.flow_rules.load([cluster_flow_rule(flow_id=7, count=2.0)])
+
+    ok = blocked = 0
+    for _ in range(5):
+        try:
+            app.entry("res-7").exit()
+            ok += 1
+        except ERR.FlowException:
+            blocked += 1
+    # degraded → the cluster rule enforces locally (fallbackToLocalOrPass)
+    assert ok == 2 and blocked == 3
+
+
+def test_check_batch_enforces_cluster_rules(client_factory):
+    """The bulk API must consult the token service too (not just entry())."""
+    app = client_factory()
+    decision = client_factory()
+    svc = DefaultTokenService(decision)
+    svc.flow_rules.load("default", [cluster_flow_rule(flow_id=501, count=2.0)])
+    mgr = ClusterStateManager()
+    mgr.set_to_server(svc, serve_network=False)
+    app.set_cluster(mgr)
+    app.flow_rules.load([cluster_flow_rule(flow_id=501, count=2.0)])
+
+    results = app.check_batch(["res-501"] * 5)
+    verdicts = [v for v, _ in results]
+    assert verdicts.count(ERR.PASS) == 2
+    assert verdicts.count(ERR.BLOCK_FLOW) == 3
+
+
+def test_too_many_request_degrades_to_local(client_factory):
+    """Namespace-guard overload must fall back to local enforcement, not
+    hard-block everything (applyTokenResult groups TOO_MANY with FAIL)."""
+    from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+
+    class OverloadedService(TokenService):
+        def request_token(self, flow_id, count=1, prioritized=False):
+            return TokenResult(C.STATUS_TOO_MANY_REQUEST)
+
+    class FakeMgr:
+        def token_service(self):
+            return OverloadedService()
+
+    app = client_factory()
+    app.set_cluster(FakeMgr())
+    app.flow_rules.load([cluster_flow_rule(flow_id=9, count=2.0)])
+
+    ok = blocked = 0
+    for _ in range(5):
+        try:
+            app.entry("res-9").exit()
+            ok += 1
+        except ERR.FlowException:
+            blocked += 1
+    # local fallback enforces count=2, nothing hard-blocks on TOO_MANY itself
+    assert ok == 2 and blocked == 3
+
+
+def test_degraded_probe_recovers_without_unenforced_window(client_factory):
+    """While degraded, fallback rules stay compiled through probes; a probe
+    response flips back to remote enforcement."""
+    from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+
+    class FlappingService(TokenService):
+        def __init__(self):
+            self.up = False
+            self.calls = 0
+
+        def request_token(self, flow_id, count=1, prioritized=False):
+            self.calls += 1
+            return TokenResult(C.STATUS_OK if self.up else C.STATUS_FAIL)
+
+    svc = FlappingService()
+
+    class Mgr:
+        def token_service(self):
+            return svc
+
+    app = client_factory()
+    app.set_cluster(Mgr())
+    app.cluster_retry_interval_s = 0.0  # every entry re-probes
+    app.flow_rules.load([cluster_flow_rule(flow_id=11, count=100.0)])
+
+    app.entry("res-11").exit()  # FAIL → degraded
+    assert app._cluster_degraded_active
+    app.entry("res-11").exit()  # probe still failing → stays degraded
+    assert app._cluster_degraded_active
+    svc.up = True
+    app.entry("res-11").exit()  # probe succeeds → back to remote
+    assert not app._cluster_degraded_active
+
+
+def test_cluster_no_fallback_passes_when_unavailable(client_factory):
+    app = client_factory()
+    app.set_cluster(ClusterStateManager())
+    r = cluster_flow_rule(flow_id=8, count=1.0)
+    r.cluster_fallback_to_local = False
+    app.flow_rules.load([r])
+    for _ in range(4):
+        app.entry("res-8").exit()  # no fallback → pass-through
