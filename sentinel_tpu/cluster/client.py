@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import itertools
 import socket
-import struct
 import threading
 import time as _time
 from concurrent.futures import Future
@@ -21,7 +20,11 @@ from typing import Any, Dict, List, Optional
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
-from sentinel_tpu.utils.record_log import record_log
+
+#: sentinel returned by _roundtrip for requests that can never be encoded
+#: (oversized params) — a client-side problem, NOT a server failure, so it
+#: must not flip the runtime into degraded mode
+_BAD_REQUEST = P.ClusterResponse(xid=-1, type=0, status=C.STATUS_BAD_REQUEST)
 
 
 class ClusterTokenClient(TokenService):
@@ -40,6 +43,9 @@ class ClusterTokenClient(TokenService):
         self.reconnect_interval_s = reconnect_interval_s
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # serializes sendall: concurrent partial writes from two threads
+        # would interleave mid-frame and desync the server's FrameReader
+        self._send_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
         self._xid_counter = itertools.count(0)
         self._reader: Optional[threading.Thread] = None
@@ -130,10 +136,12 @@ class ClusterTokenClient(TokenService):
                 self._teardown()
 
     def _send_nowait(self, req: P.ClusterRequest) -> None:
+        raw = P.encode_request(req)
         s = self._sock
         if s is None:
             raise OSError("not connected")
-        s.sendall(P.encode_request(req))
+        with self._send_lock:
+            s.sendall(raw)
 
     def _roundtrip(self, req: P.ClusterRequest) -> Optional[P.ClusterResponse]:
         if not self._ensure_connected():
@@ -141,15 +149,15 @@ class ClusterTokenClient(TokenService):
         try:
             raw = P.encode_request(req)
         except Exception:
-            # oversized payload / codec error → STATUS_FAIL, socket stays up
-            return None
+            return _BAD_REQUEST  # unencodable request; connection is fine
         f: Future = Future()
         self._pending[req.xid] = f
         try:
             s = self._sock
             if s is None:
                 raise OSError("not connected")
-            s.sendall(raw)
+            with self._send_lock:
+                s.sendall(raw)
         except OSError:
             self._pending.pop(req.xid, None)
             self._teardown()

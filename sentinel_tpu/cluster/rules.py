@@ -129,8 +129,11 @@ class ClusterFlowRuleManager:
         with self._lock:
             old = self._by_ns.get(namespace, [])
             for r in old:
-                self._by_id.pop(r.cluster_flow_id, None)
-                self._ns_by_id.pop(r.cluster_flow_id, None)
+                # only drop ids this namespace still owns — a flow id that
+                # was re-registered by another namespace stays live
+                if self._ns_by_id.get(r.cluster_flow_id) == namespace:
+                    self._by_id.pop(r.cluster_flow_id, None)
+                    self._ns_by_id.pop(r.cluster_flow_id, None)
             self._by_ns[namespace] = rules
             for r in rules:
                 self._by_id[r.cluster_flow_id] = r
@@ -166,8 +169,9 @@ class ClusterParamFlowRuleManager:
         with self._lock:
             old = self._by_ns.get(namespace, [])
             for r in old:
-                self._by_id.pop(r.cluster_flow_id, None)
-                self._ns_by_id.pop(r.cluster_flow_id, None)
+                if self._ns_by_id.get(r.cluster_flow_id) == namespace:
+                    self._by_id.pop(r.cluster_flow_id, None)
+                    self._ns_by_id.pop(r.cluster_flow_id, None)
             self._by_ns[namespace] = rules
             for r in rules:
                 self._by_id[r.cluster_flow_id] = r

@@ -78,11 +78,18 @@ class ClusterTokenServer:
         cfg = token_service.config.transport
         self.port = cfg.port if port is None else port
         self.idle_seconds = cfg.idle_seconds if idle_seconds is None else idle_seconds
-        self.connections = ConnectionManager(
-            on_change=token_service.refresh_connected_count
-        )
-        self.service.connected_count_fn = self.connections.connected_count
         self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="tok")
+        # census changes fire on the event loop (PING / disconnect); the
+        # reprojection they may trigger recompiles engine rules, so run it
+        # on the worker pool instead of stalling the loop
+        def _census_changed():
+            try:
+                self._pool.submit(token_service.refresh_connected_count)
+            except RuntimeError:
+                pass  # pool already shut down (server stopping)
+
+        self.connections = ConnectionManager(on_change=_census_changed)
+        self.service.connected_count_fn = self.connections.connected_count
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None

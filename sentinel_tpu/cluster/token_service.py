@@ -93,15 +93,29 @@ class GlobalRequestLimiter:
         self._windows: Dict[str, HostWindow] = {}
         self._lock = threading.Lock()
 
-    def try_pass(self, namespace: str, now_ms: int) -> bool:
+    def _window(self, namespace: str) -> HostWindow:
+        cfg = self._config.flow_config(namespace)
         w = self._windows.get(namespace)
-        if w is None:
+        if w is None or (w.sample_count, w.interval_ms) != (
+            cfg.sample_count,
+            cfg.interval_ms,
+        ):
+            # (re)build to the configured shape; a config push that reshapes
+            # the window restarts its accounting, like the reference's
+            # per-namespace RequestLimiter re-creation
             with self._lock:
-                w = self._windows.setdefault(
-                    namespace, HostWindow(C.DEFAULT_SAMPLE_COUNT, C.DEFAULT_INTERVAL_MS)
-                )
+                w = self._windows.get(namespace)
+                if w is None or (w.sample_count, w.interval_ms) != (
+                    cfg.sample_count,
+                    cfg.interval_ms,
+                ):
+                    w = HostWindow(cfg.sample_count, cfg.interval_ms)
+                    self._windows[namespace] = w
+        return w
+
+    def try_pass(self, namespace: str, now_ms: int) -> bool:
         limit = self._config.flow_config(namespace).max_allowed_qps
-        return w.try_pass(now_ms, limit)
+        return self._window(namespace).try_pass(now_ms, limit)
 
     def current_qps(self, namespace: str, now_ms: int) -> float:
         w = self._windows.get(namespace)
@@ -198,6 +212,8 @@ class DefaultTokenService(TokenService):
             flow = []
             for fid in self.flow_rules.all_ids():
                 rule = self.flow_rules.get_by_id(fid)
+                if rule is None:
+                    continue  # unloaded between snapshot and lookup
                 ns = self.flow_rules.namespace_of(fid) or C.DEFAULT_NAMESPACE
                 flow.append(
                     R.FlowRule(
@@ -209,6 +225,8 @@ class DefaultTokenService(TokenService):
             param = []
             for fid in self.param_rules.all_ids():
                 rule = self.param_rules.get_by_id(fid)
+                if rule is None:
+                    continue
                 param.append(
                     R.ParamFlowRule(
                         resource=param_resource(fid),
@@ -223,8 +241,17 @@ class DefaultTokenService(TokenService):
             self.client.param_flow_rules.load(param)
 
     def refresh_connected_count(self) -> None:
-        """Call when the connection census changes (AVG_LOCAL scaling)."""
-        self._reproject()
+        """Call when the connection census changes.  Only AVG_LOCAL rules
+        scale with the census — with purely GLOBAL rules this is a no-op,
+        so a churning client fleet doesn't trigger recompiles."""
+        has_avg_local = any(
+            r is not None and r.cluster_threshold_type != C.FLOW_THRESHOLD_GLOBAL
+            for r in (
+                self.flow_rules.get_by_id(fid) for fid in self.flow_rules.all_ids()
+            )
+        )
+        if has_avg_local:
+            self._reproject()
 
     # -- TokenService --------------------------------------------------------
 

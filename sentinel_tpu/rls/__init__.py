@@ -1,0 +1,22 @@
+"""Envoy Rate Limit Service (RLS) front door.
+
+Wire-compatible reimplementation of the reference's
+sentinel-cluster-server-envoy-rls module (SURVEY.md §2.5): an Envoy proxy
+configured with a gRPC rate_limit_service can point at
+``SentinelRlsGrpcServer`` and get cluster-wide token decisions from the
+TPU decision engine.
+"""
+
+from sentinel_tpu.rls.rules import (  # noqa: F401
+    EnvoyRlsRule,
+    EnvoyRlsRuleManager,
+    RlsKeyValue,
+    RlsResourceDescriptor,
+)
+
+__all__ = [
+    "EnvoyRlsRule",
+    "EnvoyRlsRuleManager",
+    "RlsKeyValue",
+    "RlsResourceDescriptor",
+]

@@ -1,0 +1,105 @@
+"""Envoy RLS gRPC server.
+
+Implements ``envoy.service.ratelimit.v2.RateLimitService/ShouldRateLimit``
+(reference: SentinelEnvoyRlsServiceImpl.java + SentinelRlsGrpcServer.java):
+each request descriptor resolves to a cluster flowId via the rule manager
+and is checked through the engine-backed token service; any over-limit
+descriptor makes the overall verdict OVER_LIMIT.
+
+grpcio is present in this image but grpc_tools (stub codegen) is not, so
+the service is registered through a generic handler with the protoc-built
+message classes — same wire behavior as a generated servicer.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import grpc
+
+from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.rls import rls_pb2 as pb
+from sentinel_tpu.rls.rules import EnvoyRlsRuleManager
+
+SERVICE_NAME = "envoy.service.ratelimit.v2.RateLimitService"
+
+
+class SentinelEnvoyRlsService:
+    """The ShouldRateLimit decision logic (unary-unary)."""
+
+    def __init__(self, token_service, rule_manager: Optional[EnvoyRlsRuleManager] = None):
+        self.token_service = token_service
+        self.rules = rule_manager or EnvoyRlsRuleManager(token_service)
+
+    def should_rate_limit(self, request: pb.RateLimitRequest, context=None) -> pb.RateLimitResponse:
+        hits = request.hits_addend or 1
+        rsp = pb.RateLimitResponse()
+        overall = pb.RateLimitResponse.OK
+        for desc in request.descriptors:
+            entries = [(e.key, e.value) for e in desc.entries]
+            fid = self.rules.lookup_flow_id(request.domain, entries)
+            status = rsp.statuses.add()
+            if fid is None:
+                # no rule for this descriptor → not limited (reference
+                # returns OK for unmatched descriptors)
+                status.code = pb.RateLimitResponse.OK
+                continue
+            r = self.token_service.request_token(fid, hits, False)
+            if r.status == C.STATUS_OK:
+                status.code = pb.RateLimitResponse.OK
+                status.limit_remaining = max(r.remaining, 0)
+            else:
+                status.code = pb.RateLimitResponse.OVER_LIMIT
+                overall = pb.RateLimitResponse.OVER_LIMIT
+        rsp.overall_code = overall
+        return rsp
+
+
+class SentinelRlsGrpcServer:
+    """gRPC front door (SentinelRlsGrpcServer.java analog)."""
+
+    def __init__(
+        self,
+        token_service,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        workers: int = 8,
+        rule_manager: Optional[EnvoyRlsRuleManager] = None,
+    ):
+        self.service = SentinelEnvoyRlsService(token_service, rule_manager)
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=workers))
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE_NAME,
+            {
+                "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
+                    self.service.should_rate_limit,
+                    request_deserializer=pb.RateLimitRequest.FromString,
+                    response_serializer=pb.RateLimitResponse.SerializeToString,
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    @property
+    def rules(self) -> EnvoyRlsRuleManager:
+        return self.service.rules
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+def make_channel_stub(address: str):
+    """Client-side helper: callable for ShouldRateLimit on a channel
+    (tests and smoke checks; Envoy itself is the production client)."""
+    channel = grpc.insecure_channel(address)
+    fn = channel.unary_unary(
+        f"/{SERVICE_NAME}/ShouldRateLimit",
+        request_serializer=pb.RateLimitRequest.SerializeToString,
+        response_deserializer=pb.RateLimitResponse.FromString,
+    )
+    return channel, fn

@@ -1,0 +1,91 @@
+"""Envoy RLS gRPC service tests (SURVEY.md §4.5 analog — but over a real
+in-process gRPC channel rather than mocked observers)."""
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.rls import rls_pb2 as pb
+from sentinel_tpu.rls.rules import (
+    EnvoyRlsRule,
+    RlsKeyValue,
+    RlsResourceDescriptor,
+    descriptor_identifier,
+    identifier_flow_id,
+)
+from sentinel_tpu.rls.server import SentinelEnvoyRlsService, SentinelRlsGrpcServer, make_channel_stub
+
+
+def make_rule(domain="mesh", key="dest", value="svc-a", count=3.0):
+    return EnvoyRlsRule(
+        domain=domain,
+        descriptors=[
+            RlsResourceDescriptor(key_values=[RlsKeyValue(key, value)], count=count)
+        ],
+    )
+
+
+def make_request(domain="mesh", entries=(("dest", "svc-a"),), hits=1):
+    req = pb.RateLimitRequest(domain=domain, hits_addend=hits)
+    d = req.descriptors.add()
+    for k, v in entries:
+        e = d.entries.add()
+        e.key, e.value = k, v
+    return req
+
+
+def test_identifier_stability_and_order_independence():
+    a = descriptor_identifier("d", [("k1", "v1"), ("k2", "v2")])
+    b = descriptor_identifier("d", [("k2", "v2"), ("k1", "v1")])
+    assert a == b
+    assert identifier_flow_id(a) == identifier_flow_id(b) > 0
+
+
+def test_should_rate_limit_inproc(client):
+    svc = DefaultTokenService(client)
+    rls = SentinelEnvoyRlsService(svc)
+    rls.rules.load([make_rule(count=2.0)])
+
+    codes = [rls.should_rate_limit(make_request()).overall_code for _ in range(4)]
+    assert codes.count(pb.RateLimitResponse.OK) == 2
+    assert codes.count(pb.RateLimitResponse.OVER_LIMIT) == 2
+
+    # unmatched descriptor → OK (no rule)
+    r = rls.should_rate_limit(make_request(entries=(("dest", "unknown"),)))
+    assert r.overall_code == pb.RateLimitResponse.OK
+
+
+def test_hits_addend_consumes_multiple_tokens(client):
+    svc = DefaultTokenService(client)
+    rls = SentinelEnvoyRlsService(svc)
+    rls.rules.load([make_rule(count=5.0)])
+    assert (
+        rls.should_rate_limit(make_request(hits=5)).overall_code
+        == pb.RateLimitResponse.OK
+    )
+    assert (
+        rls.should_rate_limit(make_request(hits=1)).overall_code
+        == pb.RateLimitResponse.OVER_LIMIT
+    )
+
+
+def test_grpc_server_end_to_end(client_factory):
+    decision = client_factory()
+    svc = DefaultTokenService(decision)
+    server = SentinelRlsGrpcServer(svc, host="127.0.0.1", port=0)
+    server.rules.load([make_rule(count=2.0)])
+    server.start()
+    try:
+        channel, call = make_channel_stub(f"127.0.0.1:{server.port}")
+        codes = [call(make_request()).overall_code for _ in range(4)]
+        channel.close()
+        assert codes.count(pb.RateLimitResponse.OK) == 2
+        assert codes.count(pb.RateLimitResponse.OVER_LIMIT) == 2
+    finally:
+        server.stop()
+
+
+def test_rule_dict_roundtrip():
+    rule = make_rule()
+    assert EnvoyRlsRule.from_dict(rule.to_dict()) == rule
