@@ -93,29 +93,31 @@ class GlobalRequestLimiter:
         self._windows: Dict[str, HostWindow] = {}
         self._lock = threading.Lock()
 
-    def _window(self, namespace: str) -> HostWindow:
-        cfg = self._config.flow_config(namespace)
+    def _window(self, namespace: str, cfg) -> HostWindow:
+        # a pushed config is unvalidated: round interval up to a multiple of
+        # sample_count instead of letting HostWindow's divisibility assert
+        # fire on the request hot path
+        sample_count = max(int(cfg.sample_count), 1)
+        interval_ms = max(int(cfg.interval_ms), sample_count)
+        interval_ms = ((interval_ms + sample_count - 1) // sample_count) * sample_count
         w = self._windows.get(namespace)
-        if w is None or (w.sample_count, w.interval_ms) != (
-            cfg.sample_count,
-            cfg.interval_ms,
-        ):
+        if w is None or (w.sample_count, w.interval_ms) != (sample_count, interval_ms):
             # (re)build to the configured shape; a config push that reshapes
             # the window restarts its accounting, like the reference's
             # per-namespace RequestLimiter re-creation
             with self._lock:
                 w = self._windows.get(namespace)
                 if w is None or (w.sample_count, w.interval_ms) != (
-                    cfg.sample_count,
-                    cfg.interval_ms,
+                    sample_count,
+                    interval_ms,
                 ):
-                    w = HostWindow(cfg.sample_count, cfg.interval_ms)
+                    w = HostWindow(sample_count, interval_ms)
                     self._windows[namespace] = w
         return w
 
     def try_pass(self, namespace: str, now_ms: int) -> bool:
-        limit = self._config.flow_config(namespace).max_allowed_qps
-        return self._window(namespace).try_pass(now_ms, limit)
+        cfg = self._config.flow_config(namespace)
+        return self._window(namespace, cfg).try_pass(now_ms, cfg.max_allowed_qps)
 
     def current_qps(self, namespace: str, now_ms: int) -> float:
         w = self._windows.get(namespace)

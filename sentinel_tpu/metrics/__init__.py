@@ -1,0 +1,31 @@
+"""Observability plane: metric log writer/searcher, per-second aggregation,
+block log, and the external-metrics callback SPI (SURVEY §3.5)."""
+
+from sentinel_tpu.metrics.node import MetricNode
+from sentinel_tpu.metrics.writer import MetricWriter, list_metric_files, metric_file_base
+from sentinel_tpu.metrics.searcher import MetricSearcher
+from sentinel_tpu.metrics.timer import MetricTimerListener
+from sentinel_tpu.metrics.block_log import BlockLogger, default_block_logger
+from sentinel_tpu.metrics.extension import (
+    MetricExtension,
+    register_extension,
+    unregister_extension,
+    clear_extensions,
+    get_extensions,
+)
+
+__all__ = [
+    "MetricNode",
+    "MetricWriter",
+    "MetricSearcher",
+    "MetricTimerListener",
+    "BlockLogger",
+    "default_block_logger",
+    "MetricExtension",
+    "register_extension",
+    "unregister_extension",
+    "clear_extensions",
+    "get_extensions",
+    "list_metric_files",
+    "metric_file_base",
+]

@@ -1,0 +1,71 @@
+"""Block-event log — rate-limited ``sentinel-block.log``.
+
+The analog of LogSlot → EagleEyeLogUtil.java:24-36 backed by the embedded
+EagleEye StatLogger: every blocked request is recorded, but writes are
+aggregated per (resource, exception, origin) per second so a block storm
+costs one line per distinct key per second, not one line per request.
+
+Aggregation is inline (flushed when the wall second advances) instead of
+the reference's async appender thread — the host tick loop already gives
+us a natural cadence and this keeps the writer allocation-free.
+
+Line format:  timestamp|resource|exceptionName|count|origin
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class BlockLogger:
+    def __init__(self, base_dir: str, filename: str = "sentinel-block.log"):
+        os.makedirs(base_dir, exist_ok=True)
+        self.path = os.path.join(base_dir, filename)
+        self._lock = threading.Lock()
+        self._cur_sec = -1
+        self._pending: Dict[Tuple[str, str, str], int] = {}
+
+    def log(self, now_ms: int, resource: str, exception_name: str, origin: str = "", count: int = 1) -> None:
+        sec = now_ms // 1000
+        with self._lock:
+            if sec != self._cur_sec:
+                self._flush_locked()
+                self._cur_sec = sec
+            key = (resource, exception_name, origin)
+            self._pending[key] = self._pending.get(key, 0) + count
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._pending:
+            return
+        ts = self._cur_sec * 1000
+        lines = [
+            f"{ts}|{res}|{exc}|{cnt}|{origin}\n"
+            for (res, exc, origin), cnt in self._pending.items()
+        ]
+        self._pending.clear()
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.writelines(lines)
+        except OSError:
+            pass
+
+
+_default: Optional[BlockLogger] = None
+_default_lock = threading.Lock()
+
+
+def default_block_logger() -> BlockLogger:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                from sentinel_tpu.utils.record_log import log_dir
+
+                _default = BlockLogger(log_dir())
+    return _default

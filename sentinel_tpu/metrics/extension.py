@@ -1,0 +1,65 @@
+"""Metric extension SPI — external-metrics callbacks (Prometheus-style).
+
+The analog of metric/extension/MetricExtension.java +
+MetricCallbackInit.java: registered extensions get a callback on every
+pass / block / completion so users can bridge verdict telemetry into their
+own metrics system.  Callbacks run on the caller thread and must be cheap;
+when no extension is registered the hot path pays one truthiness check.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+
+class MetricExtension:
+    """Subclass and override what you need; all hooks default to no-ops."""
+
+    def on_pass(self, resource: str, count: int, origin: str, args: Optional[Sequence] = None) -> None:
+        pass
+
+    def on_block(
+        self,
+        resource: str,
+        count: int,
+        origin: str,
+        block_exception: BaseException,
+        args: Optional[Sequence] = None,
+    ) -> None:
+        pass
+
+    def on_complete(self, resource: str, rt_ms: float, success: int, origin: str) -> None:
+        pass
+
+    def on_exception(self, resource: str, count: int, origin: str) -> None:
+        pass
+
+    def on_thread_change(self, resource: str, delta: int) -> None:
+        pass
+
+
+_lock = threading.Lock()
+_extensions: List[MetricExtension] = []
+
+
+def register_extension(ext: MetricExtension) -> None:
+    with _lock:
+        _extensions.append(ext)
+
+
+def unregister_extension(ext: MetricExtension) -> None:
+    with _lock:
+        try:
+            _extensions.remove(ext)
+        except ValueError:
+            pass
+
+
+def clear_extensions() -> None:
+    with _lock:
+        _extensions.clear()
+
+
+def get_extensions() -> List[MetricExtension]:
+    return _extensions  # read without lock: list is replaced-in-place rarely

@@ -87,6 +87,7 @@ class EnvoyRlsRuleManager:
         self._lock = threading.Lock()
         self._rules: List[EnvoyRlsRule] = []
         self._id_by_identifier: Dict[str, int] = {}
+        self._loaded_namespaces: set = set()
 
     def load(self, rules: List[EnvoyRlsRule]) -> None:
         with self._lock:
@@ -109,8 +110,13 @@ class EnvoyRlsRuleManager:
                             cluster_threshold_type=1,  # GLOBAL
                         )
                     )
+            # clear namespaces dropped by this push, or their old flow rules
+            # stay enforced in the token service forever
+            for ns in self._loaded_namespaces - set(by_ns):
+                self._svc.flow_rules.load(ns, [])
             for ns, flow_rules in by_ns.items():
                 self._svc.flow_rules.load(ns, flow_rules)
+            self._loaded_namespaces = set(by_ns)
 
     def get(self) -> List[EnvoyRlsRule]:
         return list(self._rules)

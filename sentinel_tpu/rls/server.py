@@ -46,7 +46,10 @@ class SentinelEnvoyRlsService:
                 status.code = pb.RateLimitResponse.OK
                 continue
             r = self.token_service.request_token(fid, hits, False)
-            if r.status == C.STATUS_OK:
+            if r.status in (C.STATUS_OK, C.STATUS_NO_RULE):
+                # NO_RULE happens when a concurrent rule push removed the
+                # flow id between lookup and check — unmatched descriptors
+                # fail open, same as the fid-is-None path above
                 status.code = pb.RateLimitResponse.OK
                 status.limit_remaining = max(r.remaining, 0)
             else:

@@ -43,6 +43,7 @@ from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.runtime import context as CTX
 from sentinel_tpu.runtime.registry import Registry
+from sentinel_tpu.metrics import extension as MEXT
 from sentinel_tpu.utils.system_status import SystemStatusSampler
 from sentinel_tpu.utils.time_source import TimeSource, VirtualTimeSource
 
@@ -121,6 +122,13 @@ class Entry:
             return  # pass-through entry (capacity overflow)
         now = self.client.time.now_ms()
         rt = float(max(now - self.create_ms, 0))
+        exts = MEXT.get_extensions()
+        if exts:
+            n = count if count is not None else self.count
+            for x in exts:
+                x.on_complete(self.resource, rt, n, "")
+                if self._errors:
+                    x.on_exception(self.resource, self._errors, "")
         self.client._submit_completion(
             Completion(
                 res=self.res,
@@ -201,6 +209,9 @@ class SentinelClient:
         mode: str = "threaded",  # "threaded" | "sync"
         tick_interval_ms: float = 1.0,
         entry_timeout_s: float = 5.0,
+        metric_log: bool = False,
+        metric_log_dir: Optional[str] = None,
+        block_log: bool = False,
     ):
         from sentinel_tpu.core.config import app_name as cfg_app_name
 
@@ -249,6 +260,16 @@ class SentinelClient:
         self._started = False
         self.stats = ClientStats(self)
 
+        # observability plane (MetricTimerListener / EagleEye block log)
+        self._metric_log_enabled = metric_log
+        self._metric_log_dir = metric_log_dir
+        self.metric_timer = None
+        self.block_log = None
+        if block_log:
+            from sentinel_tpu.metrics.block_log import default_block_logger
+
+            self.block_log = default_block_logger()
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
@@ -268,12 +289,26 @@ class SentinelClient:
                 daemon=True,
             )
             self._thread.start()
+        if self._metric_log_enabled and self.metric_timer is None:
+            from sentinel_tpu.metrics.timer import MetricTimerListener
+            from sentinel_tpu.metrics.writer import MetricWriter
+            from sentinel_tpu.utils.record_log import log_dir
+
+            writer = MetricWriter(self._metric_log_dir or log_dir(), self.app_name)
+            self.metric_timer = MetricTimerListener(self, writer)
+            if self.mode == "threaded":
+                self.metric_timer.start()
 
     def stop(self) -> None:
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self.metric_timer is not None:
+            self.metric_timer.stop()
+            self.metric_timer = None
+        if self.block_log is not None:
+            self.block_log.flush()
         self._started = False
 
     # -- rule compilation ---------------------------------------------------
@@ -397,7 +432,11 @@ class SentinelClient:
                 if frule.cluster_fallback_to_local:
                     self._enter_cluster_degraded()
                 return 0, 0
-            responded = True
+            # BAD_REQUEST is synthesized client-side without touching the
+            # network — it proves nothing about server health, so it must
+            # not count as a successful probe out of degraded mode
+            if r.status != CC.STATUS_BAD_REQUEST:
+                responded = True
             if r.status == CC.STATUS_BLOCKED:
                 if degraded:
                     self._exit_cluster_degraded()
@@ -415,7 +454,8 @@ class SentinelClient:
             if r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
                 self._enter_cluster_degraded()
                 return 0, wait_total
-            responded = True
+            if r.status != CC.STATUS_BAD_REQUEST:
+                responded = True
             if r.status == CC.STATUS_BLOCKED:
                 if degraded:
                     self._exit_cluster_degraded()
@@ -460,7 +500,8 @@ class SentinelClient:
                 if frule.cluster_fallback_to_local:
                     self._enter_cluster_degraded()
                 return verdicts, waits
-            responded = True
+            if r.status != CC.STATUS_BAD_REQUEST:
+                responded = True
             if r.status in (CC.STATUS_OK, CC.STATUS_SHOULD_WAIT, CC.STATUS_BLOCKED):
                 granted = r.remaining if r.status != CC.STATUS_BLOCKED else 0
                 acc = 0
@@ -485,7 +526,8 @@ class SentinelClient:
                 if r is None or r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
                     self._enter_cluster_degraded()
                     return verdicts, waits
-                responded = True
+                if r.status != CC.STATUS_BAD_REQUEST:
+                    responded = True
                 if r.status == CC.STATUS_BLOCKED:
                     for i in live:
                         verdicts[i] = ERR.BLOCK_PARAM
@@ -569,10 +611,25 @@ class SentinelClient:
         verdict, wait_ms = req.future.result(timeout=self.entry_timeout_s)
 
         if verdict not in (ERR.PASS, ERR.PASS_WAIT):
-            # record nothing extra here: the engine already counted the block
-            ERR.raise_for_verdict(verdict, resource)
+            # the engine already counted the block; here only the
+            # observability side-channels fire (block log + extension SPI)
+            exc_cls = ERR.EXCEPTION_BY_CODE.get(int(verdict), ERR.BlockException)
+            exc = exc_cls(resource)
+            if self.block_log is not None:
+                self.block_log.log(
+                    self.time.wall_ms(), resource, exc_cls.__name__, origin or "", count
+                )
+            exts = MEXT.get_extensions()
+            if exts:
+                for x in exts:
+                    x.on_block(resource, count, origin or "", exc, args)
+            raise exc
         if verdict == ERR.PASS_WAIT and wait_ms > 0:
             self.time.sleep_ms(wait_ms)
+        exts = MEXT.get_extensions()
+        if exts:
+            for x in exts:
+                x.on_pass(resource, count, origin or "", args)
 
         e = Entry(
             self,
@@ -826,6 +883,42 @@ class ClientStats:
         if rid is None:
             return None
         return self._row_stats(rid)
+
+    def snapshot(self, now_ms: Optional[int] = None) -> Dict[str, Dict[str, float]]:
+        """Trailing-second stats for ALL registered resources in ONE batched
+        device gather — the TPU-shaped walk of the ClusterNode map that
+        MetricTimerListener does per second."""
+        c = self._c
+        resources = c.registry.resources()
+        if not resources:
+            return {}
+        names = list(resources.keys())
+        rows_np = np.asarray(list(resources.values()), dtype=np.int32)
+        rows = jnp.asarray(rows_np)
+        sec_cfg = W.WindowConfig(c.cfg.second_sample_count, c.cfg.second_window_ms)
+        now = jnp.int32(c.time.now_ms() if now_ms is None else now_ms)
+        with c._engine_lock:
+            st = c._state
+            counts = np.asarray(W.gather_window_counts(st.win_sec, now, rows, sec_cfg))
+            rt_tot, rt_min = W.gather_window_rt(st.win_sec, now, rows, sec_cfg)
+            conc = np.asarray(st.concurrency)[rows_np]
+        rt_tot = np.asarray(rt_tot)
+        rt_min = np.asarray(rt_min)
+        interval_s = sec_cfg.interval_ms / 1000.0
+        out: Dict[str, Dict[str, float]] = {}
+        for i, name in enumerate(names):
+            succ = float(counts[i, W.EV_SUCCESS])
+            out[name] = {
+                "passQps": float(counts[i, W.EV_PASS]) / interval_s,
+                "blockQps": float(counts[i, W.EV_BLOCK]) / interval_s,
+                "successQps": succ / interval_s,
+                "exceptionQps": float(counts[i, W.EV_EXCEPTION]) / interval_s,
+                "occupiedPassQps": float(counts[i, W.EV_OCCUPIED]) / interval_s,
+                "avgRt": float(rt_tot[i]) / succ if succ > 0 else 0.0,
+                "minRt": float(rt_min[i]),
+                "curThreadNum": int(conc[i]),
+            }
+        return out
 
     def entry_node(self) -> Dict[str, float]:
         return self._row_stats(self._c.cfg.entry_node_row)

@@ -1,0 +1,167 @@
+"""Observability plane tests: metric log writer/searcher round-trip, the
+per-second aggregation timer, the rate-limited block log, and the metric
+extension callback SPI (reference: MetricWriter/MetricSearcher tests,
+MetricTimerListener.java:34-59, EagleEyeLogUtil.java:24-36,
+metric/extension/MetricExtension.java)."""
+
+import os
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.metrics import (
+    BlockLogger,
+    MetricExtension,
+    MetricNode,
+    MetricSearcher,
+    MetricTimerListener,
+    MetricWriter,
+    clear_extensions,
+    list_metric_files,
+    register_extension,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_extensions():
+    clear_extensions()
+    yield
+    clear_extensions()
+
+
+def test_metric_node_line_roundtrip():
+    n = MetricNode(
+        timestamp=1700000000000,
+        resource="GET:/api/v1|weird name",
+        pass_qps=20,
+        block_qps=3,
+        success_qps=19,
+        exception_qps=1,
+        rt=12.5,
+        occupied_pass_qps=0,
+        concurrency=4,
+        classification=1,
+    )
+    back = MetricNode.from_line(n.to_line())
+    assert back == n
+
+
+def test_writer_searcher_roundtrip(tmp_path):
+    w = MetricWriter(str(tmp_path), "app1", single_file_size=10_000)
+    t0 = 1700000000000
+    for sec in range(10):
+        nodes = [
+            MetricNode(resource="resA", pass_qps=sec + 1, success_qps=sec + 1),
+            MetricNode(resource="resB", block_qps=2),
+            MetricNode(resource="idle"),  # inactive → skipped
+        ]
+        w.write(t0 + sec * 1000, nodes)
+    w.close()
+
+    s = MetricSearcher(str(tmp_path), "app1")
+    found = s.find(t0)
+    assert len(found) == 20  # 2 active nodes × 10 s
+    assert all(n.resource != "idle" for n in found)
+
+    # seek into the middle of the range
+    mid = s.find(t0 + 5000)
+    assert len(mid) == 10
+    assert min(n.timestamp for n in mid) == t0 + 5000
+
+    by_res = s.find_by_time_and_resource(t0, t0 + 3000, "resA")
+    assert [n.pass_qps for n in by_res] == [1, 2, 3, 4]
+
+    # recommended_count never truncates mid-second
+    few = s.find(t0, recommended_count=3)
+    assert len(few) == 4
+    assert len({n.timestamp for n in few}) == 2
+
+
+def test_writer_rolls_and_trims(tmp_path):
+    w = MetricWriter(str(tmp_path), "app2", single_file_size=500, total_file_count=3)
+    t0 = 1700000000000
+    for sec in range(40):
+        w.write(t0 + sec * 1000, [MetricNode(resource="r", pass_qps=1)])
+    w.close()
+    files = list_metric_files(str(tmp_path), "app2")
+    assert 1 <= len(files) <= 3
+    # idx exists for every kept file
+    for f in files:
+        assert os.path.exists(f + ".idx")
+
+
+def test_metric_timer_aggregates_from_engine(client, vt, tmp_path):
+    client.flow_rules.load([st.FlowRule(resource="timed", count=100)])
+    timer = MetricTimerListener(client, MetricWriter(str(tmp_path), "app3"))
+    for _ in range(5):
+        vt.advance(100)
+        with client.entry("timed"):
+            vt.advance(10)
+    written = timer.run_once()
+    assert written == 1
+    timer.writer.close()
+    found = MetricSearcher(str(tmp_path), "app3").find(0)
+    assert len(found) == 1
+    node = found[0]
+    assert node.resource == "timed"
+    assert node.pass_qps == 5
+    assert node.success_qps == 5
+    assert node.rt > 0
+
+
+def test_block_logger_aggregates_per_second(tmp_path):
+    bl = BlockLogger(str(tmp_path))
+    for i in range(100):
+        bl.log(5000, "res1", "FlowException", "web")
+    bl.log(5000, "res2", "DegradeException")
+    bl.log(6200, "res1", "FlowException", "web")  # second advances → flush
+    bl.flush()
+    lines = open(bl.path).read().strip().split("\n")
+    assert "5000|res1|FlowException|100|web" in lines
+    assert "5000|res2|DegradeException|1|" in lines
+    assert "6000|res1|FlowException|1|web" in lines
+
+
+class _Capture(MetricExtension):
+    def __init__(self):
+        self.events = []
+
+    def on_pass(self, resource, count, origin, args=None):
+        self.events.append(("pass", resource, count))
+
+    def on_block(self, resource, count, origin, exc, args=None):
+        self.events.append(("block", resource, type(exc).__name__))
+
+    def on_complete(self, resource, rt_ms, success, origin):
+        self.events.append(("complete", resource, success))
+
+    def on_exception(self, resource, count, origin):
+        self.events.append(("exception", resource, count))
+
+
+def test_metric_extension_callbacks(client, vt):
+    cap = _Capture()
+    register_extension(cap)
+    client.flow_rules.load([st.FlowRule(resource="ext", count=1)])
+    with client.entry("ext"):
+        client.trace(ValueError("biz"))
+    with pytest.raises(st.BlockException):
+        client.entry("ext")
+    kinds = [e[0] for e in cap.events]
+    assert kinds == ["pass", "complete", "exception", "block"]
+    assert ("block", "ext", "FlowException") in cap.events
+
+
+def test_client_block_log_wiring(client_factory, vt, tmp_path, monkeypatch):
+    import sentinel_tpu.metrics.block_log as BL
+
+    monkeypatch.setattr(BL, "_default", None)
+    monkeypatch.setenv("CSP_SENTINEL_LOG_DIR", str(tmp_path))
+    c = client_factory(block_log=True)
+    c.flow_rules.load([st.FlowRule(resource="blk", count=0)])
+    with pytest.raises(st.BlockException):
+        c.entry("blk")
+    c.block_log.flush()
+    content = open(c.block_log.path).read()
+    assert "blk|FlowException|1|" in content
+    monkeypatch.setattr(BL, "_default", None)
