@@ -78,9 +78,13 @@ EXCEPTION_BY_CODE = {
 }
 
 
+def exception_for_verdict(code: int, resource: str) -> BlockException:
+    """The BlockException instance matching a nonzero verdict code."""
+    return EXCEPTION_BY_CODE.get(int(code), BlockException)(resource)
+
+
 def raise_for_verdict(code: int, resource: str, wait_ms: int = 0) -> None:
     """Raise the BlockException matching a nonzero verdict code."""
     if code == PASS or code == PASS_WAIT:
         return
-    exc = EXCEPTION_BY_CODE.get(int(code), BlockException)
-    raise exc(resource)
+    raise exception_for_verdict(code, resource)

@@ -12,6 +12,7 @@ from sentinel_tpu.metrics.extension import (
     unregister_extension,
     clear_extensions,
     get_extensions,
+    safe_dispatch,
 )
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "unregister_extension",
     "clear_extensions",
     "get_extensions",
+    "safe_dispatch",
     "list_metric_files",
     "metric_file_base",
 ]

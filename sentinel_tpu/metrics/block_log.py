@@ -20,9 +20,17 @@ from typing import Dict, Optional, Tuple
 
 
 class BlockLogger:
-    def __init__(self, base_dir: str, filename: str = "sentinel-block.log"):
+    def __init__(
+        self,
+        base_dir: str,
+        filename: str = "sentinel-block.log",
+        max_file_size: int = 50 * 1024 * 1024,
+        backup_count: int = 3,
+    ):
         os.makedirs(base_dir, exist_ok=True)
         self.path = os.path.join(base_dir, filename)
+        self.max_file_size = max_file_size
+        self.backup_count = backup_count
         self._lock = threading.Lock()
         self._cur_sec = -1
         self._pending: Dict[Tuple[str, str, str], int] = {}
@@ -50,10 +58,25 @@ class BlockLogger:
         ]
         self._pending.clear()
         try:
+            self._roll_if_needed()
             with open(self.path, "a", encoding="utf-8") as f:
                 f.writelines(lines)
         except OSError:
             pass
+
+    def _roll_if_needed(self) -> None:
+        """Size-capped rotation (EagleEyeRollingFileAppender analog):
+        block.log → block.log.1 → … → block.log.{backup_count} → dropped."""
+        try:
+            if os.path.getsize(self.path) < self.max_file_size:
+                return
+        except OSError:
+            return
+        for i in range(self.backup_count - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        os.replace(self.path, f"{self.path}.1")
 
 
 _default: Optional[BlockLogger] = None

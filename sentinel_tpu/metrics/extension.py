@@ -44,22 +44,39 @@ _extensions: List[MetricExtension] = []
 
 
 def register_extension(ext: MetricExtension) -> None:
+    global _extensions
     with _lock:
-        _extensions.append(ext)
+        _extensions = _extensions + [ext]
 
 
 def unregister_extension(ext: MetricExtension) -> None:
+    global _extensions
     with _lock:
-        try:
-            _extensions.remove(ext)
-        except ValueError:
-            pass
+        _extensions = [x for x in _extensions if x is not ext]
 
 
 def clear_extensions() -> None:
+    global _extensions
     with _lock:
-        _extensions.clear()
+        _extensions = []
 
 
 def get_extensions() -> List[MetricExtension]:
-    return _extensions  # read without lock: list is replaced-in-place rarely
+    # copy-on-write: registration rebinds a fresh list under the lock, so
+    # lock-free readers always iterate an immutable snapshot
+    return _extensions
+
+
+def safe_dispatch(hook: str, *args) -> None:
+    """Invoke one hook on every registered extension, isolating failures —
+    a throwing user extension must never corrupt engine accounting."""
+    exts = _extensions
+    if not exts:
+        return
+    for x in exts:
+        try:
+            getattr(x, hook)(*args)
+        except Exception:  # noqa: BLE001
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().exception("metric extension %s.%s failed", type(x).__name__, hook)

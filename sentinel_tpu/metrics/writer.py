@@ -134,7 +134,14 @@ class MetricWriter:
         self._trim_old_files()
 
     def _trim_old_files(self) -> None:
-        files = list_metric_files(self.base_dir, self.app_name)
+        # trim ONLY this process's files: another live process of the same
+        # app owns its pid-named files and may have one open for append
+        own_prefix = metric_file_base(self.app_name) + "."
+        files = [
+            f
+            for f in list_metric_files(self.base_dir, self.app_name)
+            if os.path.basename(f).startswith(own_prefix)
+        ]
         excess = len(files) - self.total_file_count
         for path in files[: max(excess, 0)]:
             if path == self._cur_path:

@@ -92,7 +92,9 @@ class EnvoyRlsRuleManager:
     def load(self, rules: List[EnvoyRlsRule]) -> None:
         with self._lock:
             self._rules = list(rules)
-            self._id_by_identifier = {}
+            # build the lookup aside and publish once: lookup_flow_id reads
+            # without the lock, so it must never see a half-populated map
+            id_by_identifier: Dict[str, int] = {}
             by_ns: Dict[str, List[R.FlowRule]] = {}
             for rule in rules:
                 for desc in rule.descriptors:
@@ -100,7 +102,7 @@ class EnvoyRlsRuleManager:
                         rule.domain, [(kv.key, kv.value) for kv in desc.key_values]
                     )
                     fid = identifier_flow_id(ident)
-                    self._id_by_identifier[ident] = fid
+                    id_by_identifier[ident] = fid
                     by_ns.setdefault(rule.domain, []).append(
                         R.FlowRule(
                             resource=ident,
@@ -117,6 +119,7 @@ class EnvoyRlsRuleManager:
             for ns, flow_rules in by_ns.items():
                 self._svc.flow_rules.load(ns, flow_rules)
             self._loaded_namespaces = set(by_ns)
+            self._id_by_identifier = id_by_identifier
 
     def get(self) -> List[EnvoyRlsRule]:
         return list(self._rules)

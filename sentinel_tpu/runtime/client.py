@@ -122,13 +122,10 @@ class Entry:
             return  # pass-through entry (capacity overflow)
         now = self.client.time.now_ms()
         rt = float(max(now - self.create_ms, 0))
-        exts = MEXT.get_extensions()
-        if exts:
-            n = count if count is not None else self.count
-            for x in exts:
-                x.on_complete(self.resource, rt, n, "")
-                if self._errors:
-                    x.on_exception(self.resource, self._errors, "")
+        n = count if count is not None else self.count
+        MEXT.safe_dispatch("on_complete", self.resource, rt, n, "")
+        if self._errors:
+            MEXT.safe_dispatch("on_exception", self.resource, self._errors, "")
         self.client._submit_completion(
             Completion(
                 res=self.res,
@@ -221,6 +218,10 @@ class SentinelClient:
         self.mode = mode if not isinstance(self.time, VirtualTimeSource) else "sync"
         self.tick_interval_ms = tick_interval_ms
         self.entry_timeout_s = entry_timeout_s
+
+        # global protection switch (Constants.ON / OnOffSetCommandHandler):
+        # when off, every entry is a pass-through and nothing is counted
+        self.enabled = True
 
         self.registry = Registry(self.cfg)
         self.flow_rules = RuleManager(self, "flow")
@@ -548,6 +549,10 @@ class SentinelClient:
         origin: Optional[str] = None,
     ) -> Entry:
         """Acquire; raises BlockException on rejection (SphU.entry)."""
+        if not self.enabled:
+            e = _PassThroughEntry(self, resource)
+            CTX.push_entry(e)
+            return e
         ctx_name, ctx_origin = CTX.current()
         origin = origin if origin is not None else ctx_origin
         rid = self.registry.resource_id(resource)
@@ -613,23 +618,16 @@ class SentinelClient:
         if verdict not in (ERR.PASS, ERR.PASS_WAIT):
             # the engine already counted the block; here only the
             # observability side-channels fire (block log + extension SPI)
-            exc_cls = ERR.EXCEPTION_BY_CODE.get(int(verdict), ERR.BlockException)
-            exc = exc_cls(resource)
+            exc = ERR.exception_for_verdict(verdict, resource)
             if self.block_log is not None:
                 self.block_log.log(
-                    self.time.wall_ms(), resource, exc_cls.__name__, origin or "", count
+                    self.time.wall_ms(), resource, type(exc).__name__, origin or "", count
                 )
-            exts = MEXT.get_extensions()
-            if exts:
-                for x in exts:
-                    x.on_block(resource, count, origin or "", exc, args)
+            MEXT.safe_dispatch("on_block", resource, count, origin or "", exc, args)
             raise exc
         if verdict == ERR.PASS_WAIT and wait_ms > 0:
             self.time.sleep_ms(wait_ms)
-        exts = MEXT.get_extensions()
-        if exts:
-            for x in exts:
-                x.on_pass(resource, count, origin or "", args)
+        MEXT.safe_dispatch("on_pass", resource, count, origin or "", args)
 
         e = Entry(
             self,
@@ -686,6 +684,8 @@ class SentinelClient:
 
         This is the TPU-native surface: N decisions in one tick.
         """
+        if not self.enabled:
+            return [(ERR.PASS, 0)] * len(resources)
         has_cluster = bool(self._cluster_flow_by_res or self._cluster_param_by_res)
         # cluster consultation happens OUTSIDE self._lock (it may block on a
         # token-server roundtrip, which must not stall the tick thread) and

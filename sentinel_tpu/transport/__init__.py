@@ -1,0 +1,48 @@
+"""Transport / command plane (SURVEY §2.4): per-instance HTTP command
+center, built-in command handlers, heartbeat to the dashboard, and the
+writable-datasource write-back registry."""
+
+from sentinel_tpu.transport.command import (
+    CommandRegistry,
+    CommandRequest,
+    CommandResponse,
+    command_mapping,
+)
+from sentinel_tpu.transport.handlers import DefaultHandlerGroup, build_default_handlers
+from sentinel_tpu.transport.http_server import DEFAULT_PORT, SimpleHttpCommandCenter
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+from sentinel_tpu.transport.writable_registry import (
+    WritableDataSourceRegistry,
+    default_registry,
+)
+
+
+def start_command_center(
+    client,
+    cluster=None,
+    metric_searcher=None,
+    writable_registry=None,
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+) -> SimpleHttpCommandCenter:
+    """Build the default handler set and serve it (CommandCenterInitFunc)."""
+    registry = build_default_handlers(client, cluster, metric_searcher, writable_registry)
+    center = SimpleHttpCommandCenter(registry, host=host, port=port)
+    center.start()
+    return center
+
+
+__all__ = [
+    "CommandRegistry",
+    "CommandRequest",
+    "CommandResponse",
+    "command_mapping",
+    "DefaultHandlerGroup",
+    "build_default_handlers",
+    "SimpleHttpCommandCenter",
+    "HeartbeatSender",
+    "WritableDataSourceRegistry",
+    "default_registry",
+    "start_command_center",
+    "DEFAULT_PORT",
+]

@@ -1,0 +1,238 @@
+"""Built-in command handlers — the analog of the ~20 handlers in
+sentinel-transport-common/.../command/handler/ (ModifyRulesCommandHandler,
+FetchActiveRuleCommandHandler, SendMetricCommandHandler, FetchJsonTree...,
+FetchClusterNode..., ModifyClusterMode..., OnOffSet..., BasicInfo...).
+
+All handlers are methods on one group object bound to a SentinelClient so
+the registry stays explicit and testable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from sentinel_tpu.core import rules as R
+from sentinel_tpu.transport.command import (
+    CommandRegistry,
+    CommandRequest,
+    CommandResponse,
+    command_mapping,
+)
+
+#: command rule-type value → SentinelClient manager attribute
+RULE_TYPE_TO_MANAGER = {
+    "flow": "flow_rules",
+    "degrade": "degrade_rules",
+    "system": "system_rules",
+    "authority": "authority_rules",
+    "paramFlow": "param_flow_rules",
+}
+
+#: command rule-type value → converter kind (core.rules codec)
+RULE_TYPE_TO_KIND = {
+    "flow": "flow",
+    "degrade": "degrade",
+    "system": "system",
+    "authority": "authority",
+    "paramFlow": "param-flow",
+}
+
+
+class DefaultHandlerGroup:
+    def __init__(self, client, cluster=None, metric_searcher=None, writable_registry=None):
+        self.client = client
+        self.cluster = cluster
+        self.metric_searcher = metric_searcher
+        self.writable_registry = writable_registry
+
+    # -- info ---------------------------------------------------------------
+
+    @command_mapping("version", "framework version")
+    def version(self, req: CommandRequest) -> CommandResponse:
+        import sentinel_tpu
+
+        return CommandResponse.of_success(getattr(sentinel_tpu, "__version__", "0.1.0"))
+
+    @command_mapping("basicInfo", "app/runtime basic info")
+    def basic_info(self, req: CommandRequest) -> CommandResponse:
+        c = self.client
+        return CommandResponse.of_success(
+            {
+                "appName": c.app_name,
+                "pid": os.getpid(),
+                "mode": c.mode,
+                "enabled": c.enabled,
+                "maxResources": c.cfg.max_resources,
+                "registeredResources": c.registry.num_resources,
+            }
+        )
+
+    @command_mapping("api", "list available commands")
+    def api(self, req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(
+            [{"name": n, "desc": d} for n, d in self._registry.names()]
+        )
+
+    # -- rules --------------------------------------------------------------
+
+    def _manager(self, type_: Optional[str]):
+        attr = RULE_TYPE_TO_MANAGER.get(type_ or "")
+        return getattr(self.client, attr) if attr else None
+
+    @command_mapping("getRules", "fetch active rules by type")
+    def get_rules(self, req: CommandRequest) -> CommandResponse:
+        type_ = req.param("type")
+        mgr = self._manager(type_)
+        if mgr is None:
+            return CommandResponse.of_failure(f"invalid type: {type_}")
+        return CommandResponse.of_success(R.rules_to_json_list(mgr.get()))
+
+    @command_mapping("setRules", "replace active rules by type")
+    def set_rules(self, req: CommandRequest) -> CommandResponse:
+        type_ = req.param("type")
+        mgr = self._manager(type_)
+        if mgr is None:
+            return CommandResponse.of_failure(f"invalid type: {type_}")
+        data = req.param("data") or req.body or "[]"
+        rules = R.rules_from_json_list(RULE_TYPE_TO_KIND[type_], json.loads(data))
+        mgr.load(rules)
+        # write-through to the registered writable datasource, so pushed
+        # rules survive restart (WritableDataSourceRegistry semantics)
+        if self.writable_registry is not None:
+            self.writable_registry.write(RULE_TYPE_TO_KIND[type_], rules)
+        return CommandResponse.of_success("success")
+
+    @command_mapping("getParamFlowRules", "fetch hot-param rules")
+    def get_param_rules(self, req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success(
+            R.rules_to_json_list(self.client.param_flow_rules.get())
+        )
+
+    # -- metrics ------------------------------------------------------------
+
+    @command_mapping("metric", "query metric log lines by time range")
+    def metric(self, req: CommandRequest) -> CommandResponse:
+        if self.metric_searcher is None:
+            return CommandResponse.of_success("")
+        start = int(req.param("startTime", "0"))
+        end = req.param("endTime")
+        identity = req.param("identity")
+        max_lines = int(req.param("maxLines", "6000"))
+        if end or identity:
+            nodes = self.metric_searcher.find_by_time_and_resource(
+                start, int(end) if end else 2**62, identity
+            )[:max_lines]
+        else:
+            nodes = self.metric_searcher.find(start, max_lines)
+        return CommandResponse.of_success("\n".join(n.to_line() for n in nodes))
+
+    @command_mapping("clusterNode", "per-resource statistics snapshot")
+    def cluster_node(self, req: CommandRequest) -> CommandResponse:
+        snap = self.client.stats.snapshot()
+        out = [dict(resource=name, **s) for name, s in snap.items()]
+        return CommandResponse.of_success(out)
+
+    @command_mapping("origin", "per-origin statistics for one resource")
+    def origin(self, req: CommandRequest) -> CommandResponse:
+        res = req.param("id")
+        if not res:
+            return CommandResponse.of_failure("id is required")
+        out = []
+        for (kind, key), row in self.client.registry.extra_rows().items():
+            if kind != "origin":
+                continue
+            r, _, origin = key.partition("\x00")
+            if r == res:
+                s = self.client.stats._row_stats(row)
+                out.append(dict(resource=res, origin=origin, **s))
+        return CommandResponse.of_success(out)
+
+    @command_mapping("jsonTree", "invocation tree with live stats")
+    def json_tree(self, req: CommandRequest) -> CommandResponse:
+        c = self.client
+        root = dict(resource="machine-root", **c.stats.entry_node(), children=[])
+        snap = c.stats.snapshot()
+        origins = {}
+        for (kind, key), row in c.registry.extra_rows().items():
+            if kind == "origin":
+                r, _, origin = key.partition("\x00")
+                origins.setdefault(r, []).append((origin, row))
+        for name, s in snap.items():
+            node = dict(resource=name, **s, children=[])
+            for origin, row in origins.get(name, []):
+                node["children"].append(
+                    dict(resource=f"{name}|{origin}", origin=origin, **c.stats._row_stats(row))
+                )
+            root["children"].append(node)
+        return CommandResponse.of_success(root)
+
+    @command_mapping("systemStatus", "system adaptive-protection inputs")
+    def system_status(self, req: CommandRequest) -> CommandResponse:
+        load, cpu = self.client._sys.sample()
+        entry = self.client.stats.entry_node()
+        return CommandResponse.of_success(
+            {
+                "load": load,
+                "cpuUsage": cpu,
+                "qps": entry["passQps"],
+                "avgRt": entry["avgRt"],
+                "threadNum": entry["curThreadNum"],
+            }
+        )
+
+    # -- switches -----------------------------------------------------------
+
+    @command_mapping("setSwitch", "turn entry protection on/off")
+    def set_switch(self, req: CommandRequest) -> CommandResponse:
+        value = (req.param("value") or "").lower()
+        if value not in ("true", "false"):
+            return CommandResponse.of_failure("value must be true|false")
+        self.client.enabled = value == "true"
+        return CommandResponse.of_success("success")
+
+    @command_mapping("getSwitch", "read the protection switch")
+    def get_switch(self, req: CommandRequest) -> CommandResponse:
+        return CommandResponse.of_success({"enabled": self.client.enabled})
+
+    # -- cluster ------------------------------------------------------------
+
+    @command_mapping("getClusterMode", "cluster role of this instance")
+    def get_cluster_mode(self, req: CommandRequest) -> CommandResponse:
+        if self.cluster is None:
+            return CommandResponse.of_success({"mode": 0, "available": False})
+        return CommandResponse.of_success(
+            {"mode": self.cluster.mode, "available": self.cluster.is_available()}
+        )
+
+    @command_mapping("setClusterMode", "flip cluster role (0=client 1=server)")
+    def set_cluster_mode(self, req: CommandRequest) -> CommandResponse:
+        """ModifyClusterModeCommandHandler analog. Becoming a server needs a
+        DefaultTokenService; the instance keeps its last one, so the flip is
+        client↔server with the wiring established at setup time."""
+        if self.cluster is None:
+            return CommandResponse.of_failure("cluster not configured")
+        from sentinel_tpu.cluster import state as CS
+
+        mode = int(req.param("mode", "-99"))
+        if mode == CS.CLUSTER_CLIENT:
+            self.cluster.set_to_client()
+        elif mode == CS.CLUSTER_SERVER:
+            svc = self.cluster._embedded
+            if svc is None:
+                return CommandResponse.of_failure("no token service configured for server mode")
+            self.cluster.set_to_server(svc)
+        else:
+            return CommandResponse.of_failure(f"invalid mode: {mode}")
+        return CommandResponse.of_success("success")
+
+
+def build_default_handlers(
+    client, cluster=None, metric_searcher=None, writable_registry=None
+) -> CommandRegistry:
+    registry = CommandRegistry()
+    group = DefaultHandlerGroup(client, cluster, metric_searcher, writable_registry)
+    group._registry = registry  # for the "api" listing handler
+    registry.register_group(group)
+    return registry

@@ -1,0 +1,103 @@
+"""Heartbeat sender — registers this instance with the dashboard.
+
+The analog of SimpleHttpHeartbeatSender.java:61 + HeartbeatSenderInitFunc:
+a daemon loop POSTs ``/registry/machine`` on every configured dashboard
+address at a fixed interval, carrying app/ip/port/hostname/version, so the
+dashboard's machine discovery stays fresh.  Failures rotate to the next
+dashboard address and never propagate.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import urllib.parse
+import urllib.request
+from typing import List, Optional
+
+DEFAULT_INTERVAL_S = 10.0
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+class HeartbeatSender:
+    def __init__(
+        self,
+        app_name: str,
+        command_port: int,
+        dashboard_addresses: List[str],
+        interval_s: float = DEFAULT_INTERVAL_S,
+        ip: Optional[str] = None,
+    ):
+        self.app_name = app_name
+        self.command_port = command_port
+        self.addresses = [a.strip() for a in dashboard_addresses if a.strip()]
+        self.interval_s = interval_s
+        self.ip = ip or _local_ip()
+        self.hostname = socket.gethostname()
+        self._idx = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.sent_ok = 0
+        self.sent_fail = 0
+
+    def start(self) -> None:
+        if self._thread is not None or not self.addresses:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="sentinel-tpu-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def send_once(self, timeout_s: float = 3.0) -> bool:
+        """One heartbeat to the current dashboard address; rotates on failure."""
+        import sentinel_tpu
+
+        params = urllib.parse.urlencode(
+            {
+                "app": self.app_name,
+                "ip": self.ip,
+                "port": self.command_port,
+                "pid": os.getpid(),
+                "hostname": self.hostname,
+                "version": getattr(sentinel_tpu, "__version__", "0.1.0"),
+            }
+        )
+        addr = self.addresses[self._idx % len(self.addresses)]
+        url = f"http://{addr}/registry/machine"
+        try:
+            req = urllib.request.Request(
+                url, data=params.encode("ascii"), method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=timeout_s) as rsp:
+                ok = 200 <= rsp.status < 300
+        except OSError:
+            ok = False
+        if ok:
+            self.sent_ok += 1
+        else:
+            self.sent_fail += 1
+            self._idx += 1  # rotate to the next dashboard address
+        return ok
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.send_once()

@@ -1,0 +1,108 @@
+"""HTTP command center — the per-instance command plane.
+
+The analog of sentinel-transport-simple-http's SimpleHttpCommandCenter:
+a small HTTP/1.1 server (stdlib ThreadingHTTPServer — the reference
+hand-rolls one on ServerSocket) exposing every registered command at
+``GET/POST /<commandName>``.  Default port 8719; when taken, the port
+auto-increments, as TransportConfig does.
+
+Responses: JSON for structured results, text/plain for strings; failures
+get HTTP 400 with the message.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from sentinel_tpu.transport.command import CommandRegistry, CommandRequest
+
+DEFAULT_PORT = 8719
+MAX_PORT_PROBES = 100
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: CommandRegistry = None  # set by server factory
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to command log
+        from sentinel_tpu.utils.record_log import command_center_log
+
+        command_center_log().info("%s - %s", self.address_string(), fmt % args)
+
+    def _dispatch(self, body: str = "") -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        name = parsed.path.strip("/")
+        params = {k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        if body and "=" in body and not body.lstrip().startswith(("[", "{")):
+            # form-encoded POST body merges into params (data=... uploads)
+            for k, v in urllib.parse.parse_qs(body).items():
+                params.setdefault(k, v[-1])
+            body = params.get("data", body)
+        rsp = self.registry.handle(name, CommandRequest(parameters=params, body=body))
+        if rsp.success:
+            if isinstance(rsp.result, str):
+                payload = rsp.result.encode("utf-8")
+                ctype = "text/plain; charset=utf-8"
+            else:
+                payload = json.dumps(rsp.result).encode("utf-8")
+                ctype = "application/json; charset=utf-8"
+            self.send_response(200)
+        else:
+            payload = str(rsp.result).encode("utf-8")
+            ctype = "text/plain; charset=utf-8"
+            self.send_response(400)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._dispatch()
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length).decode("utf-8") if length else ""
+        self._dispatch(body)
+
+
+class SimpleHttpCommandCenter:
+    def __init__(self, registry: CommandRegistry, host: str = "0.0.0.0", port: int = DEFAULT_PORT):
+        self.registry = registry
+        self.host = host
+        self.requested_port = port
+        self.port: Optional[int] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        handler = type("BoundHandler", (_Handler,), {"registry": self.registry})
+        last_err = None
+        for probe in range(MAX_PORT_PROBES):
+            try:
+                self._server = ThreadingHTTPServer((self.host, self.requested_port + probe), handler)
+                break
+            except OSError as e:
+                last_err = e
+        if self._server is None:
+            raise OSError(f"no free command-center port near {self.requested_port}: {last_err}")
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sentinel-tpu-command-center", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self.port = None

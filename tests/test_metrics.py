@@ -152,6 +152,25 @@ def test_metric_extension_callbacks(client, vt):
     assert ("block", "ext", "FlowException") in cap.events
 
 
+class _Thrower(MetricExtension):
+    def on_pass(self, *a, **k):
+        raise RuntimeError("ext boom")
+
+    def on_complete(self, *a, **k):
+        raise RuntimeError("ext boom")
+
+
+def test_throwing_extension_does_not_corrupt_accounting(client, vt):
+    register_extension(_Thrower())
+    client.flow_rules.load([st.FlowRule(resource="boom", count=100)])
+    with client.entry("boom"):
+        vt.advance(5)
+    s = client.stats.resource("boom")
+    # success recorded and concurrency drained despite the throwing hooks
+    assert s["successQps"] >= 1
+    assert s["curThreadNum"] == 0
+
+
 def test_client_block_log_wiring(client_factory, vt, tmp_path, monkeypatch):
     import sentinel_tpu.metrics.block_log as BL
 

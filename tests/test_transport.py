@@ -1,0 +1,176 @@
+"""Command-plane tests: handler dispatch, the HTTP command center over a
+real socket, heartbeat formatting, and write-back to a writable datasource
+(reference: sentinel-transport-common handler tests +
+SimpleHttpCommandCenter)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource.base import FileWritableDataSource
+from sentinel_tpu.datasource.converters import json_rule_encoder
+from sentinel_tpu.transport import (
+    SimpleHttpCommandCenter,
+    WritableDataSourceRegistry,
+    build_default_handlers,
+)
+from sentinel_tpu.transport.command import CommandRequest
+
+
+@pytest.fixture()
+def registry(client):
+    return build_default_handlers(client)
+
+
+def _call(registry, name, **params):
+    return registry.handle(name, CommandRequest(parameters={k: str(v) for k, v in params.items()}))
+
+
+def test_version_and_basic_info(registry, client):
+    assert _call(registry, "version").success
+    info = _call(registry, "basicInfo").result
+    assert info["appName"] == client.app_name
+    assert info["enabled"] is True
+
+
+def test_unknown_command(registry):
+    rsp = _call(registry, "nope")
+    assert not rsp.success
+
+
+def test_get_set_rules_roundtrip(registry, client):
+    data = json.dumps([{"resource": "cmd-res", "count": 5}])
+    assert _call(registry, "setRules", type="flow", data=data).success
+    assert client.flow_rules.get()[0].count == 5
+    got = _call(registry, "getRules", type="flow").result
+    assert got[0]["resource"] == "cmd-res"
+    assert not _call(registry, "setRules", type="bogus", data=data).success
+
+
+def test_set_rules_write_back(client, tmp_path):
+    wreg = WritableDataSourceRegistry()
+    path = tmp_path / "flow.json"
+    wreg.register("flow", FileWritableDataSource(str(path), json_rule_encoder))
+    registry = build_default_handlers(client, writable_registry=wreg)
+    data = json.dumps([{"resource": "persisted", "count": 9}])
+    assert _call(registry, "setRules", type="flow", data=data).success
+    on_disk = json.loads(path.read_text())
+    assert on_disk[0]["resource"] == "persisted"
+
+
+def test_switch_gates_entries(registry, client, vt):
+    client.flow_rules.load([st.FlowRule(resource="sw", count=0)])
+    with pytest.raises(st.BlockException):
+        client.entry("sw")
+    assert _call(registry, "setSwitch", value="false").success
+    with client.entry("sw"):  # switch off → pass-through
+        pass
+    assert _call(registry, "getSwitch").result == {"enabled": False}
+    _call(registry, "setSwitch", value="true")
+    with pytest.raises(st.BlockException):
+        client.entry("sw")
+
+
+def test_cluster_node_and_json_tree(registry, client, vt):
+    client.flow_rules.load([st.FlowRule(resource="treed", count=100)])
+    with client.context("ctx-a", origin="caller-1"):
+        with client.entry("treed", origin="caller-1"):
+            vt.advance(5)
+    nodes = _call(registry, "clusterNode").result
+    named = {n["resource"]: n for n in nodes}
+    assert named["treed"]["passQps"] >= 1
+    tree = _call(registry, "jsonTree").result
+    assert tree["resource"] == "machine-root"
+    treed = [c for c in tree["children"] if c["resource"] == "treed"][0]
+    origins = [c["origin"] for c in treed["children"]]
+    assert origins == ["caller-1"]
+    per_origin = _call(registry, "origin", id="treed").result
+    assert per_origin[0]["origin"] == "caller-1"
+
+
+def test_metric_command(client, vt, tmp_path):
+    from sentinel_tpu.metrics import MetricSearcher, MetricTimerListener, MetricWriter
+
+    client.flow_rules.load([st.FlowRule(resource="m", count=10)])
+    with client.entry("m"):
+        pass
+    timer = MetricTimerListener(client, MetricWriter(str(tmp_path), "tapp"))
+    timer.run_once()
+    timer.writer.close()
+    registry = build_default_handlers(
+        client, metric_searcher=MetricSearcher(str(tmp_path), "tapp")
+    )
+    out = _call(registry, "metric", startTime=0).result
+    assert "|m|" in out
+    by_id = _call(registry, "metric", startTime=0, identity="m").result
+    assert "|m|" in by_id
+    assert _call(registry, "metric", startTime=0, identity="absent").result == ""
+
+
+def test_http_command_center_end_to_end(client):
+    center = SimpleHttpCommandCenter(build_default_handlers(client), host="127.0.0.1", port=0)
+    center.start()
+    try:
+        base = f"http://127.0.0.1:{center.port}"
+        with urllib.request.urlopen(f"{base}/basicInfo", timeout=3) as rsp:
+            assert rsp.status == 200
+            assert json.loads(rsp.read())["appName"] == client.app_name
+        # POST form-encoded setRules (the dashboard's push shape)
+        body = urllib.parse.urlencode(
+            {"type": "flow", "data": json.dumps([{"resource": "http-res", "count": 3}])}
+        ).encode()
+        req = urllib.request.Request(f"{base}/setRules", data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=3) as rsp:
+            assert rsp.read() == b"success"
+        assert client.flow_rules.get()[0].resource == "http-res"
+        # unknown command → 400
+        try:
+            urllib.request.urlopen(f"{base}/bogus", timeout=3)
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 400
+        assert raised
+    finally:
+        center.stop()
+
+
+def test_heartbeat_against_local_receiver(client):
+    """Heartbeat posts land on an HTTP receiver (a stand-in dashboard)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    seen = []
+
+    class Recv(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            seen.append((self.path, self.rfile.read(n).decode()))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Recv)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        from sentinel_tpu.transport import HeartbeatSender
+
+        hb = HeartbeatSender(
+            client.app_name, 8719, [f"127.0.0.1:{srv.server_address[1]}"]
+        )
+        assert hb.send_once()
+        path, body = seen[0]
+        assert path == "/registry/machine"
+        params = dict(urllib.parse.parse_qsl(body))
+        assert params["app"] == client.app_name
+        assert params["port"] == "8719"
+        assert hb.sent_ok == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
