@@ -1,0 +1,24 @@
+"""Dashboard-lite (SURVEY §2.6): machine discovery via heartbeats, metric
+pull + in-memory repository, rule CRUD proxied to each machine's command
+plane, cluster role assignment — the control plane, minus the AngularJS UI."""
+
+from sentinel_tpu.dashboard.api_client import SentinelApiClient
+from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
+from sentinel_tpu.dashboard.metric_fetcher import MetricFetcher
+from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+from sentinel_tpu.dashboard.server import (
+    DashboardServer,
+    DynamicRuleProvider,
+    DynamicRulePublisher,
+)
+
+__all__ = [
+    "SentinelApiClient",
+    "AppManagement",
+    "MachineInfo",
+    "MetricFetcher",
+    "InMemoryMetricsRepository",
+    "DashboardServer",
+    "DynamicRuleProvider",
+    "DynamicRulePublisher",
+]

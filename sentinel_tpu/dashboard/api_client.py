@@ -1,0 +1,82 @@
+"""Dashboard-side client for each instance's command plane.
+
+The analog of SentinelApiClient.java:93-121: every dashboard operation on a
+machine (fetch/modify rules, pull metrics, read the node tree, flip cluster
+mode) is an HTTP call to that machine's command center (§2.4 handlers).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Any, List, Optional
+
+from sentinel_tpu.core import rules as R
+from sentinel_tpu.metrics.node import MetricNode
+
+DEFAULT_TIMEOUT_S = 3.0
+
+
+class SentinelApiClient:
+    def __init__(self, timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.timeout_s = timeout_s
+
+    # -- raw --------------------------------------------------------------
+
+    def _get(self, ip: str, port: int, command: str, **params) -> str:
+        qs = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
+        url = f"http://{ip}:{port}/{command}" + (f"?{qs}" if qs else "")
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as rsp:
+            return rsp.read().decode("utf-8")
+
+    def _post(self, ip: str, port: int, command: str, **params) -> str:
+        url = f"http://{ip}:{port}/{command}"
+        body = urllib.parse.urlencode(params).encode("ascii")
+        req = urllib.request.Request(url, data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as rsp:
+            return rsp.read().decode("utf-8")
+
+    # -- rules ------------------------------------------------------------
+
+    def fetch_rules(self, ip: str, port: int, type_: str) -> List[Any]:
+        kind = {"paramFlow": "param-flow"}.get(type_, type_)
+        raw = json.loads(self._get(ip, port, "getRules", type=type_))
+        return R.rules_from_json_list(kind, raw)
+
+    def set_rules(self, ip: str, port: int, type_: str, rules: List[Any]) -> bool:
+        data = json.dumps(R.rules_to_json_list(rules))
+        return self._post(ip, port, "setRules", type=type_, data=data) == "success"
+
+    # -- telemetry ---------------------------------------------------------
+
+    def fetch_metric(
+        self, ip: str, port: int, start_ms: int, end_ms: Optional[int] = None
+    ) -> List[MetricNode]:
+        raw = self._get(ip, port, "metric", startTime=start_ms, endTime=end_ms)
+        out = []
+        for line in raw.split("\n"):
+            if not line.strip():
+                continue
+            try:
+                out.append(MetricNode.from_line(line))
+            except ValueError:
+                continue
+        return out
+
+    def fetch_json_tree(self, ip: str, port: int) -> dict:
+        return json.loads(self._get(ip, port, "jsonTree"))
+
+    def fetch_cluster_node(self, ip: str, port: int) -> list:
+        return json.loads(self._get(ip, port, "clusterNode"))
+
+    def fetch_basic_info(self, ip: str, port: int) -> dict:
+        return json.loads(self._get(ip, port, "basicInfo"))
+
+    # -- cluster ----------------------------------------------------------
+
+    def get_cluster_mode(self, ip: str, port: int) -> dict:
+        return json.loads(self._get(ip, port, "getClusterMode"))
+
+    def set_cluster_mode(self, ip: str, port: int, mode: int) -> bool:
+        return self._post(ip, port, "setClusterMode", mode=mode) == "success"

@@ -1,0 +1,94 @@
+"""Metric fetcher — polls every healthy machine's ``metric`` command.
+
+The analog of MetricFetcher.java:70-88: a loop wakes ~every second, asks
+each healthy machine for metric-log lines since the machine's last fetched
+second (with a catch-up window capped at ``max_catchup_ms`` — reference 15 s
+:74,263-282), and saves parsed nodes into the repository keyed by app.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from sentinel_tpu.dashboard.api_client import SentinelApiClient
+from sentinel_tpu.dashboard.discovery import AppManagement
+from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+
+DEFAULT_INTERVAL_S = 1.0
+DEFAULT_MAX_CATCHUP_MS = 15_000
+
+
+class MetricFetcher:
+    def __init__(
+        self,
+        discovery: AppManagement,
+        repository: InMemoryMetricsRepository,
+        api: Optional[SentinelApiClient] = None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        max_catchup_ms: int = DEFAULT_MAX_CATCHUP_MS,
+    ):
+        self.discovery = discovery
+        self.repository = repository
+        self.api = api or SentinelApiClient(timeout_s=2.0)
+        self.interval_s = interval_s
+        self.max_catchup_ms = max_catchup_ms
+        self._last_fetched_ms: Dict[str, int] = {}  # machine key → last second pulled
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fetch_ok = 0
+        self.fetch_fail = 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="sentinel-tpu-metric-fetcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def fetch_once(self, now_ms: Optional[int] = None) -> int:
+        """One sweep over all healthy machines; returns #nodes saved."""
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        saved = 0
+        for app in self.discovery.apps():
+            for m in self.discovery.machines(app, only_healthy=True):
+                # fetch up to the PREVIOUS full second — the current second
+                # is still being written on the machine
+                end = (now_ms // 1000) * 1000 - 1000
+                # first fetch looks back the whole catch-up window so a
+                # dashboard restart doesn't lose the recent history
+                start = self._last_fetched_ms.get(m.key, end - self.max_catchup_ms)
+                start = max(start, end - self.max_catchup_ms)
+                if start > end:
+                    continue
+                try:
+                    nodes = self.api.fetch_metric(m.ip, m.port, start, end)
+                    self.fetch_ok += 1
+                except OSError:
+                    self.fetch_fail += 1
+                    continue
+                if nodes:
+                    self.repository.save_all(app, nodes)
+                    saved += len(nodes)
+                    self._last_fetched_ms[m.key] = max(n.timestamp for n in nodes) + 1000
+                else:
+                    self._last_fetched_ms[m.key] = end
+        return saved
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.fetch_once()
+            except Exception:  # noqa: BLE001 — the poll loop must survive anything
+                from sentinel_tpu.utils.record_log import record_log
+
+                record_log().exception("metric fetch sweep failed")

@@ -48,6 +48,26 @@ def list_metric_files(base_dir: str, app_name: str) -> List[str]:
     return [os.path.join(base_dir, f) for f in sorted(out, key=_file_sort_key)]
 
 
+def _pid_of(basename: str) -> int:
+    # {app}-metrics.log.pid{pid}.{date}[.{n}]
+    try:
+        return int(basename.split(".pid", 1)[1].split(".", 1)[0])
+    except (IndexError, ValueError):
+        return -1
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+
+
 def _file_sort_key(fn: str):
     # {app}-metrics.log.pid{pid}.{date}[.{n}]
     parts = fn.rsplit(".", 2)
@@ -134,14 +154,16 @@ class MetricWriter:
         self._trim_old_files()
 
     def _trim_old_files(self) -> None:
-        # trim ONLY this process's files: another live process of the same
-        # app owns its pid-named files and may have one open for append
+        # eligible for deletion: this process's own files, plus files left
+        # by pids that are no longer alive (dead runs would otherwise
+        # accumulate forever).  Files of OTHER LIVE pids are never touched —
+        # that process may have one open for append.
         own_prefix = metric_file_base(self.app_name) + "."
-        files = [
-            f
-            for f in list_metric_files(self.base_dir, self.app_name)
-            if os.path.basename(f).startswith(own_prefix)
-        ]
+        files = []
+        for f in list_metric_files(self.base_dir, self.app_name):
+            base = os.path.basename(f)
+            if base.startswith(own_prefix) or not _pid_alive(_pid_of(base)):
+                files.append(f)
         excess = len(files) - self.total_file_count
         for path in files[: max(excess, 0)]:
             if path == self._cur_path:

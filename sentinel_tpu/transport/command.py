@@ -58,6 +58,8 @@ class CommandRegistry:
 
     def register_group(self, group: Any) -> None:
         """Register every @command_mapping-decorated method of an object."""
+        if getattr(group, "_registry", None) is None:
+            group._registry = self  # lets handlers like "api" introspect us
         for attr in dir(group):
             fn = getattr(group, attr)
             name = getattr(fn, "__command_name__", None)

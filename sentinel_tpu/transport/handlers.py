@@ -233,6 +233,5 @@ def build_default_handlers(
 ) -> CommandRegistry:
     registry = CommandRegistry()
     group = DefaultHandlerGroup(client, cluster, metric_searcher, writable_registry)
-    group._registry = registry  # for the "api" listing handler
-    registry.register_group(group)
+    registry.register_group(group)  # also injects group._registry for "api"
     return registry

@@ -71,6 +71,9 @@ class HeartbeatSender:
         """One heartbeat to the current dashboard address; rotates on failure."""
         import sentinel_tpu
 
+        if not self.addresses:
+            return False
+
         params = urllib.parse.urlencode(
             {
                 "app": self.app_name,
@@ -89,7 +92,8 @@ class HeartbeatSender:
             )
             with urllib.request.urlopen(req, timeout=timeout_s) as rsp:
                 ok = 200 <= rsp.status < 300
-        except OSError:
+        except Exception:  # noqa: BLE001 — a bad address (InvalidURL is not
+            # an OSError) must rotate, never kill the heartbeat loop
             ok = False
         if ok:
             self.sent_ok += 1

@@ -1,0 +1,176 @@
+"""Dashboard-lite tests: discovery, repository retention/top-N, fetcher
+catch-up against a fake machine API, and the REST surface end-to-end with a
+real client instance behind a real command center (reference:
+sentinel-dashboard controller/repository tests)."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.dashboard import (
+    AppManagement,
+    DashboardServer,
+    InMemoryMetricsRepository,
+    MachineInfo,
+    MetricFetcher,
+)
+from sentinel_tpu.metrics.node import MetricNode
+
+
+def _node(ts, resource, p=0, b=0):
+    return MetricNode(timestamp=ts, resource=resource, pass_qps=p, block_qps=b)
+
+
+def test_discovery_register_and_health():
+    d = AppManagement(stale_after_s=0.2)
+    d.register(MachineInfo(app="a", ip="1.2.3.4", port=8719))
+    d.register(MachineInfo(app="a", ip="1.2.3.4", port=8719, pid=42))  # upsert
+    d.register(MachineInfo(app="b", ip="5.6.7.8", port=8719))
+    assert d.apps() == ["a", "b"]
+    assert len(d.machines("a")) == 1
+    assert d.machines("a")[0].pid == 42
+    assert d.machines("a", only_healthy=True)
+    time.sleep(0.25)
+    assert not d.machines("a", only_healthy=True)
+    assert d.remove_stale(older_than_s=0.1) == 2
+
+
+def test_repository_query_merge_and_retention():
+    repo = InMemoryMetricsRepository(retention_ms=10_000)
+    t0 = 1_700_000_000_000
+    repo.save_all("app", [_node(t0, "r1", p=10), _node(t0, "r2", p=1)])
+    repo.save_all("app", [_node(t0, "r1", p=5)])  # second machine, same second
+    assert repo.query("app", "r1", t0, t0)[0].pass_qps == 15
+    # retention trim
+    repo.save_all("app", [_node(t0 + 60_000, "r1", p=1)])
+    assert repo.query("app", "r1", 0, 2**62)[0].timestamp == t0 + 60_000
+    assert repo.resources_of("app") == ["r1", "r2"]
+
+
+def test_repository_top_resources():
+    repo = InMemoryMetricsRepository()
+    t0 = 1_700_000_000_000
+    repo.save_all("app", [_node(t0, "hot", p=100), _node(t0, "warm", p=10, b=5), _node(t0, "cold")])
+    assert repo.top_resources("app", 0, 2**62) == ["hot", "warm"]
+    assert repo.top_resources("app", 0, 2**62, limit=1) == ["hot"]
+
+
+class _FakeApi:
+    """Stand-in machine command plane serving canned metric lines."""
+
+    def __init__(self):
+        self.calls = []
+        self.nodes = []
+
+    def fetch_metric(self, ip, port, start_ms, end_ms):
+        self.calls.append((start_ms, end_ms))
+        return [n for n in self.nodes if start_ms <= n.timestamp <= end_ms]
+
+
+def test_fetcher_catchup_window():
+    d = AppManagement()
+    d.register(MachineInfo(app="app", ip="127.0.0.1", port=1))
+    repo = InMemoryMetricsRepository()
+    api = _FakeApi()
+    f = MetricFetcher(d, repo, api=api, max_catchup_ms=15_000)
+    now = 1_700_000_100_000
+    api.nodes = [_node(now - 5000, "r", p=7)]
+    saved = f.fetch_once(now)
+    assert saved == 1
+    assert repo.query("app", "r", 0, 2**62)[0].pass_qps == 7
+    # catch-up start was clamped to 15 s before the end second
+    start, end = api.calls[0]
+    assert end == (now // 1000) * 1000 - 1000
+    assert start >= end - 15_000
+    # next sweep resumes after the last fetched second
+    f.fetch_once(now + 1000)
+    start2, _ = api.calls[1]
+    assert start2 == (now - 5000) + 1000
+
+
+@pytest.fixture()
+def live_stack(client):
+    """Real client + command center + dashboard server, wired by heartbeat."""
+    from sentinel_tpu.transport import HeartbeatSender, start_command_center
+
+    center = start_command_center(client, host="127.0.0.1", port=0)
+    dash = DashboardServer(host="127.0.0.1", port=0, fetch_metrics=False)
+    dash.start()
+    hb = HeartbeatSender(
+        client.app_name, center.port, [f"127.0.0.1:{dash.port}"], ip="127.0.0.1"
+    )
+    assert hb.send_once()
+    yield client, center, dash
+    dash.stop()
+    center.stop()
+
+
+def _get(dash, path):
+    return json.load(
+        urllib.request.urlopen(f"http://127.0.0.1:{dash.port}{path}", timeout=3)
+    )
+
+
+def test_dashboard_rest_end_to_end(live_stack, vt):
+    client, center, dash = live_stack
+    apps = _get(dash, "/apps")
+    assert client.app_name in apps
+    machine = apps[client.app_name][0]
+    assert machine["port"] == center.port and machine["healthy"]
+
+    # push rules through the dashboard → machine command plane
+    rules = json.dumps([{"resource": "dash-res", "count": 11}])
+    body = urllib.parse.urlencode({"app": client.app_name, "type": "flow", "data": rules}).encode()
+    rsp = json.load(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{dash.port}/rules", data=body, method="POST"
+            ),
+            timeout=3,
+        )
+    )
+    assert rsp["pushed"] == 1
+    assert client.flow_rules.get()[0].count == 11
+
+    # read rules back through the dashboard
+    got = _get(
+        dash,
+        f"/rules?ip=127.0.0.1&port={center.port}&type=flow",
+    )
+    assert got[0]["resource"] == "dash-res"
+
+    # live tree proxy
+    with client.entry("dash-res"):
+        vt.advance(3)
+    tree = _get(dash, f"/tree?ip=127.0.0.1&port={center.port}")
+    assert tree["resource"] == "machine-root"
+
+    # metric flow: machine metric log → fetcher → repository → REST
+    from sentinel_tpu.metrics import MetricSearcher, MetricTimerListener, MetricWriter
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        timer = MetricTimerListener(client, MetricWriter(td, client.app_name))
+        timer.run_once()
+        timer.writer.close()
+        # rebuild the command registry with a searcher for the metric command
+        from sentinel_tpu.transport import build_default_handlers
+
+        center.registry._handlers.update(
+            build_default_handlers(
+                client, metric_searcher=MetricSearcher(td, client.app_name)
+            )._handlers
+        )
+        wall = client.time.wall_ms()
+        saved = dash.fetcher.fetch_once(wall + 2000)
+        assert saved >= 1
+    top = _get(dash, f"/metric/top?app={client.app_name}")
+    assert "dash-res" in top
+    series = _get(
+        dash, f"/metric?app={client.app_name}&identity=dash-res"
+    )
+    assert series and series[0]["pass_qps"] >= 1
