@@ -1,0 +1,43 @@
+"""Adapters (SURVEY §2.7): entry points that bridge user traffic into the
+engine — decorator, WSGI/ASGI middleware, gRPC interceptors, outbound HTTP
+client guards, and the API-gateway rule/param bridge."""
+
+from sentinel_tpu.adapters.decorator import sentinel_resource
+from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
+from sentinel_tpu.adapters.http_client import (
+    SentinelHttpClient,
+    guarded_urlopen,
+    default_url_resource,
+)
+from sentinel_tpu.adapters.gateway import (
+    ApiDefinition,
+    ApiDefinitionManager,
+    ApiPredicateItem,
+    GatewayAdapter,
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayParamParser,
+    GatewayRuleManager,
+    RequestAttributes,
+    convert_to_param_rule,
+)
+
+__all__ = [
+    "sentinel_resource",
+    "SentinelWSGIMiddleware",
+    "SentinelASGIMiddleware",
+    "SentinelHttpClient",
+    "guarded_urlopen",
+    "default_url_resource",
+    "ApiDefinition",
+    "ApiDefinitionManager",
+    "ApiPredicateItem",
+    "GatewayAdapter",
+    "GatewayFlowRule",
+    "GatewayParamFlowItem",
+    "GatewayParamParser",
+    "GatewayRuleManager",
+    "RequestAttributes",
+    "convert_to_param_rule",
+]

@@ -229,6 +229,9 @@ class SentinelClient:
         self.system_rules = RuleManager(self, "system")
         self.authority_rules = RuleManager(self, "authority")
         self.param_flow_rules = RuleManager(self, "param-flow")
+        # gateway rules project onto param rules in a separate manager so
+        # gateway pushes never clobber user param rules (GatewayRuleManager)
+        self.gateway_param_rules = RuleManager(self, "gateway-param")
 
         # cluster-mode wiring (FlowRuleChecker.passClusterCheck analog):
         # cluster rules are checked against a TokenService; on token-server
@@ -327,14 +330,19 @@ class SentinelClient:
         cluster_flow = [r for r in flow if r.cluster_mode]
         self._cluster_flow_by_res = {r.resource: r for r in cluster_flow}
 
-        param = self.param_flow_rules.get()
+        param = self.param_flow_rules.get() + self.gateway_param_rules.get()
         local_param = [r for r in param if not r.cluster_mode]
         cluster_param = [r for r in param if r.cluster_mode]
         self._cluster_param_by_res = {r.resource: r for r in cluster_param}
         # one param index per resource drives the host-side hash, so healthy
         # (token-service) and degraded (local-engine) modes key off the SAME
-        # argument; first rule wins when several disagree
+        # argument.  Gateway rules win on shared resources: gateway traffic
+        # supplies the (short) parsed gateway vector as args, and a user
+        # rule's larger param_idx would index past it, zeroing the hash and
+        # disabling param checks entirely for those entries.
         idx_map: Dict[str, int] = {}
+        for r in self.gateway_param_rules.get():
+            idx_map.setdefault(r.resource, r.param_idx)
         for r in param:
             idx_map.setdefault(r.resource, r.param_idx)
         self._param_idx_by_res = idx_map

@@ -27,6 +27,9 @@ from sentinel_tpu.core.config import EngineConfig
 
 
 class Registry:
+    #: cap on interned origins (MAX_CONTEXT_NAME_SIZE-style degradation)
+    MAX_ORIGINS = 10_000
+
     def __init__(self, cfg: EngineConfig):
         self.cfg = cfg
         self._lock = threading.RLock()
@@ -129,7 +132,12 @@ class Registry:
             return cid
 
     def origin_id(self, origin: str) -> int:
-        """Intern an origin string. '' (no origin) maps to -1."""
+        """Intern an origin string. '' (no origin) maps to -1.
+
+        Capped at MAX_ORIGINS distinct values: beyond that, new origins map
+        to -1 (anonymous) instead of growing without bound — the analog of
+        MAX_CONTEXT_NAME_SIZE pass-through degradation (Constants.java:36)
+        for adversarial/high-cardinality origins (e.g. client IPs)."""
         if not origin:
             return -1
         oid = self._origins.get(origin)
@@ -139,6 +147,8 @@ class Registry:
             oid = self._origins.get(origin)
             if oid is not None:
                 return oid
+            if len(self._origin_names) >= self.MAX_ORIGINS:
+                return -1
             oid = len(self._origin_names)
             self._origins[origin] = oid
             self._origin_names.append(origin)

@@ -1,0 +1,347 @@
+"""Adapter tests — decorator, WSGI, ASGI, gRPC interceptors, outbound HTTP
+guard, and the gateway rule/param bridge (reference: the 96 adapter tests'
+pattern — drive the framework hook, assert block vs pass + node counters)."""
+
+import asyncio
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.adapters import (
+    ApiDefinition,
+    ApiDefinitionManager,
+    ApiPredicateItem,
+    GatewayAdapter,
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayRuleManager,
+    RequestAttributes,
+    SentinelASGIMiddleware,
+    SentinelHttpClient,
+    SentinelWSGIMiddleware,
+    sentinel_resource,
+)
+from sentinel_tpu.adapters import gateway as GW
+
+
+# -- decorator --------------------------------------------------------------
+
+
+def test_decorator_pass_block_fallback(client, vt):
+    calls = []
+
+    def on_block(x, block_exception=None):
+        calls.append(("block", x, type(block_exception).__name__))
+        return "blocked"
+
+    def on_err(x, exception=None):
+        calls.append(("fallback", x, type(exception).__name__))
+        return "fell-back"
+
+    @sentinel_resource("deco", block_handler=on_block, fallback=on_err, client=client)
+    def fn(x):
+        if x == "boom":
+            raise ValueError("biz")
+        return x * 2
+
+    client.flow_rules.load([st.FlowRule(resource="deco", count=2)])
+    assert fn("a") == "aa"
+    assert fn("boom") == "fell-back"
+    assert fn("c") == "blocked"  # third call in the window → flow-blocked
+    assert calls == [("fallback", "boom", "ValueError"), ("block", "c", "FlowException")]
+    s = client.stats.resource("deco")
+    assert s["blockQps"] == 1
+    assert s["exceptionQps"] == 1
+
+
+def test_decorator_default_name_and_ignore(client, vt):
+    @sentinel_resource(exceptions_to_ignore=(KeyError,), client=client)
+    def named():
+        raise KeyError("skip")
+
+    assert named.__sentinel_resource__.endswith("named")
+    with pytest.raises(KeyError):
+        named()
+    s = client.stats.resource(named.__sentinel_resource__)
+    assert s["exceptionQps"] == 0  # ignored exceptions are not traced
+
+
+# -- WSGI -------------------------------------------------------------------
+
+
+def _wsgi_get(mw, path, **environ):
+    status_headers = {}
+
+    def start_response(status, headers):
+        status_headers["status"] = status
+
+    env = {"REQUEST_METHOD": "GET", "PATH_INFO": path, **environ}
+    result = mw(env, start_response)
+    try:
+        body = b"".join(result)
+    finally:
+        close = getattr(result, "close", None)
+        if close is not None:
+            close()  # WSGI servers always call close()
+    return status_headers["status"], body
+
+
+def test_wsgi_block_and_pass(client, vt):
+    def app(environ, start_response):
+        start_response("200 OK", [("Content-Type", "text/plain")])
+        return [b"hello"]
+
+    mw = SentinelWSGIMiddleware(app, client=client)
+    client.flow_rules.load([st.FlowRule(resource="GET:/api", count=2)])
+    assert _wsgi_get(mw, "/api") == ("200 OK", b"hello")
+    assert _wsgi_get(mw, "/api") == ("200 OK", b"hello")
+    status, body = _wsgi_get(mw, "/api")
+    assert status.startswith("429")
+    assert b"Blocked" in body
+    s = client.stats.resource("GET:/api")
+    assert s["passQps"] == 2 and s["blockQps"] == 1
+    assert s["curThreadNum"] == 0  # iterator close exits every entry
+
+
+def test_wsgi_origin_and_exception(client, vt):
+    def app(environ, start_response):
+        raise RuntimeError("app broke")
+
+    mw = SentinelWSGIMiddleware(app, client=client)
+    client.authority_rules.load(
+        [st.AuthorityRule(resource="GET:/sec", limit_app="evil", strategy=st.AUTHORITY_BLACK)]
+    )
+    status, body = _wsgi_get(mw, "/sec", HTTP_S_USER="evil")
+    assert status.startswith("429")
+    with pytest.raises(RuntimeError):
+        _wsgi_get(mw, "/ok", HTTP_S_USER="good")
+    s = client.stats.resource("GET:/ok")
+    assert s["exceptionQps"] == 1 and s["curThreadNum"] == 0
+
+
+# -- ASGI -------------------------------------------------------------------
+
+
+def test_asgi_block_and_pass(client, vt):
+    async def app(scope, receive, send):
+        await send({"type": "http.response.start", "status": 200, "headers": []})
+        await send({"type": "http.response.body", "body": b"ok"})
+
+    mw = SentinelASGIMiddleware(app, client=client)
+    client.flow_rules.load([st.FlowRule(resource="GET:/a", count=1)])
+
+    async def run_one():
+        sent = []
+
+        async def send(msg):
+            sent.append(msg)
+
+        async def receive():
+            return {"type": "http.request"}
+
+        scope = {"type": "http", "method": "GET", "path": "/a", "headers": []}
+        await mw(scope, receive, send)
+        return sent
+
+    first = asyncio.run(run_one())
+    assert first[0]["status"] == 200
+    second = asyncio.run(run_one())
+    assert second[0]["status"] == 429
+    s = client.stats.resource("GET:/a")
+    assert s["passQps"] == 1 and s["blockQps"] == 1
+
+
+# -- outbound HTTP guard ----------------------------------------------------
+
+
+def test_http_client_guard(client, vt):
+    sent = []
+
+    def send(method, url, **kw):
+        sent.append((method, url))
+        return "rsp"
+
+    hc = SentinelHttpClient(send, client=client)
+    client.flow_rules.load(
+        [st.FlowRule(resource="GET:http://svc/api", count=1)]
+    )
+    assert hc.request("GET", "http://svc/api?q=1") == "rsp"
+    with pytest.raises(st.BlockException):
+        hc.request("GET", "http://svc/api?q=2")  # query stripped → same resource
+    assert len(sent) == 1
+
+
+# -- gRPC interceptors ------------------------------------------------------
+
+
+def test_grpc_server_interceptor(client, vt):
+    import grpc
+    from sentinel_tpu.adapters.grpc_adapter import SentinelServerInterceptor
+
+    inner_calls = []
+
+    def inner(request, context):
+        inner_calls.append(request)
+        return "reply"
+
+    base_handler = grpc.unary_unary_rpc_method_handler(inner)
+
+    class Details:
+        method = "/pkg.Svc/Do"
+        invocation_metadata = (("s-user", "caller-x"),)
+
+    class FakeContext:
+        def __init__(self):
+            self.aborted = None
+
+        def abort(self, code, details):
+            self.aborted = code
+            raise RuntimeError("aborted")
+
+    interceptor = SentinelServerInterceptor(client=client)
+    handler = interceptor.intercept_service(lambda d: base_handler, Details())
+    client.flow_rules.load([st.FlowRule(resource="/pkg.Svc/Do", count=1)])
+    ctx = FakeContext()
+    assert handler.unary_unary("req", ctx) == "reply"
+    ctx2 = FakeContext()
+    with pytest.raises(RuntimeError):
+        handler.unary_unary("req2", ctx2)
+    assert ctx2.aborted == grpc.StatusCode.RESOURCE_EXHAUSTED
+    assert inner_calls == ["req"]
+
+
+def test_grpc_client_interceptor(client, vt):
+    import grpc
+    from sentinel_tpu.adapters.grpc_adapter import SentinelClientInterceptor
+
+    class FakeCall:
+        def __init__(self):
+            self.cbs = []
+
+        def add_done_callback(self, cb):
+            self.cbs.append(cb)
+
+        def code(self):
+            return grpc.StatusCode.OK
+
+    class Details:
+        method = "/pkg.Svc/Out"
+
+    interceptor = SentinelClientInterceptor(client=client)
+    client.flow_rules.load([st.FlowRule(resource="/pkg.Svc/Out", count=1)])
+    call = interceptor.intercept_unary_unary(lambda d, r: FakeCall(), Details(), "req")
+    for cb in call.cbs:
+        cb(call)  # RPC completes → entry exits
+    with pytest.raises(st.BlockException):
+        interceptor.intercept_unary_unary(lambda d, r: FakeCall(), Details(), "req")
+    s = client.stats.resource("/pkg.Svc/Out")
+    assert s["curThreadNum"] == 0
+
+
+# -- gateway ----------------------------------------------------------------
+
+
+def test_gateway_param_parser_strategies():
+    p = GW.GatewayParamParser()
+    req = RequestAttributes(
+        path="/x",
+        client_ip="10.0.0.9",
+        host="svc.example",
+        headers={"X-Tenant": "acme"},
+        url_params={"user": "u1"},
+        cookies={"session": "s1"},
+    )
+    assert p.parse_value(GatewayParamFlowItem(GW.PARAM_PARSE_STRATEGY_CLIENT_IP), req) == "10.0.0.9"
+    assert p.parse_value(GatewayParamFlowItem(GW.PARAM_PARSE_STRATEGY_HOST), req) == "svc.example"
+    assert (
+        p.parse_value(
+            GatewayParamFlowItem(GW.PARAM_PARSE_STRATEGY_HEADER, field_name="X-Tenant"), req
+        )
+        == "acme"
+    )
+    assert (
+        p.parse_value(
+            GatewayParamFlowItem(GW.PARAM_PARSE_STRATEGY_URL_PARAM, field_name="user"), req
+        )
+        == "u1"
+    )
+    assert (
+        p.parse_value(
+            GatewayParamFlowItem(GW.PARAM_PARSE_STRATEGY_COOKIE, field_name="session"), req
+        )
+        == "s1"
+    )
+    # pattern mismatch → NOT_MATCH sentinel
+    item = GatewayParamFlowItem(
+        GW.PARAM_PARSE_STRATEGY_HEADER,
+        field_name="X-Tenant",
+        pattern="globex",
+        match_strategy=GW.PARAM_MATCH_STRATEGY_EXACT,
+    )
+    assert p.parse_value(item, req) == GW.NOT_MATCH_PARAM
+    item.match_strategy = GW.PARAM_MATCH_STRATEGY_CONTAINS
+    item.pattern = "cm"
+    assert p.parse_value(item, req) == "acme"
+
+
+def test_api_definition_matching():
+    apis = ApiDefinitionManager()
+    apis.load(
+        [
+            ApiDefinition("user-api", [ApiPredicateItem("/users", GW.URL_MATCH_STRATEGY_PREFIX)]),
+            ApiDefinition("exact-api", [ApiPredicateItem("/ping", GW.URL_MATCH_STRATEGY_EXACT)]),
+            ApiDefinition("re-api", [ApiPredicateItem(r"/v\d+/items", GW.URL_MATCH_STRATEGY_REGEX)]),
+        ]
+    )
+    assert apis.match("/users/42") == ["user-api"]
+    assert apis.match("/ping") == ["exact-api"]
+    assert apis.match("/v2/items") == ["re-api"]
+    assert apis.match("/other") == []
+
+
+def test_gateway_end_to_end_per_param_limit(client, vt):
+    gw = GatewayAdapter(client)
+    gw.rules.load_rules(
+        [
+            GatewayFlowRule(
+                resource="route-a",
+                count=2,
+                param_item=GatewayParamFlowItem(
+                    GW.PARAM_PARSE_STRATEGY_HEADER, field_name="X-Tenant"
+                ),
+            )
+        ]
+    )
+
+    def hit(tenant):
+        req = RequestAttributes(path="/svc", client_ip="1.1.1.1", headers={"X-Tenant": tenant})
+        try:
+            entries = gw.entries_for("route-a", req)
+        except st.BlockException:
+            return False
+        for e in entries:
+            e.exit()
+        return True
+
+    assert hit("acme") and hit("acme")
+    assert not hit("acme")  # tenant acme exhausted its 2 QPS
+    assert hit("globex")  # other tenant unaffected
+    vt.advance(1100)
+    assert hit("acme")
+
+
+def test_gateway_api_group_entry(client, vt):
+    gw = GatewayAdapter(client)
+    gw.apis.load(
+        [ApiDefinition("grp", [ApiPredicateItem("/g", GW.URL_MATCH_STRATEGY_PREFIX)])]
+    )
+    gw.rules.load_rules([GatewayFlowRule(resource="grp", count=1)])
+    req = RequestAttributes(path="/g/1", client_ip="2.2.2.2")
+    entries = gw.entries_for("route-b", req)
+    assert [e.resource for e in entries] == ["route-b", "grp"]
+    for e in entries:
+        e.exit()
+    with pytest.raises(st.BlockException):
+        gw.entries_for("route-b", req)  # grp limit 1/s exhausted
+    # the failed acquisition exited the route entry it had taken
+    assert client.stats.resource("route-b")["curThreadNum"] == 0
