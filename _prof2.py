@@ -1,0 +1,89 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.core.rules import FlowRule
+from sentinel_tpu.ops import engine as E
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops import param as P
+from sentinel_tpu.runtime.registry import Registry
+
+n_res = 1 << 20
+B = 32768
+cfg = EngineConfig(max_resources=n_res, max_nodes=n_res, max_flow_rules=4096,
+                   batch_size=B, complete_batch_size=B, enable_minute_window=False)
+reg = Registry(cfg)
+rules = [FlowRule(resource=f"res-{i+1}", count=1000.0) for i in range(4095)]
+for i in range(4095):
+    reg.resource_id(f"res-{i+1}")
+ruleset = E.compile_ruleset(cfg, reg, flow_rules=rules)
+rng = np.random.default_rng(0)
+z = rng.zipf(1.3, size=B).astype(np.int64)
+ids = jnp.asarray(((z - 1) % (n_res - 1) + 1).astype(np.int32))
+acq = E.empty_acquire(cfg)._replace(res=ids, count=jnp.ones((B,), jnp.int32))
+comp = E.empty_complete(cfg)._replace(
+    res=ids, rt=jnp.abs(jnp.asarray(rng.normal(3.0, 1.0, B), jnp.float32)),
+    success=jnp.ones((B,), jnp.int32))
+
+
+def partial_tick(stages):
+    def fn(state, now):
+        out = jnp.zeros((B,), jnp.int8)
+        if "comp" in stages:
+            state = E._process_completions(cfg, state, ruleset, comp, now)
+        if "warmup" in stages:
+            state = E._sync_warmup(cfg, state, ruleset, now)
+        valid = acq.res != cfg.trash_row
+        eligible = valid
+        if "auth" in stages:
+            ab = E._check_authority(cfg, ruleset, acq) & valid
+            eligible = eligible & ~ab
+            out = out + ab.astype(jnp.int8)
+        if "system" in stages:
+            sb = E._check_system(cfg, state, ruleset, acq, now, jnp.float32(0), jnp.float32(0), eligible)
+            eligible = eligible & ~sb
+            out = out + sb.astype(jnp.int8)
+        if "param" in stages:
+            pb, cms, ce, ci, ps, pa = E._check_param(cfg, state, ruleset, acq, now, eligible)
+            eligible = eligible & ~pb
+            out = out + pb.astype(jnp.int8)
+            state = state._replace(cms=cms, cms_epochs=ce)
+        if "flow" in stages:
+            fb, wm, lp = E._check_flow(cfg, state, ruleset, acq, now, eligible)
+            eligible = eligible & ~fb
+            state = state._replace(latest_passed_ms=lp)
+            out = out + fb.astype(jnp.int8)
+        if "degrade" in stages:
+            db, cb = E._check_degrade(cfg, state, ruleset, acq, now, eligible)
+            state = state._replace(cb_state=cb)
+            out = out + db.astype(jnp.int8)
+        if "effects" in stages:
+            rows4 = E._stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, acq.inbound)
+            deltas1 = jnp.zeros((B, W.NUM_EVENTS), jnp.int32)
+            deltas1 = deltas1.at[:, W.EV_PASS].set(jnp.where(eligible, acq.count, 0))
+            deltas4 = jnp.tile(deltas1, (4, 1))
+            state = E._scatter_events(cfg, state, now, rows4, deltas4, None)
+            conc = state.concurrency.at[rows4].add(jnp.tile(jnp.where(eligible, acq.count, 0), (4,)), mode="drop")
+            state = state._replace(concurrency=conc)
+        return state, out
+    return jax.jit(fn, donate_argnums=0)
+
+
+def run(stages, n=30):
+    f = partial_tick(stages)
+    state = E.init_state(cfg)
+    state, o = f(state, jnp.int32(0))
+    jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    for t in range(n):
+        state, o = f(state, jnp.int32(t + 1))
+    jax.block_until_ready((state, o))
+    dt = (time.perf_counter() - t0) / n * 1000
+    print(f"{'+'.join(stages) or 'none':55s} {dt:8.2f} ms")
+    return dt
+
+
+run([])
+for s in ["comp", "warmup", "auth", "system", "param", "flow", "degrade", "effects"]:
+    run([s])
+run(["comp", "warmup", "auth", "system", "param", "flow", "degrade", "effects"])

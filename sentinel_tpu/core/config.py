@@ -135,6 +135,11 @@ class EngineConfig:
     topk_k: int = 32
     # statistic max RT clamp (SentinelConfig.java:63)
     statistic_max_rt: int = 5000
+    # memory-access strategy: True routes every big-table gather/scatter in
+    # the tick through one-hot MXU contractions (ops/tables.py) — the TPU
+    # path; False uses plain XLA gather/scatter — the CPU/test path
+    use_mxu_tables: bool = False
+    mxu_n_lo: int = 512
 
     # dtype policy: counters int32, rt sums float32
     @property

@@ -56,6 +56,7 @@ from sentinel_tpu.core.rules import (
 )
 from sentinel_tpu.ops import degrade as D
 from sentinel_tpu.ops import param as P
+from sentinel_tpu.ops import tables as T
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.rank import grouped_exclusive_cumsum, grouped_first
 
@@ -185,31 +186,84 @@ def empty_complete(cfg: EngineConfig, b: Optional[int] = None) -> CompleteBatch:
     )
 
 
-def _stat_rows(cfg: EngineConfig, res, ctx_node, origin_node, inbound):
-    """[4*N] stat rows an item writes to: cluster node, context DefaultNode,
-    origin node, and the global ENTRY node for inbound traffic
-    (StatisticSlot.java:54-123)."""
-    entry = jnp.where(
-        inbound > 0, jnp.int32(cfg.entry_node_row), jnp.int32(cfg.trash_row)
-    )
-    return jnp.concatenate([res, ctx_node, origin_node, entry])
+def _stat_rows(cfg: EngineConfig, res, ctx_node, origin_node, with_nodes: bool):
+    """Stat rows an item writes to: the per-resource ClusterNode row, plus
+    (with the "nodes" feature) the context DefaultNode and origin rows
+    (StatisticSlot.java:54-123).  The global ENTRY node is handled by a
+    masked reduction instead of a scatter lane — its row is fixed."""
+    if with_nodes:
+        return jnp.concatenate([res, ctx_node, origin_node])
+    return res
 
 
-def _scatter_events(
+def _stat_update(
     cfg: EngineConfig,
     state: EngineState,
     now_ms,
-    rows4,  # [4N]
-    deltas,  # int32 [4N, NUM_EVENTS]
-    rt,  # float32 [4N] or None
+    rows,  # [N] or [3N] stat rows
+    deltas,  # int32 [same, NUM_EVENTS]
+    rt,  # float32 [same] or None
+    entry_deltas,  # int32 [NUM_EVENTS] — ENTRY-node contribution (reduction)
+    entry_rt,  # f32 scalar or None
+    entry_rt_min,  # f32 scalar or None — min inbound RT this tick
 ) -> EngineState:
+    """Land one batch of stat events.
+
+    CPU path: scatter-add per window (exact, incl. per-row minRt).
+    MXU path: one-hot-matmul histogram → dense column add (ops/tables.py);
+    per-row minRt is skipped (ENTRY-row min is kept via min_into_row)."""
     sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
-    win_sec = W.add_batch(state.win_sec, now_ms, rows4, deltas, rt, sec_cfg)
+    min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
+    erow = cfg.entry_node_row
+
+    if cfg.use_mxu_tables:
+        hist = T.histogram(cfg, rows, deltas, cfg.node_rows)
+        hist = hist.at[erow].add(entry_deltas)
+        rt_hist = None
+        if rt is not None:
+            # quantize to 1/8 ms so the RT plane rides the exact bf16 digit
+            # path (values ≤ statistic_max_rt*8 < 2^16) instead of a slow
+            # f32 contraction; RT is clamped like the reference's
+            # statisticMaxRt (SentinelConfig.java:63)
+            rt_q = jnp.round(
+                jnp.minimum(rt, float(cfg.statistic_max_rt)) * 8.0
+            ).astype(jnp.int32)
+            rt_hist = (
+                T.histogram(cfg, rows, rt_q, cfg.node_rows).astype(jnp.float32) / 8.0
+            )
+            rt_hist = rt_hist.at[erow].add(entry_rt)
+        win_sec = W.add_dense(state.win_sec, now_ms, hist, rt_hist, sec_cfg)
+        if entry_rt_min is not None:
+            win_sec = W.min_into_row(win_sec, now_ms, erow, entry_rt_min, sec_cfg)
+        win_min = state.win_min
+        if cfg.enable_minute_window:
+            win_min = W.add_dense(state.win_min, now_ms, hist, rt_hist, min_cfg)
+        return state._replace(win_sec=win_sec, win_min=win_min), hist
+    # CPU/scatter path
+    win_sec = W.add_batch(state.win_sec, now_ms, rows, deltas, rt, sec_cfg)
+    win_sec = W.WindowState(
+        counts=win_sec.counts.at[erow, W.current_index(now_ms, sec_cfg), :].add(
+            entry_deltas
+        ),
+        rt_sum=win_sec.rt_sum
+        if rt is None
+        else win_sec.rt_sum.at[erow, W.current_index(now_ms, sec_cfg)].add(entry_rt),
+        rt_min=win_sec.rt_min,
+        epochs=win_sec.epochs,
+    )
+    if entry_rt_min is not None:
+        win_sec = W.min_into_row(win_sec, now_ms, erow, entry_rt_min, sec_cfg)
     win_min = state.win_min
     if cfg.enable_minute_window:
-        min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
-        win_min = W.add_batch(state.win_min, now_ms, rows4, deltas, rt, min_cfg)
-    return state._replace(win_sec=win_sec, win_min=win_min)
+        win_min = W.add_batch(state.win_min, now_ms, rows, deltas, rt, min_cfg)
+        idx_m = W.current_index(now_ms, min_cfg)
+        win_min = win_min._replace(
+            counts=win_min.counts.at[erow, idx_m, :].add(entry_deltas),
+            rt_sum=win_min.rt_sum
+            if rt is None
+            else win_min.rt_sum.at[erow, idx_m].add(entry_rt),
+        )
+    return state._replace(win_sec=win_sec, win_min=win_min), None
 
 
 # ---------------------------------------------------------------------------
@@ -218,44 +272,89 @@ def _scatter_events(
 
 
 def _process_completions(
-    cfg: EngineConfig, state: EngineState, rules: RuleSet, comp: CompleteBatch, now_ms
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    comp: CompleteBatch,
+    now_ms,
+    features: frozenset,
 ) -> EngineState:
     """Exit path: RT/success/exception recording + circuit-breaker feedback
     (StatisticSlot.exit:125-164, DegradeSlot.exit:60-75)."""
     b = comp.res.shape[0]
     valid = comp.res != cfg.trash_row
+    with_nodes = "nodes" in features
 
-    rows4 = _stat_rows(cfg, comp.res, comp.ctx_node, comp.origin_node, comp.inbound)
+    rows = _stat_rows(cfg, comp.res, comp.ctx_node, comp.origin_node, with_nodes)
     deltas1 = jnp.zeros((b, W.NUM_EVENTS), dtype=jnp.int32)
     deltas1 = deltas1.at[:, W.EV_SUCCESS].set(comp.success)
     deltas1 = deltas1.at[:, W.EV_EXCEPTION].set(comp.error)
-    deltas4 = jnp.tile(deltas1, (4, 1))
-    rt4 = jnp.tile(jnp.where(valid, comp.rt, 0.0), (4,))
-    state = _scatter_events(cfg, state, now_ms, rows4, deltas4, rt4)
+    rt1 = jnp.where(valid, comp.rt, 0.0)
+    fan = 3 if with_nodes else 1
+    deltas = jnp.tile(deltas1, (fan, 1)) if with_nodes else deltas1
+    rt = jnp.tile(rt1, (fan,)) if with_nodes else rt1
+    inb = valid & (comp.inbound > 0)
+    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
+    entry_deltas = entry_deltas.at[W.EV_SUCCESS].set(jnp.sum(jnp.where(inb, comp.success, 0)))
+    entry_deltas = entry_deltas.at[W.EV_EXCEPTION].set(jnp.sum(jnp.where(inb, comp.error, 0)))
+    entry_rt = jnp.sum(jnp.where(inb, comp.rt, 0.0))
+    # rt <= 0 means "no RT data", matching the add_batch per-row min filter
+    # (window.py rt_for_min) — a sub-ms completion must not collapse the
+    # BBR capacity estimate to zero
+    entry_rt_min = jnp.min(
+        jnp.where(inb & (comp.rt > 0), comp.rt, jnp.float32(W.RT_MIN_INIT))
+    )
+    state, hist = _stat_update(
+        cfg, state, now_ms, rows, deltas, rt, entry_deltas, entry_rt, entry_rt_min
+    )
 
-    # concurrency release on all touched rows
-    dec = jnp.tile(jnp.where(valid, comp.success, 0), (4,))
-    concurrency = state.concurrency.at[rows4].add(-dec, mode="drop")
+    # concurrency release on all touched rows (+ ENTRY via its fixed row)
+    if hist is not None:  # MXU: reuse the success histogram, no extra matmul
+        # (the histogram already carries the ENTRY-row reduction)
+        concurrency = state.concurrency - hist[:, W.EV_SUCCESS]
+    else:
+        dec = jnp.tile(jnp.where(valid, comp.success, 0), (fan,))
+        concurrency = state.concurrency.at[rows].add(-dec, mode="drop")
+        concurrency = concurrency.at[cfg.entry_node_row].add(
+            -entry_deltas[W.EV_SUCCESS]
+        )
     concurrency = jnp.maximum(concurrency, 0)
+
+    if "degrade" not in features:
+        return state._replace(concurrency=concurrency)
 
     # --- circuit-breaker windows -----------------------------------------
     KD = cfg.degrade_rules_per_resource
-    res_l = jnp.minimum(comp.res, cfg.max_resources)
-    slots = rules.degrade.res_cbs[res_l]  # [B2, KD]
+    res_l = jnp.minimum(comp.res, cfg.max_resources)  # row max_resources = pad
+    slots = T.big_gather(cfg, rules.degrade.res_cbs, res_l, cfg.max_resources + 1, max_int=cfg.max_degrade_rules)
     slots_f = slots.reshape(-1)
     item = jnp.repeat(jnp.arange(b), KD)
-    enabled = rules.degrade.enabled[slots_f]
-    active = enabled & valid[item]
 
     cb_counts, cb_epochs, cur_idx = D.refresh_columns(
         state.cb_counts, state.cb_epochs, rules.degrade.window_ms, now_ms
     )
-    is_err = (comp.error[item] > 0) & active
-    is_slow = (
-        (rules.degrade.grade[slots_f] == D.GRADE_SLOW_RATIO)
-        & (comp.rt[item] > rules.degrade.count[slots_f])
-        & active
+    # one packed matmul for all per-slot fields (enabled/grade/count/cur_idx)
+    dg = T.small_gather_fields(
+        cfg,
+        T.pack_fields(
+            [
+                rules.degrade.enabled,
+                rules.degrade.grade,
+                rules.degrade.count,
+                cur_idx,
+                state.cb_state,
+            ]
+        ),
+        slots_f,
     )
+    enabled = dg[:, 0] > 0
+    g_grade = dg[:, 1].astype(jnp.int32)
+    g_count = dg[:, 2]
+    g_idx = dg[:, 3].astype(jnp.int32)
+    active = enabled & valid[item]
+
+    is_err = (comp.error[item] > 0) & active
+    is_slow = (g_grade == D.GRADE_SLOW_RATIO) & (comp.rt[item] > g_count) & active
     upd = jnp.stack(
         [
             jnp.where(active, 1, 0),
@@ -265,15 +364,21 @@ def _process_completions(
         axis=-1,
     )  # [B2*KD, 3]
     safe_slots = jnp.minimum(slots_f, cfg.max_degrade_rules)
-    cb_counts = cb_counts.at[safe_slots, cur_idx[safe_slots], :].add(upd, mode="drop")
+    nbd = cfg.cb_sample_count
+    Dn1 = cfg.max_degrade_rules + 1
+    flat = safe_slots * nbd + g_idx
+    cb_counts = T.small_scatter_add(
+        cfg, cb_counts.reshape(Dn1 * nbd, 3), flat, upd
+    ).reshape(Dn1, nbd, 3)
 
     # --- half-open probe resolution (AbstractCircuitBreaker.java:68-136) --
-    half_open = state.cb_state[safe_slots] == D.CB_HALF_OPEN
+    half_open = dg[:, 4].astype(jnp.int32) == D.CB_HALF_OPEN
     probe_done = active & half_open
     probe_fail = probe_done & (is_err | is_slow)
-    Dn1 = cfg.max_degrade_rules + 1
-    seen = jnp.zeros((Dn1,), jnp.int32).at[safe_slots].max(probe_done.astype(jnp.int32))
-    failed = jnp.zeros((Dn1,), jnp.int32).at[safe_slots].max(probe_fail.astype(jnp.int32))
+    seen = T.small_scatter_or(cfg, jnp.zeros((Dn1,), jnp.int32), safe_slots, probe_done)
+    failed = T.small_scatter_or(
+        cfg, jnp.zeros((Dn1,), jnp.int32), safe_slots, probe_fail
+    )
     was_half = state.cb_state == D.CB_HALF_OPEN
     to_open = was_half & (seen > 0) & (failed > 0)
     to_close = was_half & (seen > 0) & (failed == 0)
@@ -310,8 +415,9 @@ def _process_completions(
 def _check_authority(cfg: EngineConfig, rules: RuleSet, acq: AcquireBatch):
     """AuthoritySlot: origin allow/deny (AuthorityRuleChecker.java:28-54)."""
     res_l = jnp.minimum(acq.res, cfg.max_resources)
-    mode = rules.auth.mode[res_l]  # [B]
-    origins = rules.auth.origins[res_l]  # [B, KA]
+    n = cfg.max_resources + 1
+    mode = T.big_gather(cfg, rules.auth.mode, res_l, n, max_int=255)  # [B]
+    origins = T.big_gather(cfg, rules.auth.origins, res_l, n)  # [B, KA]
     listed = ((origins == acq.origin_id[:, None]) & (origins != RT.AUTH_EMPTY)).any(
         axis=1
     )
@@ -384,7 +490,7 @@ def _check_param(
     KP = cfg.param_rules_per_resource
     b = acq.res.shape[0]
     res_l = jnp.minimum(acq.res, cfg.max_resources)
-    slots = rules.param.res_params[res_l]  # [B, KP]
+    slots = T.big_gather(cfg, rules.param.res_params, res_l, cfg.max_resources + 1, max_int=cfg.max_param_rules)
     slots_f = slots.reshape(-1)
     item = jnp.repeat(jnp.arange(b), KP)
 
@@ -392,18 +498,24 @@ def _check_param(
         state.cms, state.cms_epochs, rules.param.window_ms, now_ms
     )
 
-    enabled = rules.param.enabled[slots_f]
+    pg = T.small_gather_fields(
+        cfg, T.pack_fields([rules.param.enabled, rules.param.threshold]), slots_f
+    )
+    enabled = pg[:, 0] > 0
     ph = acq.param_hash[item]
     applicable = enabled & (ph != 0)
     est = P.estimate(cms, cms_epochs, rules.param.window_ms, slots_f, ph, now_ms)
 
-    # per-value exception items (ParamFlowItem)
-    ih = rules.param.item_hash[slots_f]  # [N, KI]
-    it = rules.param.item_threshold[slots_f]
+    # per-value exception items (ParamFlowItem): hashes are raw int32 bits,
+    # so they go through the exact int gather; thresholds pack as f32
+    ih = T.small_gather_int(cfg, rules.param.item_hash, slots_f)  # [N, KI]
+    it = T.small_gather_fields(
+        cfg, jnp.asarray(rules.param.item_threshold, jnp.float32), slots_f
+    )
     is_item = (ih == ph[:, None]) & (ih != 0)
     has_item = is_item.any(axis=1)
     item_thr = jnp.max(jnp.where(is_item, it, 0.0), axis=1)
-    thr = jnp.where(has_item, item_thr, rules.param.threshold[slots_f])
+    thr = jnp.where(has_item, item_thr, pg[:, 1])
 
     cnt = acq.count[item].astype(jnp.float32)
     elig_f = eligible[item] & applicable
@@ -430,7 +542,11 @@ def _sync_warmup(
     do_sync = is_warm & ((elapsed > 0) | first)
 
     node = f.res  # warm-up rules meter their resource's cluster node
-    pass_qps = W.gather_window_event(state.win_sec, now_ms, node, sec_cfg, W.EV_PASS)
+    if cfg.use_mxu_tables:
+        wsum = W.window_event(state.win_sec, now_ms, sec_cfg, W.EV_PASS)
+        pass_qps = T.big_gather(cfg, wsum, jnp.asarray(node), cfg.node_rows, max_int=(1 << 24))
+    else:
+        pass_qps = W.gather_window_event(state.win_sec, now_ms, node, sec_cfg, W.EV_PASS)
     pass_qps = pass_qps.astype(jnp.float32)
 
     tokens = state.warmup_tokens
@@ -466,14 +582,38 @@ def _check_flow(
     sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
 
     res_l = jnp.minimum(acq.res, cfg.max_resources)
-    slots = f.res_rules[res_l]  # [B, K]
+    slots = T.big_gather(cfg, f.res_rules, res_l, cfg.max_resources + 1, max_int=cfg.max_flow_rules)  # [B, K]
     slots_f = slots.reshape(-1)  # [N]
     item = jnp.repeat(jnp.arange(b), K)
 
-    enabled = f.enabled[slots_f]
-    la = f.limit_app[slots_f]
+    # ONE packed matmul replaces a dozen serialized per-field gathers; the
+    # dynamic per-rule state (warm-up tokens, latestPassedTime) rides in the
+    # same matrix, packed fresh each tick (a [F+1, 13] stack — free)
+    fg = T.small_gather_fields(
+        cfg,
+        T.pack_fields(
+            [
+                f.enabled,  # 0
+                f.limit_app,  # 1
+                f.strategy,  # 2
+                f.ref_node,  # 3
+                f.ref_ctx,  # 4
+                f.grade,  # 5
+                f.count,  # 6
+                f.behavior,  # 7
+                f.max_queue_ms,  # 8
+                f.warning_token,  # 9
+                f.slope,  # 10
+                state.warmup_tokens,  # 11
+                state.latest_passed_ms,  # 12
+            ]
+        ),
+        slots_f,
+    )
+    enabled = fg[:, 0] > 0
+    la = fg[:, 1].astype(jnp.int32)
     origin = acq.origin_id[item]
-    la_all = f.limit_app[slots]  # [B, K]
+    la_all = la.reshape(b, K)  # [B, K]
     named = ((la_all >= 0) & (la_all == acq.origin_id[:, None])).any(axis=1)  # [B]
     match = (
         (la == RT.LIMIT_ANY)
@@ -483,31 +623,32 @@ def _check_flow(
     applicable = enabled & match
 
     # --- node selection (FlowRuleChecker.selectNodeByRequesterAndStrategy:115)
-    strategy = f.strategy[slots_f]
+    strategy = fg[:, 2].astype(jnp.int32)
+    ref_node = fg[:, 3].astype(jnp.int32)
+    ref_ctx = fg[:, 4].astype(jnp.int32)
     direct_node = jnp.where(la == RT.LIMIT_ANY, acq.res[item], acq.origin_node[item])
-    relate_node = f.ref_node[slots_f]
-    chain_ok = (f.ref_ctx[slots_f] >= 0) & (f.ref_ctx[slots_f] == acq.ctx_name[item])
+    chain_ok = (ref_ctx >= 0) & (ref_ctx == acq.ctx_name[item])
     chain_node = jnp.where(chain_ok, acq.ctx_node[item], -1)
     node = jnp.where(
         strategy == STRATEGY_DIRECT,
         direct_node,
-        jnp.where(strategy == STRATEGY_RELATE, relate_node, chain_node),
+        jnp.where(strategy == STRATEGY_RELATE, ref_node, chain_node),
     )
     node_ok = (node >= 0) & (node != cfg.trash_row)
     applicable = applicable & node_ok
     node_safe = jnp.where(node_ok, node, cfg.trash_row)
 
-    grade = f.grade[slots_f]
-    rcount = f.count[slots_f]
-    behavior = jnp.where(grade == GRADE_QPS, f.behavior[slots_f], CONTROL_DEFAULT)
+    grade = fg[:, 5].astype(jnp.int32)
+    rcount = fg[:, 6]
+    behavior = jnp.where(grade == GRADE_QPS, fg[:, 7].astype(jnp.int32), CONTROL_DEFAULT)
     cnt = acq.count[item].astype(jnp.float32)
 
     # --- per-entry warm-up threshold (WarmUpController.canPass)
-    rest = state.warmup_tokens[slots_f]
-    warning = f.warning_token[slots_f]
+    rest = fg[:, 11]
+    warning = fg[:, 9]
     above = jnp.maximum(rest - warning, 0.0)
     warm_qps = jnp.floor(
-        1.0 / (above * f.slope[slots_f] + 1.0 / jnp.maximum(rcount, 1e-9)) + 0.5
+        1.0 / (above * fg[:, 10] + 1.0 / jnp.maximum(rcount, 1e-9)) + 0.5
     )
     warm_qps = jnp.where(rest >= warning, warm_qps, rcount)
 
@@ -529,9 +670,23 @@ def _check_flow(
         key, [cnt, jnp.ones_like(cnt), cost], elig_f
     )
 
-    wp = W.gather_window_event(state.win_sec, now_ms, node_safe, sec_cfg, W.EV_PASS)
-    wp = wp.astype(jnp.float32)
-    conc = state.concurrency[node_safe].astype(jnp.float32)
+    if cfg.use_mxu_tables:
+        # dense per-row windowed pass totals once (elementwise over the
+        # window tensor), then ONE one-hot gather for (pass, concurrency)
+        wsum = W.window_event(state.win_sec, now_ms, sec_cfg, W.EV_PASS)
+        both = T.big_gather(
+            cfg,
+            jnp.stack([wsum, state.concurrency], axis=1),
+            node_safe,
+            cfg.node_rows,
+            max_int=(1 << 24),
+        )
+        wp = both[:, 0].astype(jnp.float32)
+        conc = both[:, 1].astype(jnp.float32)
+    else:
+        wp = W.gather_window_event(state.win_sec, now_ms, node_safe, sec_cfg, W.EV_PASS)
+        wp = wp.astype(jnp.float32)
+        conc = state.concurrency[node_safe].astype(jnp.float32)
 
     # DefaultController.canPass:31-49
     thr_eff = jnp.where(is_warm, warm_qps, rcount)
@@ -541,11 +696,11 @@ def _check_flow(
 
     # RateLimiterController.canPass:50-105 (exact batched leaky bucket)
     now_f = now_ms.astype(jnp.float32)
-    l0 = state.latest_passed_ms[slots_f]
+    l0 = fg[:, 12]
     csum_incl = rank_cost + cost
     expected = jnp.maximum(l0 + csum_incl, now_f + csum_incl - cost)
     wait = expected - now_f
-    rl_block = wait > f.max_queue_ms[slots_f].astype(jnp.float32)
+    rl_block = wait > fg[:, 8]
 
     entry_block = jnp.where(is_rl, rl_block, basic_block) & applicable
     # warm-up RL blocks on either the pace or the warm-up threshold
@@ -562,9 +717,13 @@ def _check_flow(
 
     # advance latestPassedTime for admitted entries (even if a later slot
     # blocks the request, matching the reference's side-effect order)
-    latest = state.latest_passed_ms.at[
-        jnp.where(rl_ok, slots_f, cfg.max_flow_rules)
-    ].max(jnp.where(rl_ok, expected, -1.0e9), mode="drop")
+    latest = T.small_scatter_max(
+        cfg,
+        state.latest_passed_ms,
+        jnp.where(rl_ok, slots_f, jnp.int32(-1)),
+        jnp.where(rl_ok, expected, -3.0e38),
+        -3.0e38,
+    )
 
     return blocked, wait_ms.astype(jnp.int32), latest
 
@@ -583,13 +742,17 @@ def _check_degrade(
     KD = cfg.degrade_rules_per_resource
     b = acq.res.shape[0]
     res_l = jnp.minimum(acq.res, cfg.max_resources)
-    slots = rules.degrade.res_cbs[res_l]  # [B, KD]
+    slots = T.big_gather(cfg, rules.degrade.res_cbs, res_l, cfg.max_resources + 1, max_int=cfg.max_degrade_rules)
     slots_f = slots.reshape(-1)
     item = jnp.repeat(jnp.arange(b), KD)
-    enabled = rules.degrade.enabled[slots_f]
-
-    st = state.cb_state[slots_f]
-    retry_due = now_ms >= state.cb_retry_ms[slots_f]
+    dg = T.small_gather_fields(
+        cfg, T.pack_fields([rules.degrade.enabled, state.cb_state]), slots_f
+    )
+    enabled = dg[:, 0] > 0
+    st = dg[:, 1].astype(jnp.int32)
+    # retry deadlines are absolute engine-ms — int-exact gather (f32 packing
+    # would drift by several ms once uptime passes 2^24 ms ≈ 4.6 h)
+    retry_due = now_ms >= T.small_gather_int(cfg, state.cb_retry_ms, slots_f)
     open_wait = (st == D.CB_OPEN) & ~retry_due
     open_due = (st == D.CB_OPEN) & retry_due
     half = st == D.CB_HALF_OPEN
@@ -604,10 +767,11 @@ def _check_degrade(
     # is blocked by another CB on the same resource must not flip
     probe_ok = probe & ~blocked[item]
     Dn1 = cfg.max_degrade_rules + 1
-    flip = (
-        jnp.zeros((Dn1,), jnp.int32)
-        .at[jnp.minimum(slots_f, cfg.max_degrade_rules)]
-        .max(probe_ok.astype(jnp.int32))
+    flip = T.small_scatter_or(
+        cfg,
+        jnp.zeros((Dn1,), jnp.int32),
+        jnp.minimum(slots_f, cfg.max_degrade_rules),
+        probe_ok,
     )
     cb_state = jnp.where(
         (flip > 0) & (state.cb_state == D.CB_OPEN), D.CB_HALF_OPEN, state.cb_state
@@ -616,6 +780,13 @@ def _check_degrade(
 
 
 # ---------------------------------------------------------------------------
+
+
+#: every optional tick stage; make_tick compiles only what the rule set
+#: needs (the SPI slot-chain analog: absent slots cost nothing)
+ALL_FEATURES = frozenset(
+    {"authority", "system", "param", "flow", "degrade", "warmup", "nodes"}
+)
 
 
 def tick(
@@ -627,46 +798,67 @@ def tick(
     sys_load: jax.Array,  # float32 scalar — host-sampled load average
     sys_cpu: jax.Array,  # float32 scalar — host-sampled CPU usage [0,1]
     cfg: EngineConfig,
+    features: frozenset = ALL_FEATURES,
 ) -> Tuple[EngineState, TickOutput]:
     """One engine tick: completions, then batched decisions, then effects."""
     b = acq.res.shape[0]
     now_ms = now_ms.astype(jnp.int32)
+    zero_block = jnp.zeros((b,), bool)
 
     # 1. exits first: they release concurrency and update breakers
-    state = _process_completions(cfg, state, rules, comp, now_ms)
+    state = _process_completions(cfg, state, rules, comp, now_ms, features)
 
     # 2. warm-up token sync (per second, vectorized over rules)
-    state = _sync_warmup(cfg, state, rules, now_ms)
+    if "warmup" in features:
+        state = _sync_warmup(cfg, state, rules, now_ms)
 
     valid = acq.res != cfg.trash_row
     forced = valid & (acq.pre_verdict > 0)
 
     # 3. rule checks in reference slot order; each stage's blocks remove
     #    the item from later stages' rank accounting
-    auth_block = _check_authority(cfg, rules, acq) & valid & ~forced
+    if "authority" in features:
+        auth_block = _check_authority(cfg, rules, acq) & valid & ~forced
+    else:
+        auth_block = zero_block
     eligible = valid & ~auth_block & ~forced
 
-    sys_block = _check_system(
-        cfg, state, rules, acq, now_ms, sys_load, sys_cpu, eligible
-    )
+    if "system" in features:
+        sys_block = _check_system(
+            cfg, state, rules, acq, now_ms, sys_load, sys_cpu, eligible
+        )
+    else:
+        sys_block = zero_block
     eligible = eligible & ~sys_block
 
-    param_block, cms, cms_epochs, cms_idx, pslots_f, p_applicable = _check_param(
-        cfg, state, rules, acq, now_ms, eligible
-    )
-    param_block = param_block & eligible
+    if "param" in features:
+        param_block, cms, cms_epochs, cms_idx, pslots_f, p_applicable = _check_param(
+            cfg, state, rules, acq, now_ms, eligible
+        )
+        param_block = param_block & eligible
+    else:
+        param_block = zero_block
     eligible = eligible & ~param_block
 
-    flow_block, wait_ms, latest_passed = _check_flow(
-        cfg, state, rules, acq, now_ms, eligible
-    )
-    flow_block = flow_block & eligible
+    if "flow" in features:
+        flow_block, wait_ms, latest_passed = _check_flow(
+            cfg, state, rules, acq, now_ms, eligible
+        )
+        flow_block = flow_block & eligible
+        state = state._replace(latest_passed_ms=latest_passed)
+    else:
+        flow_block = zero_block
+        wait_ms = jnp.zeros((b,), jnp.int32)
     eligible = eligible & ~flow_block
-    state = state._replace(latest_passed_ms=latest_passed)
 
-    degrade_block, cb_state = _check_degrade(cfg, state, rules, acq, now_ms, eligible)
-    degrade_block = degrade_block & eligible
-    state = state._replace(cb_state=cb_state)
+    if "degrade" in features:
+        degrade_block, cb_state = _check_degrade(
+            cfg, state, rules, acq, now_ms, eligible
+        )
+        degrade_block = degrade_block & eligible
+        state = state._replace(cb_state=cb_state)
+    else:
+        degrade_block = zero_block
 
     passed = valid & ~forced & ~(
         auth_block | sys_block | param_block | flow_block | degrade_block
@@ -683,33 +875,51 @@ def tick(
     wait_ms = jnp.where(passed, wait_ms, 0)
 
     # 4. effects: pass/block statistics (StatisticSlot.java:54-123)
-    rows4 = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, acq.inbound)
+    with_nodes = "nodes" in features
+    rows = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, with_nodes)
     deltas1 = jnp.zeros((b, W.NUM_EVENTS), dtype=jnp.int32)
     deltas1 = deltas1.at[:, W.EV_PASS].set(jnp.where(passed, acq.count, 0))
     deltas1 = deltas1.at[:, W.EV_BLOCK].set(jnp.where(valid & ~passed, acq.count, 0))
-    deltas4 = jnp.tile(deltas1, (4, 1))
-    state = _scatter_events(cfg, state, now_ms, rows4, deltas4, None)
+    fan = 3 if with_nodes else 1
+    deltas = jnp.tile(deltas1, (fan, 1)) if with_nodes else deltas1
+    inb = valid & (acq.inbound > 0)
+    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
+    entry_deltas = entry_deltas.at[W.EV_PASS].set(
+        jnp.sum(jnp.where(inb & passed, acq.count, 0))
+    )
+    entry_deltas = entry_deltas.at[W.EV_BLOCK].set(
+        jnp.sum(jnp.where(inb & ~passed, acq.count, 0))
+    )
+    state, hist = _stat_update(
+        cfg, state, now_ms, rows, deltas, None, entry_deltas, None, None
+    )
 
-    inc = jnp.tile(jnp.where(passed, acq.count, 0), (4,))
-    concurrency = state.concurrency.at[rows4].add(inc, mode="drop")
+    if hist is not None:  # MXU: concurrency rides the pass histogram
+        # (the histogram already carries the ENTRY-row reduction)
+        concurrency = state.concurrency + hist[:, W.EV_PASS]
+    else:
+        inc = jnp.tile(jnp.where(passed, acq.count, 0), (fan,))
+        concurrency = state.concurrency.at[rows].add(inc, mode="drop")
+        concurrency = concurrency.at[cfg.entry_node_row].add(entry_deltas[W.EV_PASS])
     state = state._replace(concurrency=concurrency)
 
     # param pass counting into the sketch (only admitted traffic consumes
     # the per-value budget, like the token bucket decrement in
     # ParamFlowChecker.passDefaultLocalCheck)
-    KP = cfg.param_rules_per_resource
-    item_p = jnp.repeat(jnp.arange(b), KP)
-    p_add = p_applicable & passed[item_p]
-    cms = P.add(
-        cms,
-        cms_epochs,
-        cms_idx,
-        jnp.where(p_add, pslots_f, cfg.max_param_rules),
-        acq.param_hash[item_p],
-        jnp.where(p_add, acq.count[item_p], 0),
-        cfg.max_param_rules,
-    )
-    state = state._replace(cms=cms, cms_epochs=cms_epochs)
+    if "param" in features:
+        KP = cfg.param_rules_per_resource
+        item_p = jnp.repeat(jnp.arange(b), KP)
+        p_add = p_applicable & passed[item_p]
+        cms = P.add(
+            cms,
+            cms_epochs,
+            cms_idx,
+            jnp.where(p_add, pslots_f, cfg.max_param_rules),
+            acq.param_hash[item_p],
+            jnp.where(p_add, acq.count[item_p], 0),
+            cfg.max_param_rules,
+        )
+        state = state._replace(cms=cms, cms_epochs=cms_epochs)
 
     return state, TickOutput(verdict=verdict, wait_ms=wait_ms)
 
@@ -737,17 +947,26 @@ def compile_ruleset(
 _TICK_CACHE: dict = {}
 
 
-def make_tick(cfg: EngineConfig, donate: bool = True, jit: bool = True):
+def make_tick(
+    cfg: EngineConfig,
+    donate: bool = True,
+    jit: bool = True,
+    features: frozenset = ALL_FEATURES,
+):
     """Build the compiled tick for a given engine config.
 
-    Cached per (cfg, donate) — EngineConfig is frozen/hashable — so multiple
-    clients with the same config share one compiled executable (compile is
-    the expensive part, especially on the first call).
+    Cached per (cfg, donate, features) — EngineConfig is frozen/hashable —
+    so multiple clients with the same config share one compiled executable
+    (compile is the expensive part, especially on the first call).
+
+    ``features`` compiles only the stages the rule set needs — the SPI
+    slot-chain analog; a flow-only service pays nothing for param/degrade/
+    authority machinery, and "nodes" off drops the ctx/origin stat fan-out.
     """
-    key = (cfg, donate, jit)
+    key = (cfg, donate, jit, features)
     fn = _TICK_CACHE.get(key)
     if fn is None:
-        fn = functools.partial(tick, cfg=cfg)
+        fn = functools.partial(tick, cfg=cfg, features=features)
         if jit:
             fn = jax.jit(fn, donate_argnums=(0,) if donate else ())
         _TICK_CACHE[key] = fn

@@ -1,0 +1,186 @@
+"""MXU-shaped table ops — scatter-add / gather without XLA scatter.
+
+Motivation (measured on v5e): XLA lowers `table.at[idx].add(v)` and
+`table[idx]` with random indices to a serialized per-element loop —
+~65 ns/element — capping the engine tick at a few hundred K decisions/s.
+The TPU-native replacement expresses both operations as dense one-hot
+contractions on the MXU (the systolic array), which is exactly the
+"batched sketch/histogram kernel" shape the north star calls for:
+
+    row id  r = hi * n_lo + lo          (two-level decomposition)
+    Hi = one_hot(hi)  [B, n_hi]
+    Lo = one_hot(lo)  [B, n_lo]
+
+    scatter-add:  table[h, l] += sum_b Hi[b,h] * Lo[b,l] * v[b]
+                  == Hi^T @ (Lo * v[:, None])          (one matmul / plane)
+    gather:       out[b] = Hi[b] @ table @ Lo[b]^T
+                  == rowsum( (Hi @ table) * Lo )       (one matmul / plane)
+
+Exactness: every product involves a 0/1 one-hot factor, and in the gather
+each output element touches exactly one nonzero, so there is NO floating
+rounding beyond f32 accumulation of true integer values (< 2^24 — far
+above any per-tick cell count).  Everything runs in f32 on the MXU.
+
+Cost: B × N MACs per plane (N = table rows).  B=128K, N=256K → 34 GMAC ≈
+0.2–0.7 ms — vs ~10 ms for the serialized scatter of the same batch.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class TablePlan(NamedTuple):
+    n: int  # logical rows (ids in [0, n))
+    n_hi: int
+    n_lo: int
+
+    @property
+    def padded(self) -> int:
+        return self.n_hi * self.n_lo
+
+
+def make_plan(n: int, n_lo: int = 512) -> TablePlan:
+    """Split [0, n) ids as hi*n_lo + lo. n_lo is lane-friendly (mult of 128)."""
+    n_lo = min(n_lo, max(128, 1 << (max(n - 1, 1)).bit_length() - 1)) if n < n_lo else n_lo
+    n_lo = max(n_lo, 128)
+    n_hi = max((n + n_lo - 1) // n_lo, 1)
+    return TablePlan(n=n, n_hi=n_hi, n_lo=n_lo)
+
+
+def onehots(idx: jax.Array, plan: TablePlan, valid=None, dtype=jnp.bfloat16):
+    """Hi [B, n_hi], Lo [B, n_lo] one-hots; invalid/out-of-range ids produce
+    all-zero rows (the drop-mode analog).  bf16 by default — 0/1 is exact in
+    every float dtype and halves the one-hot memory traffic."""
+    idx = idx.astype(jnp.int32)
+    ok = (idx >= 0) & (idx < plan.n)
+    if valid is not None:
+        ok = ok & valid
+    safe = jnp.where(ok, idx, 0)
+    hi = safe // plan.n_lo
+    lo = safe % plan.n_lo
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (1, plan.n_hi), 1)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, plan.n_lo), 1)
+    Hi = ((hi[:, None] == iota_h) & ok[:, None]).astype(dtype)
+    Lo = (lo[:, None] == iota_l).astype(dtype)
+    return Hi, Lo
+
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+#: bf16 represents integers exactly up to 256 (8-bit mantissa); larger
+#: payloads are decomposed into base-256 digit planes so every matmul runs
+#: at full bf16 MXU rate while staying bit-exact
+_DIGIT = 256
+
+
+def _digit_planes(v_int: jax.Array, n_digits: int):
+    """Split nonnegative int32 into base-256 bf16-exact digit planes."""
+    out = []
+    for d in range(n_digits):
+        out.append(((v_int >> (8 * d)) & 0xFF).astype(jnp.bfloat16))
+    return out
+
+
+def scatter_add(
+    table: jax.Array,
+    plan: TablePlan,
+    Hi,
+    Lo,
+    values: jax.Array,
+    max_int: int = 65535,
+):
+    """table [n, ...planes] += one-hot scatter of values [B, ...planes].
+
+    Integer payloads run as bf16 digit-plane matmuls (exact, full MXU
+    rate); float payloads run one f32 matmul per plane (exact but slower).
+    ``max_int`` bounds each integer VALUE (not the accumulated cell), and
+    sets the number of digit planes."""
+    is_int = jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_
+    v = values
+    if v.ndim == 1:
+        v = v[:, None]
+    planes = v.shape[1:]
+    P = int(math.prod(planes))
+    v2 = v.reshape(v.shape[0], P)
+    Hi16, Lo16 = Hi.astype(jnp.bfloat16), Lo.astype(jnp.bfloat16)
+    upds = []
+    for p in range(P):
+        if is_int:
+            nd = max(1, (int(max_int).bit_length() + 7) // 8)
+            acc = None
+            for d, dig in enumerate(_digit_planes(v2[:, p].astype(jnp.int32), nd)):
+                LoV = Lo16 * dig[:, None]
+                part = jax.lax.dot(
+                    Hi16.T, LoV, preferred_element_type=jnp.float32
+                )
+                acc = part * float(1 << (8 * d)) if acc is None else acc + part * float(1 << (8 * d))
+            upds.append(acc)
+        else:
+            LoV = Lo * v2[:, p : p + 1].astype(jnp.float32)
+            upds.append(jnp.matmul(Hi.T, LoV, precision=_HIGHEST))
+    upd = jnp.stack(upds, axis=-1).reshape(plan.padded, P)[: plan.n]
+    out = table.astype(jnp.float32) + upd.reshape(table.shape)
+    return out.astype(table.dtype) if jnp.issubdtype(table.dtype, jnp.integer) else out
+
+
+def gather(
+    table: jax.Array, plan: TablePlan, Hi, Lo, max_int: Optional[int] = None
+) -> jax.Array:
+    """out [B, ...planes] = table[idx] with zeros for invalid ids.
+
+    table: [n, ...planes].  For NONNEGATIVE integer tables, pass ``max_int``
+    (the max cell value) to run bf16 digit-plane matmuls instead of f32;
+    signed tables must omit it (digit planes assume unsigned bits)."""
+    planes = table.shape[1:]
+    P = int(math.prod(planes)) if planes else 1
+    is_int = jnp.issubdtype(table.dtype, jnp.integer)
+    use_digits = is_int and max_int is not None
+    pad = plan.padded - plan.n
+
+    def padded(t2):
+        if pad:
+            t2 = jnp.concatenate([t2, jnp.zeros((pad, t2.shape[1]), t2.dtype)], axis=0)
+        return t2
+
+    outs = []
+    if use_digits:
+        nd = max(1, (int(max_int).bit_length() + 7) // 8)
+        t_int = table.reshape(plan.n, P).astype(jnp.int32)
+        Hi16, Lo16 = Hi.astype(jnp.bfloat16), Lo.astype(jnp.bfloat16)
+        for p in range(P):
+            acc = None
+            for d in range(nd):
+                dig = ((t_int[:, p] >> (8 * d)) & 0xFF).astype(jnp.bfloat16)
+                tp = padded(dig[:, None]).reshape(plan.n_hi, plan.n_lo)
+                sel = jax.lax.dot(Hi16, tp, preferred_element_type=jnp.float32)
+                part = jnp.sum(sel * Lo, axis=1)
+                acc = part * float(1 << (8 * d)) if acc is None else acc + part * float(1 << (8 * d))
+            outs.append(acc)
+    else:
+        t = padded(table.astype(jnp.float32).reshape(plan.n, P)).reshape(
+            plan.n_hi, plan.n_lo, P
+        )
+        for p in range(P):
+            # [B, n_hi] @ [n_hi, n_lo] -> [B, n_lo]; then per-b dot with Lo
+            sel = jnp.matmul(Hi, t[:, :, p], precision=_HIGHEST)
+            outs.append(jnp.sum(sel * Lo, axis=1))
+    out = jnp.stack(outs, axis=-1)
+    out = out.reshape((-1,) + planes) if planes else out[:, 0]
+    if is_int:
+        out = jnp.round(out).astype(table.dtype)
+    elif out.dtype != table.dtype:
+        out = out.astype(table.dtype)
+    return out
+
+
+def scatter_or(table: jax.Array, plan: TablePlan, Hi, Lo, flag: jax.Array):
+    """Boolean OR-scatter (0/1 max): table [n] int32/bool |= flag [B]."""
+    hist = scatter_add(
+        jnp.zeros((plan.n,), jnp.float32), plan, Hi, Lo, flag.astype(jnp.float32)
+    )
+    return (table.astype(jnp.bool_) | (hist > 0)).astype(table.dtype)

@@ -27,6 +27,48 @@ import jax.numpy as jnp
 _NEG = jnp.float32(-3.0e38)
 
 
+def fast_cumsum(v: jax.Array) -> jax.Array:
+    """Inclusive prefix sum via two-level triangular matmuls.
+
+    XLA's cumsum lowers to a serialized log-pass reduce-window on TPU
+    (~17 ns/element measured); expressing the prefix as chunked
+    lower-triangular matmuls moves it onto the MXU: within-chunk prefix =
+    v_chunks @ tril, cross-chunk offsets = prefix of chunk sums."""
+    n = v.shape[0]
+    C = 128
+    if n <= C:
+        tri = jnp.tril(jnp.ones((n, n), jnp.float32))
+        return jnp.matmul(v.astype(jnp.float32), tri.T, precision=jax.lax.Precision.HIGHEST)
+    pad = (-n) % C
+    vp = jnp.concatenate([v.astype(jnp.float32), jnp.zeros((pad,), jnp.float32)]) if pad else v.astype(jnp.float32)
+    rows = vp.reshape(-1, C)
+    tri = jnp.tril(jnp.ones((C, C), jnp.float32))
+    within = jnp.matmul(rows, tri.T, precision=jax.lax.Precision.HIGHEST)  # [R, C]
+    row_tot = within[:, -1]
+    offsets = fast_cumsum(row_tot) - row_tot  # exclusive chunk offsets
+    out = (within + offsets[:, None]).reshape(-1)
+    return out[:n]
+
+
+def fast_running_max(v: jax.Array) -> jax.Array:
+    """Inclusive running max, chunked so the scan passes are lane-parallel:
+    within-chunk scans run across all chunks at once, cross-chunk offsets
+    recurse on the (tiny) chunk-maxima vector."""
+    n = v.shape[0]
+    C = 128
+    if n <= C:
+        return jax.lax.associative_scan(jnp.maximum, v)
+    pad = (-n) % C
+    vp = jnp.concatenate([v, jnp.full((pad,), _NEG, v.dtype)]) if pad else v
+    rows = vp.reshape(-1, C)
+    within = jax.lax.associative_scan(jnp.maximum, rows, axis=1)  # [R, C]
+    row_tot = within[:, -1]
+    prev = fast_running_max(row_tot)
+    offsets = jnp.concatenate([jnp.full((1,), _NEG, v.dtype), prev[:-1]])
+    out = jnp.maximum(within, offsets[:, None]).reshape(-1)
+    return out[:n]
+
+
 def grouped_exclusive_cumsum(
     keys: jax.Array,  # int32 [N] group key per item
     values: Sequence[jax.Array],  # each float32/int32 [N]
@@ -34,28 +76,30 @@ def grouped_exclusive_cumsum(
 ) -> Tuple[jax.Array, ...]:
     """For each item: sum over eligible earlier same-key items, per value array.
 
-    "Earlier" means smaller batch index (arrival order) — the sort is stable,
-    so within a key group the original order is preserved.
+    "Earlier" means smaller batch index (arrival order).  Implementation:
+    ONE multi-operand stable sort carries (key, position, values) together —
+    no serialized permutation gathers — then segmented prefix sums, then a
+    second sort by position restores batch order.  O(N log N) sort network +
+    MXU prefix sums; every payload rides the sort comparators.
     """
     n = keys.shape[0]
-    order = jnp.argsort(keys, stable=True)
-    inv = jnp.argsort(order, stable=True)  # position of item i in sorted order
-    ks = keys[order]
-    seg_start = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), ks[1:] != ks[:-1]]
-    )  # [N]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
+    masked = [
+        jnp.where(eligible, v.astype(jnp.float32), 0.0) for v in values
+    ]
+    sorted_ops = jax.lax.sort([keys, pos] + masked, num_keys=2, is_stable=False)
+    ks, ps = sorted_ops[0], sorted_ops[1]
+    seg_start = jnp.concatenate([jnp.ones((1,), dtype=bool), ks[1:] != ks[:-1]])
 
-    outs = []
-    for v in values:
-        vs = jnp.where(eligible[order], v[order].astype(jnp.float32), 0.0)
-        csum_excl = jnp.cumsum(vs) - vs
+    ranks_sorted = []
+    for vs in sorted_ops[2:]:
+        csum_excl = fast_cumsum(vs) - vs
         # propagate each segment's starting csum to all its members
-        base = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(seg_start, csum_excl, _NEG)
-        )
-        rank_sorted = csum_excl - base
-        outs.append(rank_sorted[inv])
-    return tuple(outs)
+        base = fast_running_max(jnp.where(seg_start, csum_excl, _NEG))
+        ranks_sorted.append(csum_excl - base)
+    # un-sort: order by original position (single key, payloads ride along)
+    restored = jax.lax.sort([ps] + ranks_sorted, num_keys=1, is_stable=False)
+    return tuple(restored[1:])
 
 
 def grouped_first(

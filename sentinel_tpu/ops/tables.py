@@ -1,0 +1,218 @@
+"""Backend-selectable table primitives for the engine.
+
+Every random-access table op in the tick goes through this layer, so the
+engine logic is written once and the memory-access strategy is chosen by
+``cfg.use_mxu_tables``:
+
+- **cpu / small** (False): plain XLA gather / scatter-add.  Optimal on CPU
+  and fine for small test configs.
+- **mxu** (True): one-hot matmul contractions (ops/mxu_table.py) for
+  big per-row tables, and a single packed-matrix matmul for per-rule-slot
+  field gathers.  On TPU this replaces XLA's serialized ~65 ns/element
+  scatter/gather loops with MXU work at B×N MACs — the difference between
+  ~0.3M and tens of M decisions/s (measured on v5e).
+
+Exactness: both paths are bit-identical for integer payloads (< 2^24) and
+match to f32 rounding for float payloads — the MXU contractions multiply
+by 0/1 one-hots only (see ops/mxu_table.py); einsums run at
+Precision.HIGHEST so f32 values survive the MXU's bf16 pass decomposition.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.ops import mxu_table as MX
+
+HIGHEST = jax.lax.Precision.HIGHEST
+
+
+# ---------------------------------------------------------------------------
+# big tables: [n_rows, ...planes] indexed by dynamic ids
+# ---------------------------------------------------------------------------
+
+
+def big_gather(
+    cfg: EngineConfig,
+    table: jax.Array,
+    idx: jax.Array,
+    n: int,
+    max_int: int = None,
+) -> jax.Array:
+    """table[idx] with zeros for ids outside [0, n).
+
+    ``max_int``: for NONNEGATIVE int tables, the max cell value — enables
+    exact bf16 digit-plane matmuls on the MXU path (several× faster than
+    the f32 fallback)."""
+    if not cfg.use_mxu_tables:
+        safe = jnp.clip(idx, 0, n - 1)
+        out = table[safe]
+        ok = (idx >= 0) & (idx < n)
+        return jnp.where(ok.reshape(ok.shape + (1,) * (out.ndim - 1)), out, 0)
+    plan = MX.make_plan(n, cfg.mxu_n_lo)
+    Hi, Lo = MX.onehots(idx, plan)
+    return MX.gather(table, plan, Hi, Lo, max_int=max_int)
+
+
+def big_scatter_add(
+    cfg: EngineConfig,
+    table: jax.Array,
+    idx: jax.Array,
+    values: jax.Array,
+    n: int,
+    max_int: int = 65535,
+) -> jax.Array:
+    """table.at[idx].add(values), dropping ids outside [0, n).
+
+    ``max_int`` bounds each integer VALUE (not the cell) for the bf16
+    digit decomposition; 65535 covers per-item counts."""
+    if not cfg.use_mxu_tables:
+        ok = (idx >= 0) & (idx < n)
+        v = values
+        okb = ok.reshape(ok.shape + (1,) * (v.ndim - 1))
+        return table.at[jnp.where(ok, idx, jnp.int32(2**30))].add(
+            jnp.where(okb, v, 0), mode="drop"
+        )
+    plan = MX.make_plan(n, cfg.mxu_n_lo)
+    Hi, Lo = MX.onehots(idx, plan)
+    return MX.scatter_add(table, plan, Hi, Lo, values, max_int=max_int)
+
+
+def histogram(
+    cfg: EngineConfig, idx: jax.Array, values: jax.Array, n: int, max_int: int = 65535
+) -> jax.Array:
+    """Dense [n, ...planes] sum of values grouped by id (dropped if OOB).
+
+    The MXU-native replacement for scatter-into-state: compute the dense
+    per-row delta once, then apply it with an elementwise add."""
+    planes = values.shape[1:]
+    dtype = values.dtype if jnp.issubdtype(values.dtype, jnp.floating) else jnp.int32
+    zeros = jnp.zeros((n,) + planes, dtype)
+    return big_scatter_add(cfg, zeros, idx, values, n, max_int=max_int)
+
+
+# ---------------------------------------------------------------------------
+# small tables: per-rule-slot field rows, S <= a few thousand
+# ---------------------------------------------------------------------------
+
+
+def pack_fields(fields: Sequence[jax.Array]) -> jax.Array:
+    """[S, F] f32 matrix from per-slot field vectors (bool/int/float)."""
+    cols = [jnp.asarray(f).astype(jnp.float32) for f in fields]
+    return jnp.stack(cols, axis=1)
+
+
+def small_gather_fields(
+    cfg: EngineConfig, packed: jax.Array, slots: jax.Array
+) -> jax.Array:
+    """[N, F] f32 = packed[slots] — ONE matmul on the MXU path, replacing F
+    separate serialized gathers."""
+    S = packed.shape[0]
+    if not cfg.use_mxu_tables:
+        safe = jnp.clip(slots, 0, S - 1)
+        return packed[safe]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    onehot = (jnp.clip(slots, 0, S - 1)[:, None] == iota).astype(jnp.float32)
+    return jnp.matmul(onehot, packed, precision=HIGHEST)
+
+
+def small_gather_int(cfg: EngineConfig, table: jax.Array, slots: jax.Array) -> jax.Array:
+    """Exact int32 gather from a small table via f32 matmuls.
+
+    A raw int32 (e.g. a param hash) does not survive an f32 matmul above
+    2^24; splitting into unsigned 16-bit halves keeps each half exact and
+    the int32 recombination restores the original bits."""
+    if not cfg.use_mxu_tables:
+        S = table.shape[0]
+        return table[jnp.clip(slots, 0, S - 1)]
+    t = jnp.asarray(table)
+    flat = t.reshape(t.shape[0], -1).astype(jnp.uint32)
+    hi = (flat >> 16).astype(jnp.float32)
+    lo = (flat & 0xFFFF).astype(jnp.float32)
+    packed = jnp.concatenate([hi, lo], axis=1)
+    g = small_gather_fields(cfg, packed, slots)
+    F = flat.shape[1]
+    hi_i = jnp.round(g[:, :F]).astype(jnp.uint32)
+    lo_i = jnp.round(g[:, F:]).astype(jnp.uint32)
+    out = ((hi_i << 16) | lo_i).astype(jnp.int32)
+    return out.reshape((slots.shape[0],) + t.shape[1:])
+
+
+def small_scatter_add(
+    cfg: EngineConfig, table: jax.Array, slots: jax.Array, values: jax.Array
+) -> jax.Array:
+    """table [S, ...planes] .at[slots].add(values) — one-hot matmul on MXU.
+    Out-of-range slots are dropped."""
+    S = table.shape[0]
+    if not cfg.use_mxu_tables:
+        return table.at[jnp.where((slots >= 0) & (slots < S), slots, 2**30)].add(
+            values, mode="drop"
+        )
+    ok = (slots >= 0) & (slots < S)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    onehot = ((jnp.where(ok, slots, 0)[:, None] == iota) & ok[:, None]).astype(
+        jnp.float32
+    )
+    v = values.astype(jnp.float32)
+    squeeze = v.ndim == 1
+    if squeeze:
+        v = v[:, None]
+    upd = jnp.einsum("ns,np->sp", onehot, v, precision=HIGHEST)
+    if squeeze:
+        upd = upd[:, 0]
+    out = table.astype(jnp.float32) + upd.reshape(table.shape)
+    return out.astype(table.dtype) if jnp.issubdtype(table.dtype, jnp.integer) else out
+
+
+def small_scatter_or(
+    cfg: EngineConfig, table: jax.Array, slots: jax.Array, flag: jax.Array
+) -> jax.Array:
+    """Boolean OR-scatter into [S] (0/1 semantics)."""
+    hist = small_scatter_add(
+        cfg, jnp.zeros(table.shape, jnp.float32), slots, flag.astype(jnp.float32)
+    )
+    return (table.astype(jnp.bool_) | (hist > 0)).astype(table.dtype)
+
+
+def small_scatter_max(
+    cfg: EngineConfig, table: jax.Array, slots: jax.Array, values: jax.Array, neutral: float
+) -> jax.Array:
+    """table [S] = elementwise max with per-slot max of values [N].
+
+    MXU path: masked one-hot substitution + column max — O(N*S) VPU ops,
+    fine for S <= a few thousand."""
+    S = table.shape[0]
+    if not cfg.use_mxu_tables:
+        return table.at[jnp.where((slots >= 0) & (slots < S), slots, 2**30)].max(
+            values, mode="drop"
+        )
+    ok = (slots >= 0) & (slots < S)
+    safe = jnp.where(ok, slots, 0)
+    n = slots.shape[0]
+    chunk = 8192
+    pad = (-n) % chunk
+    if pad:
+        safe = jnp.concatenate([safe, jnp.zeros((pad,), safe.dtype)])
+        ok = jnp.concatenate([ok, jnp.zeros((pad,), bool)])
+        values = jnp.concatenate([values, jnp.full((pad,), neutral, values.dtype)])
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+
+    def body(carry, xs):
+        s, o, v = xs
+        onehot = (s[:, None] == iota) & o[:, None]  # [chunk, S]
+        cand = jnp.where(onehot, v[:, None], neutral)
+        return jnp.maximum(carry, jnp.max(cand, axis=0)), None
+
+    C = safe.shape[0] // chunk
+    init = jnp.full((S,), neutral, jnp.float32)
+    colmax, _ = jax.lax.scan(
+        body,
+        init,
+        (safe.reshape(C, chunk), ok.reshape(C, chunk), values.astype(jnp.float32).reshape(C, chunk)),
+    )
+    return jnp.maximum(table, colmax.astype(table.dtype))

@@ -132,6 +132,41 @@ def add_batch(
     return WindowState(counts=counts, rt_sum=rt_sum, rt_min=rt_min, epochs=state.epochs)
 
 
+def add_dense(
+    state: WindowState,
+    now_ms: jax.Array,
+    count_hist: jax.Array,  # int32 [rows, NUM_EVENTS] — dense per-row deltas
+    rt_hist: Optional[jax.Array],  # float32 [rows] or None
+    cfg: WindowConfig,
+) -> WindowState:
+    """Apply a precomputed dense per-row delta to the current bucket column.
+
+    The MXU-path companion of add_batch: the batch is first reduced to a
+    dense histogram (ops/tables.histogram — one-hot matmuls), then landing
+    it in the window is a plain elementwise add on the current column.
+    Per-row rt_min is NOT maintained on this path (the serialized
+    scatter-min costs more than the whole tick); callers that need a min
+    keep it for fixed rows via reductions."""
+    state = refresh(state, now_ms, cfg)
+    idx = current_index(now_ms, cfg)
+    counts = state.counts.at[:, idx, :].add(count_hist.astype(state.counts.dtype))
+    rt_sum = state.rt_sum if rt_hist is None else state.rt_sum.at[:, idx].add(rt_hist)
+    return WindowState(
+        counts=counts, rt_sum=rt_sum, rt_min=state.rt_min, epochs=state.epochs
+    )
+
+
+def min_into_row(
+    state: WindowState, now_ms: jax.Array, row: int, value: jax.Array, cfg: WindowConfig
+) -> WindowState:
+    """Scatter-min a scalar into ONE fixed row's current bucket (static
+    index — cheap): keeps ENTRY-node minRt exact for the BBR system check
+    while the dense path skips per-row minimums."""
+    idx = current_index(now_ms, cfg)
+    rt_min = state.rt_min.at[row, idx].min(value)
+    return state._replace(rt_min=rt_min)
+
+
 def valid_mask(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
     """bool [nb] — which columns fall inside [now - interval, now]."""
     wid = _wid(now_ms, cfg)

@@ -857,6 +857,13 @@ class SentinelClient:
                 r.future.set_result((int(verdict[i]), int(wait[i])))
 
 
+def _mask_min_rt(v: float) -> float:
+    """RT_MIN_INIT (5000) is the 'no data yet' sentinel — also what the MXU
+    backend leaves for per-resource rows (it skips per-row minimums).
+    Report 0.0 instead of a phantom 5-second minimum."""
+    return 0.0 if v >= W.RT_MIN_INIT else v
+
+
 class ClientStats:
     """Read-side node statistics (the ClusterNode/StatisticNode getters:
     passQps/blockQps/successQps/exceptionQps/avgRt/curThreadNum)."""
@@ -882,7 +889,7 @@ class ClientStats:
             "successQps": succ / interval_s,
             "exceptionQps": float(counts[W.EV_EXCEPTION]) / interval_s,
             "avgRt": float(np.asarray(rt_tot)[0]) / succ if succ > 0 else 0.0,
-            "minRt": float(np.asarray(rt_min)[0]),
+            "minRt": _mask_min_rt(float(np.asarray(rt_min)[0])),
             "curThreadNum": conc,
         }
 
@@ -923,7 +930,7 @@ class ClientStats:
                 "exceptionQps": float(counts[i, W.EV_EXCEPTION]) / interval_s,
                 "occupiedPassQps": float(counts[i, W.EV_OCCUPIED]) / interval_s,
                 "avgRt": float(rt_tot[i]) / succ if succ > 0 else 0.0,
-                "minRt": float(rt_min[i]),
+                "minRt": _mask_min_rt(float(rt_min[i])),
                 "curThreadNum": int(conc[i]),
             }
         return out

@@ -1,0 +1,110 @@
+"""Backend equivalence: the MXU one-hot-matmul table path must make the
+same decisions as the XLA scatter/gather path — the engine logic is shared
+and the two memory-access strategies are exact (ops/tables.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sentinel_tpu.core import rules as R
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.core.rule_tensors import hash_param
+from sentinel_tpu.ops import engine as E
+from sentinel_tpu.runtime.registry import Registry
+
+
+def _mk(cfg):
+    reg = Registry(cfg)
+    for i in range(1, 33):
+        reg.resource_id(f"r{i}")
+    rules = dict(
+        flow_rules=[
+            R.FlowRule(resource="r1", count=5),
+            R.FlowRule(resource="r2", count=3, control_behavior=R.CONTROL_RATE_LIMITER),
+            R.FlowRule(resource="r3", count=100, grade=R.GRADE_THREAD),
+            R.FlowRule(resource="r4", count=8, control_behavior=R.CONTROL_WARM_UP),
+        ],
+        degrade_rules=[
+            R.DegradeRule(resource="r5", grade=R.CB_STRATEGY_ERROR_COUNT, count=2, time_window=3),
+            R.DegradeRule(resource="r6", grade=R.CB_STRATEGY_SLOW_REQUEST_RATIO, count=50, slow_ratio_threshold=0.5, time_window=2),
+        ],
+        param_rules=[R.ParamFlowRule(resource="r7", count=2, param_idx=0)],
+        authority_rules=[
+            R.AuthorityRule(resource="r8", limit_app="bad", strategy=R.AUTHORITY_BLACK)
+        ],
+        system_rules=[R.SystemRule(qps=1000)],
+    )
+    ruleset = E.compile_ruleset(cfg, reg, **rules)
+    return reg, ruleset
+
+
+def _workload(cfg, reg, seed):
+    rng = np.random.default_rng(seed)
+    b = cfg.batch_size
+    res = rng.integers(1, 12, b).astype(np.int32)
+    origin_bad = reg.origin_id("bad")
+    acq = E.empty_acquire(cfg)._replace(
+        res=jnp.asarray(res),
+        count=jnp.ones((b,), jnp.int32),
+        origin_id=jnp.asarray(
+            np.where(rng.random(b) < 0.3, origin_bad, -1).astype(np.int32)
+        ),
+        inbound=jnp.asarray((rng.random(b) < 0.5).astype(np.int32)),
+        param_hash=jnp.asarray(
+            np.array(
+                [hash_param(f"v{i % 3}") if r == 7 else 0 for i, r in enumerate(res)],
+                dtype=np.int32,
+            )
+        ),
+    )
+    comp_res = rng.integers(1, 12, b).astype(np.int32)
+    comp = E.empty_complete(cfg)._replace(
+        res=jnp.asarray(comp_res),
+        rt=jnp.asarray(rng.uniform(1, 120, b).astype(np.float32)),
+        success=jnp.ones((b,), jnp.int32),
+        error=jnp.asarray((rng.random(b) < 0.3).astype(np.int32)),
+        inbound=jnp.asarray((rng.random(b) < 0.5).astype(np.int32)),
+    )
+    return acq, comp
+
+
+@pytest.mark.parametrize("features", [E.ALL_FEATURES, frozenset({"flow"})])
+def test_backend_equivalence(features):
+    cfgs = [
+        small_engine_config(use_mxu_tables=False),
+        small_engine_config(use_mxu_tables=True),
+    ]
+    outs = []
+    for cfg in cfgs:
+        reg, ruleset = _mk(cfg)
+        tick = E.make_tick(cfg, donate=False, features=features)
+        state = E.init_state(cfg)
+        verdicts = []
+        for step in range(8):
+            acq, comp = _workload(cfg, reg, seed=step)
+            state, out = tick(
+                state,
+                ruleset,
+                acq,
+                comp,
+                jnp.int32(step * 300),
+                jnp.float32(0.1),
+                jnp.float32(0.1),
+            )
+            verdicts.append(np.asarray(out.verdict))
+        outs.append(
+            dict(
+                verdicts=np.stack(verdicts),
+                counts=np.asarray(state.win_sec.counts),
+                conc=np.asarray(state.concurrency),
+                cb=np.asarray(state.cb_state),
+                latest=np.asarray(state.latest_passed_ms),
+            )
+        )
+    a, b = outs
+    np.testing.assert_array_equal(a["verdicts"], b["verdicts"])
+    np.testing.assert_array_equal(a["counts"], b["counts"])
+    np.testing.assert_array_equal(a["conc"], b["conc"])
+    np.testing.assert_array_equal(a["cb"], b["cb"])
+    np.testing.assert_allclose(a["latest"], b["latest"], rtol=1e-6, atol=1e-3)
