@@ -140,6 +140,13 @@ class EngineConfig:
     # path; False uses plain XLA gather/scatter — the CPU/test path
     use_mxu_tables: bool = False
     mxu_n_lo: int = 512
+    # global stats sketch: resources beyond the exact row space get sketch
+    # ids and windowed CMS observability instead of pass-through (ops/
+    # gsketch.py) — tick cost independent of resource count
+    sketch_stats: bool = False
+    sketch_depth: int = 2
+    sketch_width: int = 1 << 14  # CMS eps = e/width of window volume
+    sketch_capacity: int = 1 << 22  # max interned sketch resources
 
     # dtype policy: counters int32, rt sums float32
     @property

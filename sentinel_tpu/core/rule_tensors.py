@@ -120,7 +120,9 @@ def compile_flow_rules(
         if not rule.is_valid() or slot >= F:
             continue
         rid = registry.resource_id(rule.resource)
-        if rid is None:
+        if rid is None or rid > cfg.max_resources:
+            # no exact row (sketch-id / pass-through resource) -> the rule
+            # cannot be enforced; observability continues via the sketch
             continue
         k = per_res_count.get(rid, 0)
         if k >= K:
@@ -191,7 +193,9 @@ def compile_degrade_rules(
         if not rule.is_valid() or slot >= D:
             continue
         rid = registry.resource_id(rule.resource)
-        if rid is None:
+        if rid is None or rid > cfg.max_resources:
+            # no exact row (sketch-id / pass-through resource) -> the rule
+            # cannot be enforced; observability continues via the sketch
             continue
         k = per_res_count.get(rid, 0)
         if k >= KD:
@@ -252,7 +256,9 @@ def compile_param_rules(
         if not rule.is_valid() or slot >= P:
             continue
         rid = registry.resource_id(rule.resource)
-        if rid is None:
+        if rid is None or rid > cfg.max_resources:
+            # no exact row (sketch-id / pass-through resource) -> the rule
+            # cannot be enforced; observability continues via the sketch
             continue
         k = per_res_count.get(rid, 0)
         if k >= KP:
@@ -287,7 +293,9 @@ def compile_authority_rules(
         if not rule.is_valid():
             continue
         rid = registry.resource_id(rule.resource)
-        if rid is None:
+        if rid is None or rid > cfg.max_resources:
+            # no exact row (sketch-id / pass-through resource) -> the rule
+            # cannot be enforced; observability continues via the sketch
             continue
         t.mode[rid] = 1 if rule.strategy == R.AUTHORITY_WHITE else 2
         for i, o in enumerate(rule.origins()[:KA]):

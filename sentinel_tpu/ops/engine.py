@@ -55,10 +55,27 @@ from sentinel_tpu.core.rules import (
     STRATEGY_RELATE,
 )
 from sentinel_tpu.ops import degrade as D
+from sentinel_tpu.ops import gsketch as GS
 from sentinel_tpu.ops import param as P
 from sentinel_tpu.ops import tables as T
 from sentinel_tpu.ops import window as W
-from sentinel_tpu.ops.rank import grouped_exclusive_cumsum, grouped_first
+from sentinel_tpu.ops.rank import (
+    fast_cumsum,
+    grouped_exclusive_cumsum,
+    grouped_exclusive_cumsum_small,
+    grouped_first,
+)
+
+#: max dense key space for the sort-free bucketed rank (ops/rank.py)
+_SMALL_RANK_LIMIT = 65536
+
+
+def _rank(cfg: EngineConfig, keys, values, eligible, key_space: int):
+    """Grouped exclusive cumsum, picking the sort-free bucketed kernel when
+    the key space is dense and small (the MXU path at scale)."""
+    if cfg.use_mxu_tables and key_space <= _SMALL_RANK_LIMIT:
+        return grouped_exclusive_cumsum_small(keys, values, eligible, key_space)
+    return grouped_exclusive_cumsum(keys, values, eligible)
 
 
 class EngineState(NamedTuple):
@@ -77,6 +94,9 @@ class EngineState(NamedTuple):
     # per param-rule count-min sketch
     cms: jax.Array  # int32 [P+1, nbp, depth, width]
     cms_epochs: jax.Array  # int32 [P+1, nbp]
+    # global observability sketch for tail resources (ops/gsketch.py);
+    # [1,1,1,1]-shaped dummy when sketch_stats is off
+    gs: GS.SketchState
 
 
 class RuleSet(NamedTuple):
@@ -150,6 +170,21 @@ def init_state(cfg: EngineConfig) -> EngineState:
             dtype=jnp.int32,
         ),
         cms_epochs=jnp.full((Pn + 1, cfg.cms_sample_count), -10, dtype=jnp.int32),
+        gs=GS.init_sketch(sketch_config(cfg))
+        if cfg.sketch_stats
+        else GS.SketchState(
+            counts=jnp.zeros((1, 1, 1, GS.PLANES), jnp.int32),
+            epochs=jnp.full((1,), -2, jnp.int32),
+        ),
+    )
+
+
+def sketch_config(cfg: EngineConfig) -> GS.SketchConfig:
+    return GS.SketchConfig(
+        sample_count=cfg.second_sample_count,
+        window_ms=cfg.second_window_ms,
+        depth=cfg.sketch_depth,
+        width=cfg.sketch_width,
     )
 
 
@@ -307,6 +342,22 @@ def _process_completions(
     state, hist = _stat_update(
         cfg, state, now_ms, rows, deltas, rt, entry_deltas, entry_rt, entry_rt_min
     )
+    if cfg.sketch_stats:
+        rt_q = jnp.round(
+            jnp.minimum(comp.rt, float(cfg.statistic_max_rt)) * GS.RT_SCALE
+        ).astype(jnp.int32)
+        vals = jnp.stack([comp.success, comp.error, rt_q], axis=1)
+        state = state._replace(
+            gs=GS.add(
+                state.gs,
+                now_ms,
+                comp.res,
+                vals,
+                (W.EV_SUCCESS, W.EV_EXCEPTION, GS.RT_PLANE),
+                valid,
+                sketch_config(cfg),
+            )
+        )
 
     # concurrency release on all touched rows (+ ENTRY via its fixed row)
     if hist is not None:  # MXU: reuse the success histogram, no extra matmul
@@ -457,9 +508,9 @@ def _check_system(
 
     inbound = (acq.inbound > 0) & eligible
     cnt = acq.count.astype(jnp.float32)
-    (rank_q,) = grouped_exclusive_cumsum(
-        jnp.zeros_like(acq.res), [cnt], inbound
-    )
+    # single group (the global ENTRY node) → plain exclusive prefix sum
+    vim = jnp.where(inbound, cnt, 0.0)
+    rank_q = fast_cumsum(vim) - vim
     rank_t = rank_q  # one concurrent slot per inbound attempt (count≈1)
 
     s = rules.system
@@ -587,8 +638,8 @@ def _check_flow(
     item = jnp.repeat(jnp.arange(b), K)
 
     # ONE packed matmul replaces a dozen serialized per-field gathers; the
-    # dynamic per-rule state (warm-up tokens, latestPassedTime) rides in the
-    # same matrix, packed fresh each tick (a [F+1, 13] stack — free)
+    # dynamic warm-up token state rides in the same matrix, packed fresh
+    # each tick (a [F+1, 12] stack — free)
     fg = T.small_gather_fields(
         cfg,
         T.pack_fields(
@@ -605,11 +656,17 @@ def _check_flow(
                 f.warning_token,  # 9
                 f.slope,  # 10
                 state.warmup_tokens,  # 11
-                state.latest_passed_ms,  # 12
             ]
         ),
         slots_f,
     )
+    # latestPassedTime is absolute engine-ms: by multi-day uptime its
+    # magnitude outgrows the matmul's bf16x3 precision (~2^-22 relative),
+    # so it takes the bit-exact integer gather (cost granularity is 1 ms
+    # anyway — RateLimiter costs are rounded to whole ms)
+    latest_g = T.small_gather_int(
+        cfg, jnp.round(state.latest_passed_ms).astype(jnp.int32), slots_f
+    ).astype(jnp.float32)
     enabled = fg[:, 0] > 0
     la = fg[:, 1].astype(jnp.int32)
     origin = acq.origin_id[item]
@@ -666,8 +723,12 @@ def _check_flow(
     # --- within-tick ranks (key: decision node; RL keys by rule slot)
     key = jnp.where(is_rl, jnp.int32(cfg.node_rows) + slots_f, node_safe)
     elig_f = eligible[item] & applicable
-    rank_tok, rank_thr, rank_cost = grouped_exclusive_cumsum(
-        key, [cnt, jnp.ones_like(cnt), cost], elig_f
+    rank_tok, rank_thr, rank_cost = _rank(
+        cfg,
+        key,
+        [cnt, jnp.ones_like(cnt), cost],
+        elig_f,
+        cfg.node_rows + cfg.max_flow_rules + 1,
     )
 
     if cfg.use_mxu_tables:
@@ -696,7 +757,7 @@ def _check_flow(
 
     # RateLimiterController.canPass:50-105 (exact batched leaky bucket)
     now_f = now_ms.astype(jnp.float32)
-    l0 = fg[:, 12]
+    l0 = latest_g
     csum_incl = rank_cost + cost
     expected = jnp.maximum(l0 + csum_incl, now_f + csum_incl - cost)
     wait = expected - now_f
@@ -758,7 +819,15 @@ def _check_degrade(
     half = st == D.CB_HALF_OPEN
 
     probe_cand = open_due & enabled & eligible[item]
-    probe = grouped_first(slots_f, probe_cand)  # one probe per rule
+    # one probe per rule: first eligible candidate by rank
+    (p_rank,) = _rank(
+        cfg,
+        jnp.minimum(slots_f, cfg.max_degrade_rules),
+        [jnp.ones_like(slots_f, dtype=jnp.float32)],
+        probe_cand,
+        cfg.max_degrade_rules + 1,
+    )
+    probe = probe_cand & (p_rank < 0.5)
 
     entry_block = enabled & (open_wait | (open_due & ~probe) | half)
     blocked = (entry_block & eligible[item]).reshape(b, KD).any(axis=1)
@@ -893,6 +962,25 @@ def tick(
     state, hist = _stat_update(
         cfg, state, now_ms, rows, deltas, None, entry_deltas, None, None
     )
+    if cfg.sketch_stats:
+        gvals = jnp.stack(
+            [
+                jnp.where(passed, acq.count, 0),
+                jnp.where(valid & ~passed, acq.count, 0),
+            ],
+            axis=1,
+        )
+        state = state._replace(
+            gs=GS.add(
+                state.gs,
+                now_ms,
+                acq.res,
+                gvals,
+                (W.EV_PASS, W.EV_BLOCK),
+                valid,
+                sketch_config(cfg),
+            )
+        )
 
     if hist is not None:  # MXU: concurrency rides the pass histogram
         # (the histogram already carries the ENTRY-row reduction)

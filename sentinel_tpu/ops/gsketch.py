@@ -1,0 +1,131 @@
+"""Global per-resource statistics sketch — observability beyond capacity.
+
+The north star (SURVEY §0, BASELINE): serve MILLIONS of resources per chip.
+Exact per-row windows cost one histogram plane of B×node_rows MACs per
+tick, so the exact space is kept small (ruled + hot resources) and the
+long tail of unruled resources is tracked in a windowed count-min sketch:
+
+    gs_counts : int32 [nb, depth, width, PLANES]
+    gs_epochs : int32 [nb]
+
+Each tick scatter-adds every valid event (pass/block on acquire;
+success/exception/rt on completion) into the current time bucket at the
+resource's hashed column per depth — MXU one-hot contractions over WIDTH,
+so cost is B×width×depth MACs, independent of how many resources exist.
+Reads take min over depth of the windowed column sums: a classic CMS
+overestimate with eps = e/width, delta = e^-depth — at width 64K and real
+(Zipf) traffic the per-resource error is a fraction of a percent of total
+volume.  The reference's analog is nothing: beyond 6,000 chains it stops
+tracking entirely (Constants.java:37).  Time bucketing mirrors
+ops/window.py's epoch scheme.
+
+Plane layout: [EV_PASS, EV_BLOCK, EV_EXCEPTION, EV_SUCCESS, EV_OCCUPIED,
+RT_Q] — the window event enum plus quantized RT (1/8 ms units).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.ops import mxu_table as MX
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops.param import cms_cell
+
+PLANES = W.NUM_EVENTS + 1  # + quantized RT sum
+RT_PLANE = W.NUM_EVENTS
+RT_SCALE = 8.0  # 1/8 ms resolution
+
+
+class SketchConfig(NamedTuple):
+    sample_count: int
+    window_ms: int
+    depth: int
+    width: int
+
+    @property
+    def interval_ms(self) -> int:
+        return self.sample_count * self.window_ms
+
+
+class SketchState(NamedTuple):
+    counts: jax.Array  # int32 [nb, depth, width, PLANES]
+    epochs: jax.Array  # int32 [nb]
+
+
+def init_sketch(cfg: SketchConfig) -> SketchState:
+    return SketchState(
+        counts=jnp.zeros((cfg.sample_count, cfg.depth, cfg.width, PLANES), jnp.int32),
+        epochs=jnp.full((cfg.sample_count,), -(cfg.sample_count + 1), jnp.int32),
+    )
+
+
+def _wid(now_ms, cfg: SketchConfig):
+    return (now_ms // cfg.window_ms).astype(jnp.int32)
+
+
+def refresh(state: SketchState, now_ms, cfg: SketchConfig) -> SketchState:
+    wid = _wid(now_ms, cfg)
+    idx = wid % cfg.sample_count
+    stale = state.epochs[idx] != wid
+
+    def reset(s):
+        return SketchState(
+            counts=s.counts.at[idx].set(0), epochs=s.epochs.at[idx].set(wid)
+        )
+
+    return jax.lax.cond(stale, reset, lambda s: s, state)
+
+
+def add(
+    state: SketchState,
+    now_ms,
+    res: jax.Array,  # int32 [N] resource ids (any id space; OOB-safe)
+    values: jax.Array,  # int32 [N, len(plane_idx)] deltas for the named planes
+    plane_idx: Tuple[int, ...],  # which PLANES columns these values land in
+    valid: jax.Array,  # bool [N]
+    cfg: SketchConfig,
+    max_int: int = 65535,
+) -> SketchState:
+    """Only the named planes are contracted — the acquire path lands
+    (pass, block), the completion path (success, exception, rt_q); paying
+    for all PLANES on both would double the sketch's MAC bill."""
+    state = refresh(state, now_ms, cfg)
+    idx = _wid(now_ms, cfg) % cfg.sample_count
+    cols = cms_cell(res, cfg.depth, cfg.width)  # [N, depth]
+    plan = MX.make_plan(cfg.width, 512)
+    col = state.counts[idx]  # [depth, width, PLANES]
+    upds = []
+    for d in range(cfg.depth):
+        Hi, Lo = MX.onehots(cols[:, d], plan, valid=valid)
+        upds.append(
+            MX.scatter_add(
+                jnp.zeros((cfg.width, len(plane_idx)), jnp.int32),
+                plan,
+                Hi,
+                Lo,
+                values,
+                max_int=max_int,
+            )
+        )
+    upd = jnp.stack(upds, axis=0)  # [depth, width, len(plane_idx)]
+    new_col = col.at[:, :, jnp.asarray(plane_idx)].add(upd)
+    return state._replace(counts=state.counts.at[idx].set(new_col))
+
+
+def estimate(
+    state: SketchState, now_ms, res: jax.Array, cfg: SketchConfig
+) -> jax.Array:
+    """int32 [N, PLANES]: windowed min-over-depth estimates per resource."""
+    wid = _wid(now_ms, cfg)
+    valid = (state.epochs > wid - cfg.sample_count) & (state.epochs <= wid)
+    windowed = jnp.sum(
+        state.counts * valid[:, None, None, None], axis=0
+    )  # [depth, width, PLANES]
+    cols = cms_cell(res, cfg.depth, cfg.width)  # [N, depth]
+    per_depth = jnp.stack(
+        [windowed[d][cols[:, d]] for d in range(cfg.depth)], axis=0
+    )  # [depth, N, PLANES]
+    return jnp.min(per_depth, axis=0)

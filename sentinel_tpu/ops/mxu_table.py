@@ -70,7 +70,13 @@ def onehots(idx: jax.Array, plan: TablePlan, valid=None, dtype=jnp.bfloat16):
     return Hi, Lo
 
 
-_HIGHEST = jax.lax.Precision.HIGHEST
+# one side of every contraction is an exact 0/1 one-hot; on TPU, DEFAULT
+# precision for f32 operands lowers to a bf16x3 decomposition (measured:
+# float scatters of values ≤ 5000 come back bit-exact, values near 2^24
+# show ~2^-22 relative error), so it is used for float payloads while
+# integer payloads take the exact digit planes below.  Callers with
+# payloads beyond ~2^22 must use digit/int gathers, not this fallback.
+_PRECISION = jax.lax.Precision.DEFAULT
 
 #: bf16 represents integers exactly up to 256 (8-bit mantissa); larger
 #: payloads are decomposed into base-256 digit planes so every matmul runs
@@ -122,7 +128,7 @@ def scatter_add(
             upds.append(acc)
         else:
             LoV = Lo * v2[:, p : p + 1].astype(jnp.float32)
-            upds.append(jnp.matmul(Hi.T, LoV, precision=_HIGHEST))
+            upds.append(jnp.matmul(Hi.T, LoV, precision=_PRECISION))
     upd = jnp.stack(upds, axis=-1).reshape(plan.padded, P)[: plan.n]
     out = table.astype(jnp.float32) + upd.reshape(table.shape)
     return out.astype(table.dtype) if jnp.issubdtype(table.dtype, jnp.integer) else out
@@ -167,7 +173,7 @@ def gather(
         )
         for p in range(P):
             # [B, n_hi] @ [n_hi, n_lo] -> [B, n_lo]; then per-b dot with Lo
-            sel = jnp.matmul(Hi, t[:, :, p], precision=_HIGHEST)
+            sel = jnp.matmul(Hi, t[:, :, p], precision=_PRECISION)
             outs.append(jnp.sum(sel * Lo, axis=1))
     out = jnp.stack(outs, axis=-1)
     out = out.reshape((-1,) + planes) if planes else out[:, 0]

@@ -12,10 +12,12 @@ engine logic is written once and the memory-access strategy is chosen by
   scatter/gather loops with MXU work at B×N MACs — the difference between
   ~0.3M and tens of M decisions/s (measured on v5e).
 
-Exactness: both paths are bit-identical for integer payloads (< 2^24) and
-match to f32 rounding for float payloads — the MXU contractions multiply
-by 0/1 one-hots only (see ops/mxu_table.py); einsums run at
-Precision.HIGHEST so f32 values survive the MXU's bf16 pass decomposition.
+Exactness: both paths are bit-identical for integer payloads through the
+bf16 digit planes; float payloads go through Precision.DEFAULT matmuls,
+which on TPU lower to a bf16x3 decomposition (measured exact for values
+below ~2^22; ~2^-22 relative beyond).  Payloads whose magnitude outgrows
+that — absolute engine-ms timestamps, raw 32-bit hashes — use the
+bit-exact integer gathers (small_gather_int / digit planes) instead.
 """
 
 from __future__ import annotations
@@ -29,7 +31,7 @@ import jax.numpy as jnp
 from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.ops import mxu_table as MX
 
-HIGHEST = jax.lax.Precision.HIGHEST
+PRECISION = jax.lax.Precision.DEFAULT  # exact: one side is a 0/1 one-hot
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +109,11 @@ def pack_fields(fields: Sequence[jax.Array]) -> jax.Array:
     return jnp.stack(cols, axis=1)
 
 
+#: above this, a flat [N, S] one-hot's memory traffic dominates — switch to
+#: the two-level decomposition (same MACs, B×(n_hi+n_lo) memory)
+_FLAT_ONEHOT_LIMIT = 1024
+
+
 def small_gather_fields(
     cfg: EngineConfig, packed: jax.Array, slots: jax.Array
 ) -> jax.Array:
@@ -116,9 +123,14 @@ def small_gather_fields(
     if not cfg.use_mxu_tables:
         safe = jnp.clip(slots, 0, S - 1)
         return packed[safe]
+    safe = jnp.clip(slots, 0, S - 1)
+    if S > _FLAT_ONEHOT_LIMIT:
+        plan = MX.make_plan(S, cfg.mxu_n_lo)
+        Hi, Lo = MX.onehots(safe, plan)
+        return MX.gather(packed, plan, Hi, Lo)
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
-    onehot = (jnp.clip(slots, 0, S - 1)[:, None] == iota).astype(jnp.float32)
-    return jnp.matmul(onehot, packed, precision=HIGHEST)
+    onehot = (safe[:, None] == iota).astype(jnp.float32)
+    return jnp.matmul(onehot, packed, precision=PRECISION)
 
 
 def small_gather_int(cfg: EngineConfig, table: jax.Array, slots: jax.Array) -> jax.Array:
@@ -154,6 +166,10 @@ def small_scatter_add(
             values, mode="drop"
         )
     ok = (slots >= 0) & (slots < S)
+    if S > _FLAT_ONEHOT_LIMIT:
+        plan = MX.make_plan(S, cfg.mxu_n_lo)
+        Hi, Lo = MX.onehots(slots, plan, valid=ok)
+        return MX.scatter_add(table, plan, Hi, Lo, values)
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
     onehot = ((jnp.where(ok, slots, 0)[:, None] == iota) & ok[:, None]).astype(
         jnp.float32
@@ -162,7 +178,7 @@ def small_scatter_add(
     squeeze = v.ndim == 1
     if squeeze:
         v = v[:, None]
-    upd = jnp.einsum("ns,np->sp", onehot, v, precision=HIGHEST)
+    upd = jnp.einsum("ns,np->sp", onehot, v, precision=PRECISION)
     if squeeze:
         upd = upd[:, 0]
     out = table.astype(jnp.float32) + upd.reshape(table.shape)

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.ops import engine as E
+from sentinel_tpu.ops import gsketch as GS
 from sentinel_tpu.ops import window as W
 
 
@@ -61,6 +62,15 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
         cb_epochs=rep,
         cms=rep,
         cms_epochs=rep,
+        # the global sketch shards on its width axis (counts [nb, depth,
+        # width, planes]) so tail-resource observability scales with chips;
+        # with the sketch off the state is a unit dummy — replicate it
+        gs=GS.SketchState(
+            counts=NamedSharding(mesh, PS(None, None, "res", None))
+            if cfg.sketch_stats
+            else rep,
+            epochs=rep,
+        ),
     )
 
 

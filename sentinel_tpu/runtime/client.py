@@ -897,42 +897,93 @@ class ClientStats:
         rid = self.registry_peek(name)
         if rid is None:
             return None
+        if self._c.registry.is_sketch_id(rid):
+            return self._sketch_stats([rid])[0]
         return self._row_stats(rid)
+
+    def _sketch_stats(self, rids, now_ms: Optional[int] = None) -> list:
+        """Windowed CMS estimates for sketch-id resources (ops/gsketch.py);
+        pass/block are small overestimates bounded by the sketch (eps,delta)."""
+        from sentinel_tpu.ops import engine as E
+        from sentinel_tpu.ops import gsketch as GS
+
+        c = self._c
+        scfg = E.sketch_config(c.cfg)
+        now = jnp.int32(c.time.now_ms() if now_ms is None else now_ms)
+        with c._engine_lock:
+            est = np.asarray(
+                GS.estimate(c._state.gs, now, jnp.asarray(rids, jnp.int32), scfg)
+            )
+        interval_s = scfg.interval_ms / 1000.0
+        out = []
+        for i in range(len(rids)):
+            succ = float(est[i, W.EV_SUCCESS])
+            out.append(
+                {
+                    "passQps": float(est[i, W.EV_PASS]) / interval_s,
+                    "blockQps": float(est[i, W.EV_BLOCK]) / interval_s,
+                    "successQps": succ / interval_s,
+                    "exceptionQps": float(est[i, W.EV_EXCEPTION]) / interval_s,
+                    "occupiedPassQps": float(est[i, W.EV_OCCUPIED]) / interval_s,
+                    "avgRt": float(est[i, GS.RT_PLANE]) / GS.RT_SCALE / succ
+                    if succ > 0
+                    else 0.0,
+                    "minRt": 0.0,
+                    "curThreadNum": 0,
+                }
+            )
+        return out
 
     def snapshot(self, now_ms: Optional[int] = None) -> Dict[str, Dict[str, float]]:
         """Trailing-second stats for ALL registered resources in ONE batched
         device gather — the TPU-shaped walk of the ClusterNode map that
-        MetricTimerListener does per second."""
+        MetricTimerListener does per second.  Sketch-id resources (beyond
+        the exact row space) are served from the global CMS in a second
+        batched read."""
         c = self._c
         resources = c.registry.resources()
         if not resources:
             return {}
-        names = list(resources.keys())
-        rows_np = np.asarray(list(resources.values()), dtype=np.int32)
-        rows = jnp.asarray(rows_np)
-        sec_cfg = W.WindowConfig(c.cfg.second_sample_count, c.cfg.second_window_ms)
-        now = jnp.int32(c.time.now_ms() if now_ms is None else now_ms)
-        with c._engine_lock:
-            st = c._state
-            counts = np.asarray(W.gather_window_counts(st.win_sec, now, rows, sec_cfg))
-            rt_tot, rt_min = W.gather_window_rt(st.win_sec, now, rows, sec_cfg)
-            conc = np.asarray(st.concurrency)[rows_np]
-        rt_tot = np.asarray(rt_tot)
-        rt_min = np.asarray(rt_min)
-        interval_s = sec_cfg.interval_ms / 1000.0
+        # ONE timestamp for the whole snapshot: the read paths may jit-compile
+        # on first use (hundreds of ms), and a per-phase `now` would let the
+        # trailing window slide between the exact and sketch reads
+        now_ms = c.time.now_ms() if now_ms is None else now_ms
+        exact = {n: r for n, r in resources.items() if not c.registry.is_sketch_id(r)}
+        sketch = {n: r for n, r in resources.items() if c.registry.is_sketch_id(r)}
         out: Dict[str, Dict[str, float]] = {}
-        for i, name in enumerate(names):
-            succ = float(counts[i, W.EV_SUCCESS])
-            out[name] = {
-                "passQps": float(counts[i, W.EV_PASS]) / interval_s,
-                "blockQps": float(counts[i, W.EV_BLOCK]) / interval_s,
-                "successQps": succ / interval_s,
-                "exceptionQps": float(counts[i, W.EV_EXCEPTION]) / interval_s,
-                "occupiedPassQps": float(counts[i, W.EV_OCCUPIED]) / interval_s,
-                "avgRt": float(rt_tot[i]) / succ if succ > 0 else 0.0,
-                "minRt": _mask_min_rt(float(rt_min[i])),
-                "curThreadNum": int(conc[i]),
-            }
+        if exact:
+            names = list(exact.keys())
+            rows_np = np.asarray(list(exact.values()), dtype=np.int32)
+            rows = jnp.asarray(rows_np)
+            sec_cfg = W.WindowConfig(c.cfg.second_sample_count, c.cfg.second_window_ms)
+            now = jnp.int32(now_ms)
+            with c._engine_lock:
+                st = c._state
+                counts = np.asarray(
+                    W.gather_window_counts(st.win_sec, now, rows, sec_cfg)
+                )
+                rt_tot, rt_min = W.gather_window_rt(st.win_sec, now, rows, sec_cfg)
+                conc = np.asarray(st.concurrency)[rows_np]
+            rt_tot = np.asarray(rt_tot)
+            rt_min = np.asarray(rt_min)
+            interval_s = sec_cfg.interval_ms / 1000.0
+            for i, name in enumerate(names):
+                succ = float(counts[i, W.EV_SUCCESS])
+                out[name] = {
+                    "passQps": float(counts[i, W.EV_PASS]) / interval_s,
+                    "blockQps": float(counts[i, W.EV_BLOCK]) / interval_s,
+                    "successQps": succ / interval_s,
+                    "exceptionQps": float(counts[i, W.EV_EXCEPTION]) / interval_s,
+                    "occupiedPassQps": float(counts[i, W.EV_OCCUPIED]) / interval_s,
+                    "avgRt": float(rt_tot[i]) / succ if succ > 0 else 0.0,
+                    "minRt": _mask_min_rt(float(rt_min[i])),
+                    "curThreadNum": int(conc[i]),
+                }
+        if sketch:
+            s_names = list(sketch.keys())
+            stats = self._sketch_stats(list(sketch.values()), now_ms=now_ms)
+            for name, s in zip(s_names, stats):
+                out[name] = s
         return out
 
     def entry_node(self) -> Dict[str, float]:

@@ -41,6 +41,10 @@ class Registry:
         # [max_resources, max_nodes)
         self._extra_rows: Dict[Tuple[str, str], int] = {}
         self._next_extra = cfg.max_resources
+        # sketch resources: ids beyond node_rows, stats tracked in the
+        # global CMS sketch (ops/gsketch.py) instead of exact rows
+        self._sketch_names: Dict[int, str] = {}
+        self._next_sketch = cfg.node_rows
         # origins are a separate id space (matched against limitApp)
         self._origins: Dict[str, int] = {}
         self._origin_names: List[str] = []
@@ -63,6 +67,19 @@ class Registry:
             if rid is not None:
                 return rid
             if self._next_res >= self.cfg.max_resources:
+                # exact rows exhausted → sketch id (observability-only,
+                # no rules), or pass-through when the sketch is off
+                # (CtSph.java:200-205 degradation)
+                if (
+                    self.cfg.sketch_stats
+                    and self._next_sketch - self.cfg.node_rows
+                    < self.cfg.sketch_capacity
+                ):
+                    rid = self._next_sketch
+                    self._next_sketch += 1
+                    self._resources[name] = rid
+                    self._sketch_names[rid] = name
+                    return rid
                 return None
             rid = self._next_res
             self._next_res += 1
@@ -76,7 +93,10 @@ class Registry:
     def resource_name(self, rid: int) -> Optional[str]:
         if 0 < rid < len(self._resource_names):
             return self._resource_names[rid]
-        return None
+        return self._sketch_names.get(rid)
+
+    def is_sketch_id(self, rid: int) -> bool:
+        return rid >= self.cfg.node_rows
 
     @property
     def num_resources(self) -> int:

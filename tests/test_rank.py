@@ -56,6 +56,29 @@ def test_grouped_exclusive_cumsum_oracle():
     np.testing.assert_allclose(np.asarray(r2), o2, rtol=1e-3, atol=1e-2)
 
 
+def test_grouped_exclusive_cumsum_small_matches_sort_version():
+    from sentinel_tpu.ops.rank import grouped_exclusive_cumsum_small
+
+    rng = np.random.default_rng(5)
+    n, S = 10_000, 97
+    keys = rng.integers(0, S, n).astype(np.int32)
+    v1 = rng.integers(1, 4, n).astype(np.float32)
+    v2 = rng.uniform(0, 5, n).astype(np.float32)
+    elig = rng.random(n) < 0.7
+    ref = grouped_exclusive_cumsum(
+        jnp.asarray(keys), [jnp.asarray(v1), jnp.asarray(v2)], jnp.asarray(elig)
+    )
+    got = grouped_exclusive_cumsum_small(
+        jnp.asarray(keys),
+        [jnp.asarray(v1), jnp.asarray(v2)],
+        jnp.asarray(elig),
+        key_space=S,
+        chunk=1024,
+    )
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4, atol=2e-2)
+
+
 def test_grouped_first_oracle():
     keys = jnp.asarray([5, 3, 5, 3, 7, 5], jnp.int32)
     elig = jnp.asarray([False, True, True, True, True, True])
