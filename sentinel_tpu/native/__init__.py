@@ -1,0 +1,12 @@
+"""Native host runtime (C++): MPMC event ring + string interner.
+
+The compute path is JAX/XLA on device; the runtime AROUND it — request
+threads feeding micro-batches, string->id interning on the ingest hot
+path — is native C++ bound via ctypes (see sentinel_host.cpp).  Pure-
+Python fallbacks keep everything working when a compiler is unavailable.
+"""
+
+from sentinel_tpu.native.loader import native_available, load_native
+from sentinel_tpu.native.ring import EventRing, NativeInterner
+
+__all__ = ["native_available", "load_native", "EventRing", "NativeInterner"]

@@ -1,0 +1,103 @@
+"""Build + load the native host library.
+
+Compiled lazily with g++ into the package directory (falls back to a
+temp dir when the package is read-only); cached by source mtime.  When no
+toolchain is available, ``load_native()`` returns None and callers use
+the pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "sentinel_host.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _so_path() -> str:
+    base = os.path.dirname(__file__)
+    if os.access(base, os.W_OK):
+        return os.path.join(base, "_sentinel_host.so")
+    # never a shared world-writable path: a pre-planted .so there would be
+    # loaded into this process — use a per-user 0700 cache dir and refuse
+    # anything not owned by us
+    d = os.path.join(
+        os.path.expanduser("~"), ".cache", "sentinel_tpu", "native"
+    )
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.stat(d)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        d = tempfile.mkdtemp(prefix="sentinel_tpu_native_")
+    return os.path.join(d, "_sentinel_host.so")
+
+
+def _build(so: str) -> bool:
+    try:
+        r = subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, _SRC],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        if r.returncode != 0:
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().warning("native build failed: %s", r.stderr[-2000:])
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    i32, i64, u32, u64, f32 = c.c_int32, c.c_int64, c.c_uint32, c.c_uint64, c.c_float
+    p = c.c_void_p
+    lib.sx_ring_new.restype = p
+    lib.sx_ring_new.argtypes = [u64]
+    lib.sx_ring_free.argtypes = [p]
+    lib.sx_ring_push.restype = i32
+    lib.sx_ring_push.argtypes = [p, i32, i32, i32, i32, i32, f32, i32, i32]
+    lib.sx_ring_drain.restype = i64
+    lib.sx_ring_drain.argtypes = [p, i64] + [p] * 8
+    lib.sx_ring_size.restype = i64
+    lib.sx_ring_size.argtypes = [p]
+    lib.sx_intern_new.restype = p
+    lib.sx_intern_new.argtypes = [u64, i32, i32]
+    lib.sx_intern_free.argtypes = [p]
+    lib.sx_intern_get.restype = i32
+    lib.sx_intern_get.argtypes = [p, c.c_char_p, u32]
+    lib.sx_intern_count.restype = i32
+    lib.sx_intern_count.argtypes = [p, i32]
+    return lib
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """The bound CDLL, building it on first use; None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        so = _so_path()
+        fresh = os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC)
+        if not fresh and not _build(so):
+            return None
+        try:
+            _LIB = _bind(ctypes.CDLL(so))
+        except OSError:
+            _LIB = None
+        return _LIB
+
+
+def native_available() -> bool:
+    return load_native() is not None

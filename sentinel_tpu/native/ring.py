@@ -1,0 +1,160 @@
+"""Python wrappers over the native ring/interner, with pure fallbacks.
+
+EventRing drains straight into numpy arrays (the exact layout the engine's
+AcquireBatch/CompleteBatch want), so the tick thread's batch assembly is a
+single C call instead of a Python loop over event objects.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from collections import deque
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sentinel_tpu.native.loader import load_native
+
+FLAG_INBOUND = 1
+FLAG_PRIORITIZED = 2
+FLAG_COMPLETION = 4
+
+
+class EventRing:
+    """Bounded MPMC event ring; native when possible, deque fallback."""
+
+    def __init__(self, capacity_pow2: int = 1 << 16):
+        assert capacity_pow2 & (capacity_pow2 - 1) == 0
+        self.capacity = capacity_pow2
+        self._lib = load_native()
+        if self._lib is not None:
+            self._ring = self._lib.sx_ring_new(capacity_pow2)
+            if not self._ring:  # allocation failed → fallback
+                self._lib = None
+        if self._lib is None:
+            self._dq: deque = deque()
+            self._dq_lock = threading.Lock()
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def push(
+        self,
+        res: int,
+        count: int = 1,
+        origin_id: int = -1,
+        param_hash: int = 0,
+        flags: int = 0,
+        rt_ms: float = 0.0,
+        error: int = 0,
+        user_tag: int = 0,
+    ) -> bool:
+        if self._lib is not None:
+            return (
+                self._lib.sx_ring_push(
+                    self._ring, res, count, origin_id, param_hash, flags,
+                    rt_ms, error, user_tag,
+                )
+                == 0
+            )
+        with self._dq_lock:
+            if len(self._dq) >= self.capacity:
+                return False
+            self._dq.append((res, count, origin_id, param_hash, flags, rt_ms, error, user_tag))
+            return True
+
+    def drain(self, max_n: int) -> Tuple[np.ndarray, ...]:
+        """(res, count, origin_id, param_hash, flags, rt_ms, error,
+        user_tag) arrays of length n <= max_n."""
+        res = np.empty(max_n, np.int32)
+        count = np.empty(max_n, np.int32)
+        origin = np.empty(max_n, np.int32)
+        ph = np.empty(max_n, np.int32)
+        flags = np.empty(max_n, np.int32)
+        rt = np.empty(max_n, np.float32)
+        err = np.empty(max_n, np.int32)
+        tag = np.empty(max_n, np.int32)
+        if self._lib is not None:
+            cp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+            n = self._lib.sx_ring_drain(
+                self._ring, max_n, cp(res), cp(count), cp(origin), cp(ph),
+                cp(flags), cp(rt), cp(err), cp(tag),
+            )
+        else:
+            n = 0
+            with self._dq_lock:
+                while n < max_n and self._dq:
+                    row = self._dq.popleft()
+                    res[n], count[n], origin[n], ph[n], flags[n], rt[n], err[n], tag[n] = row
+                    n += 1
+        return tuple(a[:n] for a in (res, count, origin, ph, flags, rt, err, tag))
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.sx_ring_size(self._ring))
+        return len(self._dq)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_ring", None):
+            lib.sx_ring_free(self._ring)
+            self._ring = None
+
+
+class NativeInterner:
+    """String -> dense id with lock-free reads.
+
+    Not wired into the Python Registry: crossing ctypes per lookup costs
+    more than a dict hit, so from Python the dict wins.  This exists for
+    native-side ingestion (a C command/RLS front door resolving resource
+    names without entering Python — SURVEY §2.9's host boundary), where
+    the same id space must be shared with the device engine."""
+
+    def __init__(self, capacity_pow2: int = 1 << 20, first_id: int = 1, max_ids: int = 1 << 20):
+        self._lib = load_native()
+        self.first_id = first_id
+        if self._lib is not None:
+            self._tbl = self._lib.sx_intern_new(capacity_pow2, first_id, max_ids)
+            if not self._tbl:
+                self._lib = None
+        if self._lib is None:
+            self._py: dict = {}
+            self._lock = threading.Lock()
+            self._next = first_id
+            self._max = max_ids
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def get(self, name: str) -> int:
+        """Dense id for name; -1 when capacity is exhausted."""
+        if self._lib is not None:
+            b = name.encode("utf-8")
+            return int(self._lib.sx_intern_get(self._tbl, b, len(b)))
+        rid = self._py.get(name)
+        if rid is not None:
+            return rid
+        with self._lock:
+            rid = self._py.get(name)
+            if rid is not None:
+                return rid
+            if self._next >= self._max:
+                return -1
+            rid = self._next
+            self._next += 1
+            self._py[name] = rid
+            return rid
+
+    def count(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.sx_intern_count(self._tbl, self.first_id))
+        return len(self._py)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_tbl", None):
+            lib.sx_intern_free(self._tbl)
+            self._tbl = None

@@ -1,0 +1,231 @@
+// Native host-runtime primitives for the TPU flow-control engine.
+//
+// The device engine consumes fixed-shape micro-batches; the host hot path
+// is "many request threads append events, one tick thread drains a batch".
+// In the reference this role is played by lock-free Java structures
+// (LongAdder queues, COW maps — SURVEY §5 "race detection").  Here:
+//
+//  - sx_ring:    a bounded MPMC ring buffer of acquire/complete events
+//                (atomic ticket acquisition, per-slot sequence numbers —
+//                 the classic Vyukov bounded queue), drained in batch
+//                 order directly into caller-provided arrays so Python
+//                 receives ready-to-use int32/float32 buffers.
+//  - sx_intern:  an open-addressing FNV-1a string -> dense id table with
+//                a single writer lock and lock-free readers (the analog
+//                of CtSph's copy-on-write chainMap, CtSph.java:207-211).
+//
+// Built as a plain C ABI shared library; Python binds via ctypes
+// (pybind11 is not available in this image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// event ring
+// ---------------------------------------------------------------------------
+
+struct sx_event {
+    int32_t res;
+    int32_t count;
+    int32_t origin_id;
+    int32_t param_hash;
+    int32_t flags;    // bit0 inbound, bit1 prioritized, bit2 completion
+    float   rt_ms;    // completions
+    int32_t error;    // completions
+    int32_t user_tag; // round-trips to the drainer (e.g. future index)
+};
+
+struct sx_slot {
+    std::atomic<uint64_t> seq;
+    sx_event ev;
+};
+
+struct sx_ring {
+    uint64_t mask;
+    sx_slot* slots;
+    alignas(64) std::atomic<uint64_t> head; // next write ticket
+    alignas(64) std::atomic<uint64_t> tail; // next read ticket
+};
+
+sx_ring* sx_ring_new(uint64_t capacity_pow2) {
+    if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1)) != 0)
+        return nullptr;
+    auto* r = new (std::nothrow) sx_ring();
+    if (!r) return nullptr;
+    r->slots = new (std::nothrow) sx_slot[capacity_pow2];
+    if (!r->slots) { delete r; return nullptr; }
+    r->mask = capacity_pow2 - 1;
+    for (uint64_t i = 0; i <= r->mask; ++i)
+        r->slots[i].seq.store(i, std::memory_order_relaxed);
+    r->head.store(0, std::memory_order_relaxed);
+    r->tail.store(0, std::memory_order_relaxed);
+    return r;
+}
+
+void sx_ring_free(sx_ring* r) {
+    if (!r) return;
+    delete[] r->slots;
+    delete r;
+}
+
+// push one event; returns 0 on success, -1 if the ring is full
+int32_t sx_ring_push(sx_ring* r, int32_t res, int32_t count, int32_t origin_id,
+                     int32_t param_hash, int32_t flags, float rt_ms,
+                     int32_t error, int32_t user_tag) {
+    uint64_t pos = r->head.load(std::memory_order_relaxed);
+    for (;;) {
+        sx_slot& s = r->slots[pos & r->mask];
+        uint64_t seq = s.seq.load(std::memory_order_acquire);
+        int64_t diff = (int64_t)seq - (int64_t)pos;
+        if (diff == 0) {
+            if (r->head.compare_exchange_weak(pos, pos + 1,
+                                              std::memory_order_relaxed))
+            {
+                s.ev = {res, count, origin_id, param_hash, flags, rt_ms,
+                        error, user_tag};
+                s.seq.store(pos + 1, std::memory_order_release);
+                return 0;
+            }
+        } else if (diff < 0) {
+            return -1; // full
+        } else {
+            pos = r->head.load(std::memory_order_relaxed);
+        }
+    }
+}
+
+// drain up to max_n events into parallel arrays; returns count drained.
+// Single-consumer use is expected (the tick thread), but the ticket
+// scheme stays correct with several.
+int64_t sx_ring_drain(sx_ring* r, int64_t max_n, int32_t* res, int32_t* count,
+                      int32_t* origin_id, int32_t* param_hash, int32_t* flags,
+                      float* rt_ms, int32_t* error, int32_t* user_tag) {
+    int64_t n = 0;
+    while (n < max_n) {
+        uint64_t pos = r->tail.load(std::memory_order_relaxed);
+        sx_slot& s = r->slots[pos & r->mask];
+        uint64_t seq = s.seq.load(std::memory_order_acquire);
+        int64_t diff = (int64_t)seq - (int64_t)(pos + 1);
+        if (diff == 0) {
+            if (!r->tail.compare_exchange_weak(pos, pos + 1,
+                                               std::memory_order_relaxed))
+                continue;
+            const sx_event& e = s.ev;
+            res[n] = e.res; count[n] = e.count; origin_id[n] = e.origin_id;
+            param_hash[n] = e.param_hash; flags[n] = e.flags;
+            rt_ms[n] = e.rt_ms; error[n] = e.error; user_tag[n] = e.user_tag;
+            s.seq.store(pos + r->mask + 1, std::memory_order_release);
+            ++n;
+        } else {
+            break; // empty (or producer mid-write: next drain gets it)
+        }
+    }
+    return n;
+}
+
+int64_t sx_ring_size(sx_ring* r) {
+    return (int64_t)(r->head.load(std::memory_order_relaxed) -
+                     r->tail.load(std::memory_order_relaxed));
+}
+
+// ---------------------------------------------------------------------------
+// string interner
+// ---------------------------------------------------------------------------
+
+struct sx_intern_entry {
+    std::atomic<uint64_t> hash; // 0 = empty
+    std::atomic<int32_t> id;    // valid once hash is published
+    char* key;
+    uint32_t len;
+};
+
+struct sx_intern {
+    uint64_t mask;
+    sx_intern_entry* entries;
+    std::atomic<int32_t> next_id;
+    int32_t max_ids;
+    std::mutex write_lock;
+};
+
+static uint64_t fnv1a(const char* p, uint64_t n) {
+    uint64_t h = 1469598103934665603ull;
+    for (uint64_t i = 0; i < n; ++i) {
+        h ^= (unsigned char)p[i];
+        h *= 1099511628211ull;
+    }
+    return h ? h : 1; // 0 is the empty marker
+}
+
+sx_intern* sx_intern_new(uint64_t capacity_pow2, int32_t first_id,
+                         int32_t max_ids) {
+    if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1)) != 0)
+        return nullptr;
+    auto* t = new (std::nothrow) sx_intern();
+    if (!t) return nullptr;
+    t->entries = new (std::nothrow) sx_intern_entry[capacity_pow2]();
+    if (!t->entries) { delete t; return nullptr; }
+    t->mask = capacity_pow2 - 1;
+    t->next_id.store(first_id, std::memory_order_relaxed);
+    t->max_ids = max_ids;
+    return t;
+}
+
+void sx_intern_free(sx_intern* t) {
+    if (!t) return;
+    for (uint64_t i = 0; i <= t->mask; ++i) delete[] t->entries[i].key;
+    delete[] t->entries;
+    delete t;
+}
+
+// lookup-or-insert; returns the dense id, or -1 when id space / table full.
+// Readers are lock-free (acquire loads); inserts take the writer lock.
+int32_t sx_intern_get(sx_intern* t, const char* key, uint32_t len) {
+    uint64_t h = fnv1a(key, len);
+    uint64_t idx = h & t->mask;
+    // fast path: lock-free probe
+    for (uint64_t probes = 0; probes <= t->mask; ++probes) {
+        uint64_t eh = t->entries[idx].hash.load(std::memory_order_acquire);
+        if (eh == 0) break;
+        if (eh == h) {
+            const sx_intern_entry& e = t->entries[idx];
+            if (e.len == len && std::memcmp(e.key, key, len) == 0)
+                return e.id.load(std::memory_order_acquire);
+        }
+        idx = (idx + 1) & t->mask;
+    }
+    // slow path: insert under lock (re-probe: someone may have raced us)
+    std::lock_guard<std::mutex> g(t->write_lock);
+    idx = h & t->mask;
+    for (uint64_t probes = 0; probes <= t->mask; ++probes) {
+        sx_intern_entry& e = t->entries[idx];
+        uint64_t eh = e.hash.load(std::memory_order_acquire);
+        if (eh == h && e.len == len && std::memcmp(e.key, key, len) == 0)
+            return e.id.load(std::memory_order_acquire);
+        if (eh == 0) {
+            int32_t id = t->next_id.load(std::memory_order_relaxed);
+            if (id >= t->max_ids) return -1;
+            char* copy = new (std::nothrow) char[len];
+            if (!copy) return -1;
+            std::memcpy(copy, key, len);
+            e.key = copy;
+            e.len = len;
+            e.id.store(id, std::memory_order_release);
+            e.hash.store(h, std::memory_order_release); // publish last
+            t->next_id.store(id + 1, std::memory_order_relaxed);
+            return id;
+        }
+        idx = (idx + 1) & t->mask;
+    }
+    return -1; // table full
+}
+
+int32_t sx_intern_count(sx_intern* t, int32_t first_id) {
+    return t->next_id.load(std::memory_order_relaxed) - first_id;
+}
+
+}  // extern "C"

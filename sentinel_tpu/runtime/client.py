@@ -254,10 +254,19 @@ class SentinelClient:
         self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
         self._rules_dirty = False
 
-        self._lock = threading.Lock()  # guards queues
+        self._lock = threading.Lock()  # guards the acquire queue
         self._engine_lock = threading.Lock()  # guards state/tick execution
         self._acquires: List[AcquireRequest] = []
-        self._completions: List[Completion] = []
+        # completions are fire-and-forget (no futures), so they ride the
+        # native MPMC event ring: Entry.exit() from any request thread is
+        # one C call, and the tick drains straight into numpy arrays
+        from sentinel_tpu.native import EventRing
+
+        self._comp_ring = EventRing(1 << 16)
+        # completions must NEVER be lost (they release concurrency and feed
+        # circuit breakers) — when the ring is full (tick thread stalled,
+        # e.g. mid-recompile) they overflow into this unbounded list
+        self._comp_overflow: List[Completion] = []
 
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
@@ -285,7 +294,7 @@ class SentinelClient:
             # Warm the compile cache before serving: the first jitted tick
             # can take tens of seconds; without this, early entry() futures
             # hit entry_timeout_s while XLA compiles.
-            self._run_tick([], [], self.time.now_ms())
+            self._run_tick([], None, self.time.now_ms())
             self._thread = threading.Thread(
                 target=self._tick_loop,
                 args=(self._stop_evt,),
@@ -753,8 +762,20 @@ class SentinelClient:
         return out
 
     def _submit_completion(self, c: Completion) -> None:
-        with self._lock:
-            self._completions.append(c)
+        from sentinel_tpu.native.ring import FLAG_COMPLETION, FLAG_INBOUND
+
+        ok = self._comp_ring.push(
+            res=c.res,
+            count=c.success,
+            origin_id=c.origin_node,
+            param_hash=c.ctx_node,
+            flags=FLAG_COMPLETION | (FLAG_INBOUND if c.inbound else 0),
+            rt_ms=c.rt,
+            error=c.error,
+        )
+        if not ok:
+            with self._lock:
+                self._comp_overflow.append(c)
         if self.mode == "sync":
             self.tick_once()
 
@@ -783,13 +804,36 @@ class SentinelClient:
             with self._lock:
                 acq = self._acquires[: self.cfg.batch_size]
                 self._acquires = self._acquires[self.cfg.batch_size :]
-                comp = self._completions[: self.cfg.complete_batch_size]
-                self._completions = self._completions[self.cfg.complete_batch_size :]
-            if not acq and not comp and now_ms is None:
+            comp = self._comp_ring.drain(self.cfg.complete_batch_size)
+            n_comp = len(comp[0])
+            if n_comp < self.cfg.complete_batch_size and self._comp_overflow:
+                with self._lock:
+                    spill = self._comp_overflow[: self.cfg.complete_batch_size - n_comp]
+                    self._comp_overflow = self._comp_overflow[len(spill) :]
+                if spill:
+                    comp = tuple(
+                        np.concatenate([col, np.asarray(extra, col.dtype)])
+                        for col, extra in zip(
+                            comp,
+                            zip(
+                                *[
+                                    (s.res, s.success, s.origin_node, s.ctx_node,
+                                     4 | (1 if s.inbound else 0), s.rt, s.error, 0)
+                                    for s in spill
+                                ]
+                            ),
+                        )
+                    )
+                    n_comp += len(spill)
+            if not acq and not n_comp and now_ms is None:
                 return
-            self._run_tick(acq, comp, now_ms)
+            self._run_tick(acq, comp if n_comp else None, now_ms)
             with self._lock:
-                more = bool(self._acquires) or bool(self._completions)
+                more = (
+                    bool(self._acquires)
+                    or bool(self._comp_ring)
+                    or bool(self._comp_overflow)
+                )
             if not more:
                 return
             now_ms = None  # subsequent drain loops use fresh time
@@ -797,7 +841,7 @@ class SentinelClient:
     def _run_tick(
         self,
         acq: List[AcquireRequest],
-        comp: List[Completion],
+        comp,  # Optional[Tuple[np.ndarray, ...]] — drained ring columns
         now_ms: Optional[int],
     ) -> None:
         cfg = self.cfg
@@ -823,19 +867,25 @@ class SentinelClient:
                 pre_verdict=jnp.asarray(arr("pre_verdict", 0, np.int32)),
             )
         c = E.empty_complete(cfg)
-        if comp:
-            n = len(comp)
-            arr = lambda f, fill, dt: np.asarray(
-                [getattr(r, f) for r in comp] + [fill] * (B2 - n), dtype=dt
-            )
+        if comp is not None:
+            from sentinel_tpu.native.ring import FLAG_INBOUND
+
+            res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag = comp
+            n = len(res_a)
+
+            def pad(a, fill, dt):
+                out = np.full(B2, fill, dtype=dt)
+                out[:n] = a
+                return jnp.asarray(out)
+
             c = E.CompleteBatch(
-                res=jnp.asarray(arr("res", trash, np.int32)),
-                origin_node=jnp.asarray(arr("origin_node", trash, np.int32)),
-                ctx_node=jnp.asarray(arr("ctx_node", trash, np.int32)),
-                inbound=jnp.asarray(arr("inbound", 0, np.int32)),
-                rt=jnp.asarray(arr("rt", 0.0, np.float32)),
-                success=jnp.asarray(arr("success", 0, np.int32)),
-                error=jnp.asarray(arr("error", 0, np.int32)),
+                res=pad(res_a, trash, np.int32),
+                origin_node=pad(org_a, trash, np.int32),
+                ctx_node=pad(ctx_a, trash, np.int32),
+                inbound=pad((flags_a & FLAG_INBOUND), 0, np.int32),
+                rt=pad(rt_a, 0.0, np.float32),
+                success=pad(cnt_a, 0, np.int32),
+                error=pad(err_a, 0, np.int32),
             )
 
         load, cpu = self._sys.sample()
