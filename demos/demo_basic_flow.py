@@ -1,0 +1,43 @@
+"""Basic flow control — the FlowQpsDemo (sentinel-demo-basic, BASELINE #1).
+
+Resource "HelloWorld" pinned at 20 pass/s while the loop offers far more;
+per-second pass/block counts print like the reference's metric log excerpt
+(README.md:104-116 in the reference repo).
+
+    JAX_PLATFORMS=cpu python demos/demo_basic_flow.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401 — repo path + JAX platform setup
+from _bootstrap import warm
+import time
+
+import sentinel_tpu as st
+
+
+def main():
+    client = st.init(metric_log=False)
+    st.load_flow_rules([st.FlowRule(resource="HelloWorld", count=20)])
+
+    for second in range(5):
+        passed = blocked = 0
+        t_end = time.time() + 1.0
+        while time.time() < t_end:
+            try:
+                with st.entry("HelloWorld"):
+                    pass  # guarded business logic
+            except st.BlockException:
+                blocked += 1
+            else:
+                passed += 1
+        print(f"second {second}: passed={passed} blocked={blocked}")
+    stats = client.stats.resource("HelloWorld")
+    print("final stats:", stats)
+    st.reset()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Circuit breaking — sentinel-demo-basic's degrade demos.
+
+An error-ratio breaker OPENs after a burst of failures, rejects during the
+recovery timeout, HALF-OPENs for one probe, and CLOSEs when it succeeds
+(AbstractCircuitBreaker's CLOSED/OPEN/HALF_OPEN machine).
+
+    JAX_PLATFORMS=cpu python demos/demo_circuit_breaker.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401 — repo path + JAX platform setup
+from _bootstrap import warm
+import time
+
+import sentinel_tpu as st
+
+
+def call(fail: bool) -> str:
+    try:
+        # business exceptions raised inside the entry context are traced
+        # automatically by Entry.__exit__ (the Tracer.trace analog)
+        with st.entry("remoteService"):
+            if fail:
+                raise RuntimeError("downstream timeout")
+            return "ok"
+    except st.DegradeException:
+        return "OPEN(rejected)"
+    except RuntimeError:
+        return "failed"
+
+
+def main():
+    client = st.init(entry_timeout_s=60.0)
+    st.load_degrade_rules(
+        [
+            st.DegradeRule(
+                resource="remoteService",
+                grade=st.CB_STRATEGY_ERROR_RATIO,
+                count=0.5,  # trip at 50% errors
+                min_request_amount=5,
+                stat_interval_ms=1000,
+                time_window=2,  # recovery seconds
+            )
+        ]
+    )
+
+    warm(client)  # pay the rule-reload recompile before the timed phases
+
+    print("phase 1: downstream broken")
+    for i in range(10):
+        print(" ", call(fail=True))
+        time.sleep(0.05)
+    print("phase 2: immediately after trip (OPEN)")
+    for i in range(3):
+        print(" ", call(fail=False))
+    print("phase 3: after recovery window (HALF_OPEN probe then CLOSED)")
+    time.sleep(2.2)
+    for i in range(3):
+        print(" ", call(fail=False))
+        time.sleep(0.05)
+    st.reset()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""The full control plane in one process — command center, heartbeat,
+dashboard-lite with metric pull, and rule push from the dashboard REST API
+(sentinel-dashboard + sentinel-transport + sentinel-demo-command-handler).
+
+    JAX_PLATFORMS=cpu python demos/demo_control_plane.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401 — repo path + JAX platform setup
+from _bootstrap import warm
+import tempfile
+import time
+import urllib.parse
+import urllib.request
+
+import sentinel_tpu as st
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.dashboard import DashboardServer
+from sentinel_tpu.metrics import MetricSearcher
+from sentinel_tpu.runtime.client import SentinelClient
+from sentinel_tpu.transport import HeartbeatSender, start_command_center
+
+
+def main():
+    metric_dir = tempfile.mkdtemp()
+    client = SentinelClient(
+        cfg=small_engine_config(), mode="threaded",
+        metric_log=True, metric_log_dir=metric_dir,
+        entry_timeout_s=60.0,
+    )
+    client.start()
+
+    center = start_command_center(
+        client,
+        metric_searcher=MetricSearcher(metric_dir, client.app_name),
+        host="127.0.0.1", port=0,
+    )
+    dash = DashboardServer(host="127.0.0.1", port=0)
+    dash.start()
+    hb = HeartbeatSender(client.app_name, center.port,
+                         [f"127.0.0.1:{dash.port}"], interval_s=1.0, ip="127.0.0.1")
+    hb.start()
+    print(f"command center :{center.port}  dashboard :{dash.port}")
+
+    try:
+        _body(client, dash)
+    finally:
+        hb.stop(); dash.stop(); center.stop(); client.stop()
+
+
+def _body(client, dash):
+    # push a rule THROUGH the dashboard (round-trips via the machine API)
+    body = urllib.parse.urlencode({
+        "app": client.app_name, "type": "flow",
+        "data": json.dumps([{"resource": "api", "count": 25}]),
+    }).encode()
+    time.sleep(1.2)  # wait for first heartbeat to register the machine
+    urllib.request.urlopen(
+        urllib.request.Request(f"http://127.0.0.1:{dash.port}/rules", data=body),
+        timeout=3,
+    )
+    print("rule pushed via dashboard:", client.flow_rules.get())
+    warm(client, "api")  # pay the rule-reload recompile before timing
+
+    # traffic, then read it back through the dashboard metric API
+    t_end = time.time() + 3.0
+    while time.time() < t_end:
+        try:
+            with client.entry("api"):
+                pass
+        except st.BlockException:
+            pass
+        time.sleep(0.004)
+    time.sleep(2.0)  # metric timer flush + fetcher pull
+
+    top = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{dash.port}/metric/top?app={client.app_name}", timeout=3))
+    series = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{dash.port}/metric?app={client.app_name}&identity=api",
+        timeout=3))
+    print("top resources:", top)
+    for point in series[-3:]:
+        print("  metric point:", point)
+
+
+if __name__ == "__main__":
+    main()

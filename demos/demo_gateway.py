@@ -1,0 +1,69 @@
+"""API-gateway flow rules — sentinel-demo-spring-cloud-gateway, framework-
+neutral: per-tenant limits parsed from headers, plus a custom API group
+matched by path prefix.
+
+    JAX_PLATFORMS=cpu python demos/demo_gateway.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401 — repo path + JAX platform setup
+from _bootstrap import warm
+import time
+
+import sentinel_tpu as st
+from sentinel_tpu.adapters import (
+    ApiDefinition,
+    ApiPredicateItem,
+    GatewayAdapter,
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    RequestAttributes,
+)
+from sentinel_tpu.adapters import gateway as GW
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.runtime.client import SentinelClient
+
+
+def main():
+    client = SentinelClient(cfg=small_engine_config(), mode="threaded")
+    client.start()
+    gw = GatewayAdapter(client)
+    gw.apis.load(
+        [ApiDefinition("user-api", [ApiPredicateItem("/users", GW.URL_MATCH_STRATEGY_PREFIX)])]
+    )
+    gw.rules.load_rules(
+        [
+            GatewayFlowRule(  # per-tenant limit on the route
+                resource="route-main",
+                count=5,
+                param_item=GatewayParamFlowItem(
+                    GW.PARAM_PARSE_STRATEGY_HEADER, field_name="X-Tenant"
+                ),
+            ),
+            GatewayFlowRule(resource="user-api", count=8),  # API-group cap
+        ]
+    )
+
+    def request(path, tenant):
+        req = RequestAttributes(path=path, client_ip="10.0.0.1",
+                                headers={"X-Tenant": tenant})
+        try:
+            entries = gw.entries_for("route-main", req)
+        except st.BlockException as e:
+            return f"429 ({type(e).__name__})"
+        for e in entries:
+            e.exit()
+        return "200"
+
+    for tenant in ("acme", "globex"):
+        out = [request("/users/1", tenant) for _ in range(8)]
+        print(f"{tenant:7s} /users : {out}")
+    print("acme    /other :", [request("/other", "acme") for _ in range(3)])
+    client.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Hot-parameter limiting — sentinel-demo-parameter-flow-control.
+
+Per-parameter-value QPS: each user id gets its own budget on the shared
+resource; a hot user is throttled while others sail through, with a
+per-value exception (ParamFlowItem) granting a VIP a higher limit.
+
+    JAX_PLATFORMS=cpu python demos/demo_param_flow.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401 — repo path + JAX platform setup
+from _bootstrap import warm
+import time
+
+import sentinel_tpu as st
+
+
+def main():
+    st.init()
+    st.load_param_flow_rules(
+        [
+            st.ParamFlowRule(
+                resource="queryUser",
+                count=5,  # 5/s per distinct user id
+                param_idx=0,
+                param_flow_item_list=[
+                    st.ParamFlowItem(object="vip", count=50)  # exception
+                ],
+            )
+        ]
+    )
+
+    users = ["hot-user"] * 30 + ["quiet-user"] * 3 + ["vip"] * 30
+    results = {}
+    t_end = time.time() + 1.0
+    i = 0
+    while time.time() < t_end and i < len(users):
+        u = users[i]
+        i += 1
+        try:
+            with st.entry("queryUser", args=[u]):
+                pass
+        except st.BlockException:
+            results.setdefault(u, [0, 0])[1] += 1
+        else:
+            results.setdefault(u, [0, 0])[0] += 1
+    for u, (ok, blocked) in results.items():
+        print(f"{u:12s} passed={ok:3d} blocked={blocked:3d}")
+    st.reset()
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,7 @@ from sentinel_tpu.core.rules import (
     AuthorityRule,
     DegradeRule,
     FlowRule,
+    ParamFlowItem,
     ParamFlowRule,
     SystemRule,
     # enums
@@ -97,6 +98,7 @@ __all__ = [
     "FlowException",
     "FlowRule",
     "ParamFlowException",
+    "ParamFlowItem",
     "ParamFlowRule",
     "PriorityWaitException",
     "SentinelClient",
