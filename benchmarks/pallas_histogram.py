@@ -1,0 +1,90 @@
+"""Experiment: fused one-hot histogram kernel in Pallas.
+
+MEASURED RESULT (v5e, B=131072, N=8192, P=4): the fused Pallas kernel runs
+4.9 ms vs 3.1 ms for the two-level one-hot einsum in ops/mxu_table.py —
+the naive fusion pays B×N one-hot compares per plane, while the two-level
+decomposition does B×(n_hi+n_lo) one-hot work and lets the MXU carry the
+B×N MACs. The production engine therefore uses the einsum path; this file
+is kept as the measured justification (run it on TPU to reproduce).
+
+hist[N, P] = sum_b onehot(idx[b], N) * values[b, P]
+
+Grid (n_tiles, b_chunks); one-hot tiles are built in VMEM and contracted
+immediately — nothing B×N ever touches HBM.
+"""
+import time
+import functools
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(idx_ref, val_ref, out_ref, *, n_tile, chunk):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    i = pl.program_id(0)
+    base = i * n_tile
+    idx = idx_ref[:]  # [chunk]
+    vals = val_ref[:]  # [chunk, P]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, n_tile), 1) + base
+    onehot = (idx[:, None] == iota).astype(jnp.bfloat16)  # [chunk, n_tile]
+    out_ref[:] += jax.lax.dot_general(
+        onehot, vals.astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def pallas_histogram(idx, values, n, n_tile=2048, chunk=4096, interpret=False):
+    b, p = values.shape
+    assert b % chunk == 0 and n % n_tile == 0
+    grid = (n // n_tile, b // chunk)
+    return pl.pallas_call(
+        functools.partial(_kernel, n_tile=n_tile, chunk=chunk),
+        out_shape=jax.ShapeDtypeStruct((n, p), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((chunk,), lambda i, j: (j,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, p), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_tile, p), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(idx, values)
+
+
+if __name__ == "__main__":
+    B, N, P = 131072, 8192, 4
+    rng = np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, N, B), jnp.int32)
+    vals = jnp.asarray(rng.integers(0, 100, (B, P)), jnp.float32)
+
+    out = pallas_histogram(idx, vals, N)
+    oracle = np.zeros((N, P), np.float32)
+    np.add.at(oracle, np.asarray(idx), np.asarray(vals))
+    np.testing.assert_allclose(np.asarray(out), oracle)
+    print("exact ✓")
+
+    # perf vs einsum approach
+    from sentinel_tpu.ops import mxu_table as MX
+    plan = MX.make_plan(N, 512)
+
+    def chain(name, f, mk, n=50):
+        g = jax.jit(f, donate_argnums=0)
+        s = g(mk()); _ = float(jnp.ravel(s)[0]); s = g(s)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            s = g(s)
+        _ = float(jnp.ravel(s)[0])
+        print(f"{name:30s} {(time.perf_counter()-t0)/n*1000:8.3f} ms")
+
+    chain("pallas fused hist", lambda a: a + pallas_histogram(idx, vals, N), lambda: jnp.zeros((N, P), jnp.float32))
+    def einsum_hist(a):
+        Hi, Lo = MX.onehots(idx, plan)
+        return a + MX.scatter_add(jnp.zeros((N, P), jnp.float32), plan, Hi, Lo, vals.astype(jnp.int32), max_int=127)
+    chain("einsum digit hist", einsum_hist, lambda: jnp.zeros((N, P), jnp.float32))

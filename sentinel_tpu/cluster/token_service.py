@@ -174,10 +174,10 @@ class DefaultTokenService(TokenService):
     AVG_LOCAL threshold scaling; the server wires it to its ConnectionManager
     (ConnectionGroup.getConnectedCount), standalone/embedded default is 1.
 
-    Prioritized occupy-ahead (SHOULD_WAIT) is not yet modeled for the default
-    controller — prioritized requests are checked like normal ones (the
-    reference grants occupancy up to maxOccupyRatio; a future engine rev can
-    surface it via the same PASS_WAIT channel the rate limiter uses).
+    Prioritized requests that exceed the current bucket borrow from the next
+    one (engine occupy-ahead, DefaultController.tryOccupyNext) and surface as
+    STATUS_SHOULD_WAIT with the wait until that bucket starts — the client
+    sleeps and enters, matching TokenResultStatus.SHOULD_WAIT semantics.
     """
 
     def __init__(
@@ -265,7 +265,7 @@ class DefaultTokenService(TokenService):
         if not self.limiter.try_pass(ns, self.client.time.now_ms()):
             return TokenResult(C.STATUS_TOO_MANY_REQUEST)
         verdict, wait_ms = self.client.check_batch(
-            [flow_resource(flow_id)], counts=[count]
+            [flow_resource(flow_id)], counts=[count], prioritized=[prioritized]
         )[0]
         if verdict == ERR.PASS:
             return TokenResult(C.STATUS_OK)

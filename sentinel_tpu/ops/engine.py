@@ -86,6 +86,11 @@ class EngineState(NamedTuple):
     latest_passed_ms: jax.Array  # float32 [F+1] RateLimiterController.latestPassedTime
     warmup_tokens: jax.Array  # float32 [F+1] WarmUpController.storedTokens
     warmup_last_s: jax.Array  # int32 [F+1] lastFilledTime (seconds)
+    # prioritized occupy-ahead (OccupiableBucketLeapArray / tryOccupyNext):
+    # tokens borrowed against window epoch occ_epoch, folded into that
+    # window's pass counts when it becomes current
+    occ_tokens: jax.Array  # float32 [F+1]
+    occ_epoch: jax.Array  # int32 [F+1]
     # per degrade-rule circuit breaker
     cb_state: jax.Array  # int32 [D+1]
     cb_retry_ms: jax.Array  # int32 [D+1]
@@ -161,6 +166,8 @@ def init_state(cfg: EngineConfig) -> EngineState:
         latest_passed_ms=jnp.full((F + 1,), -1.0e9, dtype=jnp.float32),
         warmup_tokens=jnp.zeros((F + 1,), dtype=jnp.float32),
         warmup_last_s=jnp.full((F + 1,), -1, dtype=jnp.int32),
+        occ_tokens=jnp.zeros((F + 1,), dtype=jnp.float32),
+        occ_epoch=jnp.full((F + 1,), -1, dtype=jnp.int32),
         cb_state=jnp.zeros((Dn + 1,), dtype=jnp.int32),
         cb_retry_ms=jnp.zeros((Dn + 1,), dtype=jnp.int32),
         cb_counts=jnp.zeros((Dn + 1, cfg.cb_sample_count, 3), dtype=jnp.int32),
@@ -577,6 +584,39 @@ def _check_param(
     return blocked, cms, cms_epochs, cur_idx, slots_f, applicable
 
 
+def _fold_occupied(cfg: EngineConfig, state: EngineState, rules: RuleSet, now_ms):
+    """Borrowed-ahead tokens whose target bucket has arrived land as
+    PASS + OCCUPIED_PASS in the current column of their rule's node —
+    the batched form of FutureBucketLeapArray's buckets becoming current
+    (occupy/OccupiableBucketLeapArray.java:29-43)."""
+    cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+    due = (state.occ_epoch <= cur_wid) & (state.occ_tokens > 0)
+    tok = jnp.round(jnp.where(due, state.occ_tokens, 0.0)).astype(jnp.int32)
+    any_due = jnp.any(due)
+
+    def fold(s):
+        # occupy grants are restricted to LIMIT_ANY/DIRECT rules, whose
+        # metered node is statically the rule's resource row; OCCUPIED was
+        # already counted once at grant time — only the deferred PASS lands
+        nodes = jnp.asarray(rules.flow.res)  # [F+1] — each rule's node row
+        hist = T.histogram(cfg, nodes, tok, cfg.node_rows)  # [rows]
+        delta = jnp.zeros((cfg.node_rows, W.NUM_EVENTS), jnp.int32)
+        delta = delta.at[:, W.EV_PASS].set(hist)
+        sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+        win_sec = W.add_dense(s.win_sec, now_ms, delta, None, sec_cfg)
+        win_min = s.win_min
+        if cfg.enable_minute_window:
+            min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
+            win_min = W.add_dense(s.win_min, now_ms, delta, None, min_cfg)
+        return s._replace(
+            win_sec=win_sec,
+            win_min=win_min,
+            occ_tokens=jnp.where(due, 0.0, s.occ_tokens),
+        )
+
+    return jax.lax.cond(any_due, fold, lambda s: s, state)
+
+
 def _sync_warmup(
     cfg: EngineConfig, state: EngineState, rules: RuleSet, now_ms
 ) -> EngineState:
@@ -623,10 +663,13 @@ def _check_flow(
     acq: AcquireBatch,
     now_ms,
     eligible,
+    occupy: bool = True,
 ):
     """FlowSlot: per-resource QPS/thread limiting with the three traffic
     shapers (FlowRuleChecker.java:42-176, Default/RateLimiter/WarmUp
-    controllers).  Returns (blocked[B], wait_ms[B], latest_passed_update)."""
+    controllers) plus prioritized occupy-ahead (DefaultController
+    :49-68 tryOccupyNext).  Returns (blocked[B], wait_ms[B],
+    latest_passed_update, occupying[B], occ_tokens, occ_epoch)."""
     K = cfg.flow_rules_per_resource
     b = acq.res.shape[0]
     f = rules.flow
@@ -656,6 +699,14 @@ def _check_flow(
                 f.warning_token,  # 9
                 f.slope,  # 10
                 state.warmup_tokens,  # 11
+                # 12: per-slot borrow pool already booked against the next
+                # bucket (computed dense below, exact int compares)
+                jnp.where(
+                    state.occ_epoch
+                    == (now_ms // cfg.second_window_ms).astype(jnp.int32) + 1,
+                    state.occ_tokens,
+                    0.0,
+                ),
             ]
         ),
         slots_f,
@@ -771,10 +822,52 @@ def _check_flow(
 
     blocked = (entry_block & elig_f).reshape(b, K).any(axis=1)
 
+    # --- prioritized occupy-ahead (DefaultController.canPass:49-68) -------
+    # a prioritized request rejected by the QPS check may borrow from the
+    # NEXT bucket's budget (up to one full bucket per rule) and enter after
+    # waiting for that bucket to start
+    occupying = jnp.zeros((b,), bool)
+    occ_wait = jnp.zeros((b,), jnp.float32)
+    occ_grant = None
+    if occupy:
+        pool = fg[:, 12]
+        # only rules whose metered node is statically their own resource
+        # row can borrow ahead — the fold knows where to land the deferred
+        # PASS (LIMIT_ANY + DIRECT; origin/relate/chain meter other nodes)
+        cand = (
+            (acq.prio[item] > 0)
+            & (behavior == CONTROL_DEFAULT)
+            & (grade == GRADE_QPS)
+            & (la == RT.LIMIT_ANY)
+            & (strategy == STRATEGY_DIRECT)
+            & applicable
+            & elig_f
+            & qps_block
+        )
+        (rank_occ,) = _rank(
+            cfg, slots_f, [cnt], cand, cfg.max_flow_rules + 1
+        )
+        granted = cand & (pool + rank_occ + cnt <= rcount)  # maxOccupyRatio=1
+        # an item occupies iff its ONLY failure was the occupiable QPS check
+        still_blocked = (entry_block & ~granted & elig_f).reshape(b, K).any(axis=1)
+        occupying = (granted & elig_f).reshape(b, K).any(axis=1) & ~still_blocked
+        blocked = still_blocked
+        occ_wait_v = (cfg.second_window_ms - (now_ms % cfg.second_window_ms)).astype(
+            jnp.float32
+        )
+        occ_wait = jnp.where(occupying, occ_wait_v, 0.0)
+        # booking is deferred to the tick (after degrade): a later stage may
+        # still block the item, and its grant must not be committed.  Book
+        # ONE lane per item (first granted) — one request borrows once even
+        # when several rules on the node granted it.
+        grant_mtx = (granted & elig_f).reshape(b, K)
+        first_lane = grant_mtx & (jnp.cumsum(grant_mtx, axis=1) == 1)
+        occ_grant = (first_lane.reshape(-1), slots_f, cnt)
+
     # pacing delay for admitted rate-limited entries
     rl_ok = is_rl & applicable & ~entry_block & elig_f & ~blocked[item]
     wait_ms_entry = jnp.where(rl_ok, jnp.maximum(wait, 0.0), 0.0)
-    wait_ms = jnp.max(wait_ms_entry.reshape(b, K), axis=1)
+    wait_ms = jnp.maximum(jnp.max(wait_ms_entry.reshape(b, K), axis=1), occ_wait)
 
     # advance latestPassedTime for admitted entries (even if a later slot
     # blocks the request, matching the reference's side-effect order)
@@ -786,7 +879,7 @@ def _check_flow(
         -3.0e38,
     )
 
-    return blocked, wait_ms.astype(jnp.int32), latest
+    return blocked, wait_ms.astype(jnp.int32), latest, occupying, occ_grant
 
 
 def _check_degrade(
@@ -854,7 +947,7 @@ def _check_degrade(
 #: every optional tick stage; make_tick compiles only what the rule set
 #: needs (the SPI slot-chain analog: absent slots cost nothing)
 ALL_FEATURES = frozenset(
-    {"authority", "system", "param", "flow", "degrade", "warmup", "nodes"}
+    {"authority", "system", "param", "flow", "degrade", "warmup", "nodes", "occupy"}
 )
 
 
@@ -880,6 +973,8 @@ def tick(
     # 2. warm-up token sync (per second, vectorized over rules)
     if "warmup" in features:
         state = _sync_warmup(cfg, state, rules, now_ms)
+    if "occupy" in features and "flow" in features:
+        state = _fold_occupied(cfg, state, rules, now_ms)
 
     valid = acq.res != cfg.trash_row
     forced = valid & (acq.pre_verdict > 0)
@@ -910,13 +1005,16 @@ def tick(
     eligible = eligible & ~param_block
 
     if "flow" in features:
-        flow_block, wait_ms, latest_passed = _check_flow(
-            cfg, state, rules, acq, now_ms, eligible
+        flow_block, wait_ms, latest_passed, occupying, occ_grant = _check_flow(
+            cfg, state, rules, acq, now_ms, eligible, occupy="occupy" in features
         )
         flow_block = flow_block & eligible
+        occupying = occupying & eligible
         state = state._replace(latest_passed_ms=latest_passed)
     else:
         flow_block = zero_block
+        occupying = zero_block
+        occ_grant = None
         wait_ms = jnp.zeros((b,), jnp.int32)
     eligible = eligible & ~flow_block
 
@@ -932,6 +1030,26 @@ def tick(
     passed = valid & ~forced & ~(
         auth_block | sys_block | param_block | flow_block | degrade_block
     )
+    # occupy grants only COMMIT for items that finally pass — a grant
+    # revoked by a later slot (e.g. an open circuit breaker) books nothing
+    occupying = occupying & passed
+    if occ_grant is not None:
+        grant_lane, oslots, ocnt = occ_grant
+        b_k = grant_lane.shape[0] // b
+        item_g = jnp.repeat(jnp.arange(b), b_k)
+        commit = grant_lane & occupying[item_g]
+        add = T.small_scatter_add(
+            cfg,
+            jnp.zeros((cfg.max_flow_rules + 1,), jnp.float32),
+            jnp.where(commit, oslots, jnp.int32(-1)),
+            jnp.where(commit, ocnt, 0.0),
+        )
+        cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+        pool_vec = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
+        state = state._replace(
+            occ_tokens=pool_vec + add,
+            occ_epoch=jnp.where(add > 0, cur_wid + 1, state.occ_epoch),
+        )
 
     verdict = jnp.full((b,), PASS, dtype=jnp.int8)
     verdict = jnp.where(forced, acq.pre_verdict.astype(jnp.int8), verdict)
@@ -943,18 +1061,27 @@ def tick(
     verdict = jnp.where(passed & (wait_ms > 0), jnp.int8(PASS_WAIT), verdict)
     wait_ms = jnp.where(passed, wait_ms, 0)
 
-    # 4. effects: pass/block statistics (StatisticSlot.java:54-123)
+    # 4. effects: pass/block statistics (StatisticSlot.java:54-123).
+    # Occupying entries count OCCUPIED now; their PASS lands when the
+    # borrowed bucket becomes current (_fold_occupied), so the next
+    # window's budget is reduced by exactly the borrowed amount.
     with_nodes = "nodes" in features
     rows = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, with_nodes)
     deltas1 = jnp.zeros((b, W.NUM_EVENTS), dtype=jnp.int32)
-    deltas1 = deltas1.at[:, W.EV_PASS].set(jnp.where(passed, acq.count, 0))
+    deltas1 = deltas1.at[:, W.EV_PASS].set(
+        jnp.where(passed & ~occupying, acq.count, 0)
+    )
+    deltas1 = deltas1.at[:, W.EV_OCCUPIED].set(jnp.where(occupying, acq.count, 0))
     deltas1 = deltas1.at[:, W.EV_BLOCK].set(jnp.where(valid & ~passed, acq.count, 0))
     fan = 3 if with_nodes else 1
     deltas = jnp.tile(deltas1, (fan, 1)) if with_nodes else deltas1
     inb = valid & (acq.inbound > 0)
     entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
     entry_deltas = entry_deltas.at[W.EV_PASS].set(
-        jnp.sum(jnp.where(inb & passed, acq.count, 0))
+        jnp.sum(jnp.where(inb & passed & ~occupying, acq.count, 0))
+    )
+    entry_deltas = entry_deltas.at[W.EV_OCCUPIED].set(
+        jnp.sum(jnp.where(inb & occupying, acq.count, 0))
     )
     entry_deltas = entry_deltas.at[W.EV_BLOCK].set(
         jnp.sum(jnp.where(inb & ~passed, acq.count, 0))
@@ -982,13 +1109,16 @@ def tick(
             )
         )
 
-    if hist is not None:  # MXU: concurrency rides the pass histogram
-        # (the histogram already carries the ENTRY-row reduction)
-        concurrency = state.concurrency + hist[:, W.EV_PASS]
+    if hist is not None:  # MXU: concurrency rides the pass+occupied histogram
+        # (the histogram already carries the ENTRY-row reduction; occupying
+        # entries hold a concurrency slot even though their PASS lands later)
+        concurrency = state.concurrency + hist[:, W.EV_PASS] + hist[:, W.EV_OCCUPIED]
     else:
         inc = jnp.tile(jnp.where(passed, acq.count, 0), (fan,))
         concurrency = state.concurrency.at[rows].add(inc, mode="drop")
-        concurrency = concurrency.at[cfg.entry_node_row].add(entry_deltas[W.EV_PASS])
+        concurrency = concurrency.at[cfg.entry_node_row].add(
+            entry_deltas[W.EV_PASS] + entry_deltas[W.EV_OCCUPIED]
+        )
     state = state._replace(concurrency=concurrency)
 
     # param pass counting into the sketch (only admitted traffic consumes

@@ -56,6 +56,8 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
         latest_passed_ms=rep,
         warmup_tokens=rep,
         warmup_last_s=rep,
+        occ_tokens=rep,
+        occ_epoch=rep,
         cb_state=rep,
         cb_retry_ms=rep,
         cb_counts=rep,

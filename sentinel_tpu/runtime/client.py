@@ -695,6 +695,7 @@ class SentinelClient:
         counts: Optional[Sequence[int]] = None,
         origins: Optional[Sequence[str]] = None,
         params: Optional[Sequence[Any]] = None,
+        prioritized: Optional[Sequence[bool]] = None,
         inbound: bool = False,
     ) -> List[Tuple[int, int]]:
         """Vector acquire: returns [(verdict, wait_ms)] per resource.
@@ -733,7 +734,7 @@ class SentinelClient:
                 req = AcquireRequest(
                     res=rid,
                     count=counts[i] if counts else 1,
-                    prio=0,
+                    prio=1 if (prioritized is not None and prioritized[i]) else 0,
                     origin_id=self.registry.origin_id(origin) if origin else -1,
                     origin_node=self.registry.origin_node_row(name, origin)
                     if origin
@@ -938,6 +939,7 @@ class ClientStats:
             "blockQps": float(counts[W.EV_BLOCK]) / interval_s,
             "successQps": succ / interval_s,
             "exceptionQps": float(counts[W.EV_EXCEPTION]) / interval_s,
+            "occupiedPassQps": float(counts[W.EV_OCCUPIED]) / interval_s,
             "avgRt": float(np.asarray(rt_tot)[0]) / succ if succ > 0 else 0.0,
             "minRt": _mask_min_rt(float(np.asarray(rt_min)[0])),
             "curThreadNum": conc,

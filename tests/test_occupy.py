@@ -1,0 +1,176 @@
+"""Prioritized occupy-ahead (DefaultController.tryOccupyNext /
+OccupiableBucketLeapArray): a prioritized request rejected by the QPS check
+borrows from the next bucket's budget, waits for it, and enters — up to one
+bucket's worth per rule; the borrowed tokens reduce the next bucket's
+budget exactly."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.ops import window as W
+
+
+@pytest.fixture()
+def c(client_factory):
+    return client_factory()
+
+
+def _fill(c, vt, res, n):
+    """Admit n normal requests inside the current bucket."""
+    ok = 0
+    for _ in range(n):
+        try:
+            with c.entry(res):
+                pass
+            ok += 1
+        except st.BlockException:
+            pass
+    return ok
+
+
+def test_prioritized_borrows_next_bucket(c, vt):
+    c.flow_rules.load([st.FlowRule(resource="occ", count=4)])
+    assert _fill(c, vt, "occ", 4) == 4
+    # normal request: rejected
+    with pytest.raises(st.FlowException):
+        c.entry("occ")
+    # prioritized request: borrows from the next bucket and waits
+    t0 = c.time.now_ms()
+    e = c.entry("occ", prioritized=True)
+    waited = c.time.now_ms() - t0
+    assert 0 < waited <= c.cfg.second_window_ms  # slept to the bucket edge
+    e.exit()
+    s = c.stats.resource("occ")
+    assert s["occupiedPassQps"] >= 1
+
+
+def test_occupy_capped_at_one_bucket():
+    """Within ONE tick, borrows against the next bucket stop at the rule's
+    count (maxOccupyRatio = 1): 3 of 6 prioritized over-quota requests get
+    SHOULD-WAIT verdicts, the rest block."""
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core import errors as ERR
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.runtime.registry import Registry
+
+    cfg = small_engine_config()
+    reg = Registry(cfg)
+    reg.resource_id("cap")
+    ruleset = E.compile_ruleset(cfg, reg, flow_rules=[FlowRule(resource="cap", count=3)])
+    tick = E.make_tick(cfg, donate=False)
+    state = E.init_state(cfg)
+    b = cfg.batch_size
+    rid = reg.peek_resource_id("cap")
+
+    # one batch: 3 normal (fill the window) + 6 prioritized over-quota
+    res = jnp.full((b,), cfg.trash_row, jnp.int32).at[:9].set(rid)
+    prio = jnp.zeros((b,), jnp.int32).at[3:9].set(1)
+    acq = E.empty_acquire(cfg)._replace(
+        res=res, count=jnp.ones((b,), jnp.int32), prio=prio
+    )
+    state, out = tick(
+        state, ruleset, acq, E.empty_complete(cfg), jnp.int32(100),
+        jnp.float32(0), jnp.float32(0),
+    )
+    v = np.asarray(out.verdict)[:9]
+    w = np.asarray(out.wait_ms)[:9]
+    assert list(v[:3]) == [ERR.PASS] * 3
+    assert list(v[3:6]) == [ERR.PASS_WAIT] * 3  # borrows up to count=3
+    assert all(0 < x <= 500 for x in w[3:6])
+    assert list(v[6:9]) == [ERR.BLOCK_FLOW] * 3  # next-bucket budget spent
+
+
+def test_borrowed_tokens_reduce_next_bucket(c, vt):
+    c.flow_rules.load([st.FlowRule(resource="debt", count=4)])
+    _fill(c, vt, "debt", 4)
+    e = c.entry("debt", prioritized=True)  # borrows 1; sleeps into next bucket
+    e.exit()
+    # we are now INSIDE the borrowed-against bucket: the sliding window
+    # still holds the previous bucket's 4 passes + the folded borrow
+    assert _fill(c, vt, "debt", 4) == 0
+    # a full interval later the debt has rolled out of the window
+    vt.advance(c.cfg.second_window_ms * c.cfg.second_sample_count)
+    assert _fill(c, vt, "debt", 4) == 4
+
+
+def test_occupy_revoked_by_open_breaker_books_nothing(c, vt):
+    """A prioritized over-quota request that a later slot (open circuit
+    breaker) blocks must not commit its borrow, must not count OCCUPIED,
+    and must not leak concurrency."""
+    import numpy as np
+
+    c.flow_rules.load([st.FlowRule(resource="rv", count=1)])
+    c.degrade_rules.load(
+        [
+            st.DegradeRule(
+                resource="rv", grade=st.CB_STRATEGY_ERROR_COUNT, count=1,
+                min_request_amount=1, stat_interval_ms=1000, time_window=5,
+            )
+        ]
+    )
+    # trip the breaker
+    with c.entry("rv"):
+        c.trace(ValueError("x"))
+    vt.advance(50)
+    # over-quota normal attempt: flow slot blocks first (reference order)
+    with pytest.raises(st.FlowException):
+        c.entry("rv")
+    # over-quota prioritized attempt: flow GRANTS the occupy, then the open
+    # breaker blocks — the grant must be revoked
+    with pytest.raises(st.DegradeException):
+        c.entry("rv", prioritized=True)
+    s = c.stats.resource("rv")
+    assert s["occupiedPassQps"] == 0
+    assert s["curThreadNum"] == 0
+    assert float(np.asarray(c._state.occ_tokens).sum()) == 0
+
+
+def test_occupied_counts_once(c, vt):
+    """occupiedPassQps counts once at grant; the fold adds only the
+    deferred PASS (the reference's OCCUPIED_PASS-then-PASS split)."""
+    c.flow_rules.load([st.FlowRule(resource="once", count=1)])
+    with c.entry("once"):
+        pass
+    e = c.entry("once", prioritized=True)  # borrows; sleeps into next bucket
+    e.exit()
+    vt.advance(10)
+    s = c.stats.resource("once")
+    assert s["occupiedPassQps"] == 1  # not 2
+    assert s["passQps"] == 2  # original + folded borrow
+
+
+def test_cluster_prioritized_should_wait(c, vt):
+    """Token-server parity: a prioritized requestToken over the global quota
+    comes back STATUS_SHOULD_WAIT with the wait to the next bucket."""
+    from sentinel_tpu.cluster import constants as CC
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+    svc = DefaultTokenService(c)
+    svc.flow_rules.load(
+        "ns",
+        [
+            st.FlowRule(
+                resource="g", count=2, cluster_mode=True,
+                cluster_flow_id=42, cluster_threshold_type=1,
+            )
+        ],
+    )
+    assert svc.request_token(42, 1).status == CC.STATUS_OK
+    assert svc.request_token(42, 1).status == CC.STATUS_OK
+    assert svc.request_token(42, 1).status == CC.STATUS_BLOCKED
+    r = svc.request_token(42, 1, prioritized=True)
+    assert r.status == CC.STATUS_SHOULD_WAIT
+    assert 0 < r.wait_ms <= c.cfg.second_window_ms
+
+
+def test_normal_requests_never_occupy(c, vt):
+    c.flow_rules.load([st.FlowRule(resource="norm", count=2)])
+    _fill(c, vt, "norm", 2)
+    with pytest.raises(st.FlowException):
+        c.entry("norm")
+    s = c.stats.resource("norm")
+    assert s["occupiedPassQps"] == 0
