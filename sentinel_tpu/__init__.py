@@ -76,6 +76,7 @@ from sentinel_tpu.core.api import (
     load_system_rules,
     reset,
     trace,
+    entry_async,
     try_entry,
 )
 
@@ -107,6 +108,7 @@ __all__ = [
     "clear_rules",
     "context",
     "entry",
+    "entry_async",
     "get_client",
     "init",
     "load_authority_rules",

@@ -54,6 +54,14 @@ def entry(resource: str, count: int = 1, prioritized: bool = False, args=None):
     return get_client().entry(resource, count=count, prioritized=prioritized, args=args)
 
 
+def entry_async(resource: str, count: int = 1, prioritized: bool = False, args=None):
+    """Awaitable entry (AsyncEntry analog): ``e = await st.entry_async(r)``;
+    exit with ``e.exit()`` (non-blocking)."""
+    return get_client().entry_async(
+        resource, count=count, prioritized=prioritized, args=args
+    )
+
+
 def try_entry(resource: str, count: int = 1, args=None):
     """Boolean variant (SphO.java). Returns an Entry or None."""
     return get_client().try_entry(resource, count=count, args=args)

@@ -28,6 +28,7 @@ from sentinel_tpu.datasource.converters import (
     json_rule_converter,
     json_rule_encoder,
 )
+from sentinel_tpu.datasource.remote import CallbackDataSource, HttpDataSource
 
 __all__ = [
     "SentinelProperty",
@@ -38,6 +39,8 @@ __all__ = [
     "ReadableDataSource",
     "WritableDataSource",
     "AbstractDataSource",
+    "CallbackDataSource",
+    "HttpDataSource",
     "AutoRefreshDataSource",
     "FileRefreshableDataSource",
     "FileWritableDataSource",

@@ -591,7 +591,11 @@ def _fold_occupied(cfg: EngineConfig, state: EngineState, rules: RuleSet, now_ms
     (occupy/OccupiableBucketLeapArray.java:29-43)."""
     cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
     due = (state.occ_epoch <= cur_wid) & (state.occ_tokens > 0)
-    tok = jnp.round(jnp.where(due, state.occ_tokens, 0.0)).astype(jnp.int32)
+    # debt whose target bucket already rolled OUT of the sliding window
+    # (idle gap longer than the interval) is discarded, not charged — the
+    # borrowed-against budget expired unused
+    chargeable = due & (cur_wid - state.occ_epoch < cfg.second_sample_count)
+    tok = jnp.round(jnp.where(chargeable, state.occ_tokens, 0.0)).astype(jnp.int32)
     any_due = jnp.any(due)
 
     def fold(s):

@@ -273,6 +273,12 @@ class SentinelClient:
         self._started = False
         self.stats = ClientStats(self)
 
+        # host-side hot-param value tracking: the device CMS holds hashes
+        # only; the command plane's topParams view needs the VALUES, so the
+        # entry path keeps a small capped counter per resource
+        self._hot_params: Dict[str, Dict[Any, int]] = {}
+        self._hot_params_lock = threading.Lock()
+
         # observability plane (MetricTimerListener / EagleEye block log)
         self._metric_log_enabled = metric_log
         self._metric_log_dir = metric_log_dir
@@ -564,18 +570,25 @@ class SentinelClient:
         args: Optional[Sequence[Any]] = None,
         inbound: bool = False,
         origin: Optional[str] = None,
+        _ctx: Optional[Tuple[str, str]] = None,
+        _push_ctx: bool = True,
     ) -> Entry:
-        """Acquire; raises BlockException on rejection (SphU.entry)."""
+        """Acquire; raises BlockException on rejection (SphU.entry).
+
+        ``_ctx``/``_push_ctx`` support entry_async: the context is captured
+        in the awaiting task and the push happens there too."""
         if not self.enabled:
             e = _PassThroughEntry(self, resource)
-            CTX.push_entry(e)
+            if _push_ctx:
+                CTX.push_entry(e)
             return e
-        ctx_name, ctx_origin = CTX.current()
+        ctx_name, ctx_origin = _ctx if _ctx is not None else CTX.current()
         origin = origin if origin is not None else ctx_origin
         rid = self.registry.resource_id(resource)
         if rid is None:
             e = _PassThroughEntry(self, resource)
-            CTX.push_entry(e)
+            if _push_ctx:
+                CTX.push_entry(e)
             return e  # capacity overflow → pass-through (CtSph.java:200)
 
         origin_id = self.registry.origin_id(origin) if origin else -1
@@ -602,6 +615,7 @@ class SentinelClient:
             if 0 <= idx < len(args):
                 param_value = args[idx]
                 param_hash = hash_param(param_value)
+                self._note_hot_param(resource, param_value)
 
         pre_verdict, cluster_wait = 0, 0
         if self._cluster_flow_by_res or self._cluster_param_by_res:
@@ -657,7 +671,8 @@ class SentinelClient:
             self.time.now_ms(),
             wait_ms,
         )
-        CTX.push_entry(e)
+        if _push_ctx:
+            CTX.push_entry(e)
         return e
 
     def try_entry(self, resource: str, **kw) -> Optional[Entry]:
@@ -666,6 +681,49 @@ class SentinelClient:
             return self.entry(resource, **kw)
         except ERR.BlockException:
             return None
+
+    async def entry_async(self, resource: str, **kw) -> Entry:
+        """AsyncEntry analog: the entry handshake (a blocking wait on the
+        engine tick, ~ms) runs in an executor so the event loop never
+        blocks; raises BlockException like entry().  Exit the returned
+        Entry normally — exits are non-blocking (one ring push).
+
+        The caller's context (ContextUtil name/origin) is captured HERE and
+        the Entry is pushed onto the AWAITING task's context stack after the
+        handshake — run_in_executor does not propagate contextvars, so both
+        must happen on this side of the await (AsyncEntry's context capture,
+        AsyncEntry.java)."""
+        import asyncio
+        import functools as _ft
+
+        ctx = CTX.current()
+        loop = asyncio.get_running_loop()
+        e = await loop.run_in_executor(
+            None, _ft.partial(self.entry, resource, _ctx=ctx, _push_ctx=False, **kw)
+        )
+        CTX.push_entry(e)
+        return e
+
+    _HOT_PARAM_CAP = 512
+
+    def _note_hot_param(self, resource: str, value) -> None:
+        """Count a parameter value sighting (ParameterMetric's value-keyed
+        CacheMap analog, host side, capped with decimation on overflow)."""
+        try:
+            with self._hot_params_lock:
+                counter = self._hot_params.setdefault(resource, {})
+                counter[value] = counter.get(value, 0) + 1
+                if len(counter) > self._HOT_PARAM_CAP:
+                    top = sorted(counter.items(), key=lambda kv: -kv[1])
+                    self._hot_params[resource] = dict(top[: self._HOT_PARAM_CAP // 2])
+        except TypeError:
+            pass  # unhashable param value — not trackable
+
+    def top_params(self, resource: str, n: int = 16) -> list:
+        """[(value, sightings)] — the hottest parameter values seen."""
+        with self._hot_params_lock:
+            counter = dict(self._hot_params.get(resource, {}))
+        return sorted(counter.items(), key=lambda kv: -kv[1])[:n]
 
     def trace(self, exc: BaseException, count: int = 1) -> None:
         e = CTX.current_entry()

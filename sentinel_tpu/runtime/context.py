@@ -51,6 +51,13 @@ def pop_entry(entry) -> None:
         _entries.set(tuple(e for e in stack if e is not entry))
 
 
+def clear() -> None:
+    """Reset this context's entry stack and context name — the
+    ContextTestUtil.cleanUpContext analog for tests/tools."""
+    _entries.set(())
+    _current.set((DEFAULT_CONTEXT_NAME, ""))
+
+
 def current_entry():
     stack = _entries.get()
     return stack[-1] if stack else None

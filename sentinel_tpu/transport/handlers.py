@@ -110,6 +110,16 @@ class DefaultHandlerGroup:
             R.rules_to_json_list(self.client.param_flow_rules.get())
         )
 
+    @command_mapping("topParams", "hottest parameter values for a resource")
+    def top_params(self, req: CommandRequest) -> CommandResponse:
+        res = req.param("id")
+        if not res:
+            return CommandResponse.of_failure("id is required")
+        n = int(req.param("n", "16"))
+        return CommandResponse.of_success(
+            [{"param": repr(v), "sightings": c} for v, c in self.client.top_params(res, n)]
+        )
+
     # -- metrics ------------------------------------------------------------
 
     @command_mapping("metric", "query metric log lines by time range")

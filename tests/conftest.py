@@ -26,6 +26,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _clean_context():
+    """Entries deliberately held open by one test must not leak into the
+    next test's (or its asyncio.run copy's) context stack — the
+    ContextTestUtil.cleanUpContext analog."""
+    yield
+    from sentinel_tpu.runtime import context as CTX
+
+    CTX.clear()
+
+
 @pytest.fixture()
 def vt():
     """Fresh virtual time source starting at a non-zero, non-aligned ms."""

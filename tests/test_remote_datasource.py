@@ -1,0 +1,139 @@
+"""Remote datasources (HTTP poll with conditional GET, push callback),
+async entry, and hot-param top-K visibility."""
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import CallbackDataSource, HttpDataSource, json_rule_converter
+
+
+@pytest.fixture()
+def rules_server():
+    state = {"body": json.dumps([{"resource": "http-res", "count": 5}]), "etag": "v1", "hits": 0, "not_modified": 0}
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            state["hits"] += 1
+            if self.headers.get("If-None-Match") == state["etag"]:
+                state["not_modified"] += 1
+                self.send_response(304)
+                self.end_headers()
+                return
+            payload = state["body"].encode()
+            self.send_response(200)
+            self.send_header("ETag", state["etag"])
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv, state
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_http_datasource_polls_and_conditional_gets(rules_server, client):
+    srv, state = rules_server
+    url = f"http://127.0.0.1:{srv.server_address[1]}/rules"
+    ds = HttpDataSource(url, json_rule_converter("flow"), refresh_ms=50)
+    try:
+        client.flow_rules.register_property(ds.get_property())
+        assert client.flow_rules.get()[0].count == 5  # initial fetch
+
+        assert not ds.refresh()  # unchanged → 304 → no push
+        assert state["not_modified"] >= 1
+
+        state["body"] = json.dumps([{"resource": "http-res", "count": 9}])
+        state["etag"] = "v2"
+        assert ds.refresh()
+        assert client.flow_rules.get()[0].count == 9
+    finally:
+        ds.close()
+
+
+def test_callback_datasource_push(client):
+    ds = CallbackDataSource(json_rule_converter("degrade"))
+    client.degrade_rules.register_property(ds.get_property())
+    ds.update(json.dumps([{"resource": "cb-res", "count": 3, "grade": 2}]))
+    rules = client.degrade_rules.get()
+    assert rules[0].resource == "cb-res"
+    ds.update("[]")
+    assert client.degrade_rules.get() == []
+
+
+def test_entry_async(client, vt):
+    client.flow_rules.load([st.FlowRule(resource="aio", count=1)])
+
+    async def run():
+        e = await client.entry_async("aio")
+        e.exit()
+        with pytest.raises(st.BlockException):
+            await client.entry_async("aio")
+
+    asyncio.run(run())
+    assert client.stats.resource("aio")["passQps"] == 1
+
+
+def test_entry_async_trace_and_context(client, vt):
+    """The Entry lands on the AWAITING task's context stack: st-style
+    trace() after the await records the error, and exit() pops cleanly."""
+    from sentinel_tpu.runtime import context as CTX
+
+    client.flow_rules.load([st.FlowRule(resource="aio2", count=10)])
+
+    async def run():
+        e = await client.entry_async("aio2")
+        assert CTX.current_entry() is e
+        client.trace(ValueError("async biz error"))
+        vt.advance(5)
+        e.exit()
+        assert CTX.current_entry() is None
+
+    asyncio.run(run())
+    s = client.stats.resource("aio2")
+    assert s["exceptionQps"] == 1
+    assert s["curThreadNum"] == 0
+
+
+def test_hot_param_topk(client, vt):
+    from sentinel_tpu.transport import build_default_handlers
+    from sentinel_tpu.transport.command import CommandRequest
+
+    client.param_flow_rules.load(
+        [st.ParamFlowRule(resource="hp", count=100, param_idx=0)]
+    )
+    for u, n in (("alice", 5), ("bob", 2), ("carol", 1)):
+        for _ in range(n):
+            with client.entry("hp", args=[u]):
+                pass
+    assert client.top_params("hp", 2) == [("alice", 5), ("bob", 2)]
+    reg = build_default_handlers(client)
+    out = reg.handle("topParams", CommandRequest(parameters={"id": "hp"}))
+    assert out.success
+    assert out.result[0] == {"param": "'alice'", "sightings": 5}
+
+
+def test_hot_param_cap_decimates(client, vt):
+    client.param_flow_rules.load(
+        [st.ParamFlowRule(resource="cap", count=10000, param_idx=0)]
+    )
+    cap = client._HOT_PARAM_CAP
+    # one hot value plus a long unique tail
+    for _ in range(10):
+        with client.entry("cap", args=["hot"]):
+            pass
+    for i in range(cap + 100):
+        with client.entry("cap", args=[f"cold-{i}"]):
+            pass
+    top = client.top_params("cap", 1)
+    assert top[0][0] == "hot"  # survivors are the hottest
+    assert len(client._hot_params["cap"]) <= cap
