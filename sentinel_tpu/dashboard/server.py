@@ -57,7 +57,12 @@ class DashboardServer:
         fetch_metrics: bool = True,
         rule_provider: Optional[DynamicRuleProvider] = None,
         rule_publisher: Optional[DynamicRulePublisher] = None,
+        auth_token: Optional[str] = None,
     ):
+        # auth_token gates every operator route with a bearer token (the
+        # AuthController/login-filter analog); machine heartbeats stay open
+        # like the reference's excluded /registry endpoints
+        self.auth_token = auth_token
         self.discovery = AppManagement()
         self.repository = InMemoryMetricsRepository()
         self.api = SentinelApiClient()
@@ -136,7 +141,18 @@ class DashboardServer:
         route = (method, parsed.path.rstrip("/") or "/")
         fn = self._routes().get(route)
         try:
-            if fn is None:
+            import hmac
+
+            if (
+                self.auth_token is not None
+                and route != ("POST", "/registry/machine")
+                and not hmac.compare_digest(
+                    handler.headers.get("Authorization") or "",
+                    f"Bearer {self.auth_token}",
+                )
+            ):
+                code, result = 401, {"error": "unauthorized"}
+            elif fn is None:
                 code, result = 404, {"error": f"no route {route[0]} {route[1]}"}
             else:
                 code, result = fn(params, body)

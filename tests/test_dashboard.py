@@ -92,6 +92,35 @@ def test_fetcher_catchup_window():
     assert start2 == (now - 5000) + 1000
 
 
+def test_dashboard_auth_token():
+    """Operator routes require the bearer token; heartbeats stay open."""
+    import urllib.error
+
+    dash = DashboardServer(host="127.0.0.1", port=0, fetch_metrics=False,
+                           auth_token="s3cret")
+    dash.start()
+    try:
+        base = f"http://127.0.0.1:{dash.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/apps", timeout=3)
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            f"{base}/apps", headers={"Authorization": "Bearer s3cret"}
+        )
+        assert json.load(urllib.request.urlopen(req, timeout=3)) == {}
+        # heartbeat registration is exempt (machines don't hold the token)
+        hb = urllib.request.Request(
+            f"{base}/registry/machine",
+            data=urllib.parse.urlencode(
+                {"app": "a", "ip": "1.1.1.1", "port": "8719"}
+            ).encode(),
+            method="POST",
+        )
+        assert urllib.request.urlopen(hb, timeout=3).status == 200
+    finally:
+        dash.stop()
+
+
 @pytest.fixture()
 def live_stack(client):
     """Real client + command center + dashboard server, wired by heartbeat."""
