@@ -147,8 +147,12 @@ class DashboardServer:
                 self.auth_token is not None
                 and route != ("POST", "/registry/machine")
                 and not hmac.compare_digest(
-                    handler.headers.get("Authorization") or "",
-                    f"Bearer {self.auth_token}",
+                    # bytes, not str: compare_digest(str) demands ASCII and
+                    # would raise on an arbitrary client-supplied header
+                    (handler.headers.get("Authorization") or "").encode(
+                        "utf-8", "surrogateescape"
+                    ),
+                    f"Bearer {self.auth_token}".encode("utf-8"),
                 )
             ):
                 code, result = 401, {"error": "unauthorized"}

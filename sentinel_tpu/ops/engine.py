@@ -86,6 +86,9 @@ class EngineState(NamedTuple):
     latest_passed_ms: jax.Array  # float32 [F+1] RateLimiterController.latestPassedTime
     warmup_tokens: jax.Array  # float32 [F+1] WarmUpController.storedTokens
     warmup_last_s: jax.Array  # int32 [F+1] lastFilledTime (seconds)
+    # per-slot admitted counts of the CURRENT second (exact passQps for the
+    # warm-up sync — a boundary-moment window read underestimates ~2x)
+    warm_acc: jax.Array  # float32 [F+1]
     # prioritized occupy-ahead (OccupiableBucketLeapArray / tryOccupyNext):
     # tokens borrowed against window epoch occ_epoch, folded into that
     # window's pass counts when it becomes current
@@ -166,6 +169,7 @@ def init_state(cfg: EngineConfig) -> EngineState:
         latest_passed_ms=jnp.full((F + 1,), -1.0e9, dtype=jnp.float32),
         warmup_tokens=jnp.zeros((F + 1,), dtype=jnp.float32),
         warmup_last_s=jnp.full((F + 1,), -1, dtype=jnp.int32),
+        warm_acc=jnp.zeros((F + 1,), dtype=jnp.float32),
         occ_tokens=jnp.zeros((F + 1,), dtype=jnp.float32),
         occ_epoch=jnp.full((F + 1,), -1, dtype=jnp.int32),
         cb_state=jnp.zeros((Dn + 1,), dtype=jnp.int32),
@@ -627,22 +631,22 @@ def _sync_warmup(
     """Per-second warm-up token refill, vectorized over all flow rules
     (WarmUpController.syncToken/coolDownTokens)."""
     f = rules.flow
-    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
     cur_s = (now_ms // 1000).astype(jnp.int32)
     is_warm = (
         (f.behavior == CONTROL_WARM_UP) | (f.behavior == CONTROL_WARM_UP_RATE_LIMITER)
     ) & f.enabled
     elapsed = cur_s - state.warmup_last_s
     first = state.warmup_last_s < 0
-    do_sync = is_warm & ((elapsed > 0) | first)
+    sync_time = (elapsed > 0) | first  # every slot tracks seconds + resets acc
+    do_sync = is_warm & sync_time
 
-    node = f.res  # warm-up rules meter their resource's cluster node
-    if cfg.use_mxu_tables:
-        wsum = W.window_event(state.win_sec, now_ms, sec_cfg, W.EV_PASS)
-        pass_qps = T.big_gather(cfg, wsum, jnp.asarray(node), cfg.node_rows, max_int=(1 << 24))
-    else:
-        pass_qps = W.gather_window_event(state.win_sec, now_ms, node, sec_cfg, W.EV_PASS)
-    pass_qps = pass_qps.astype(jnp.float32)
+    # exact passQps: the PREVIOUS full second's per-slot admitted counts,
+    # accumulated by the tick effects (a sliding-window read taken at the
+    # second boundary sees only the surviving half-bucket and systematically
+    # underestimates, freezing the bucket cold).  After an idle gap
+    # (elapsed > 1) the accumulator belongs to a long-past second — the
+    # recent rate is 0 and the bucket must be allowed to refill to cold.
+    pass_qps = jnp.where(elapsed == 1, state.warm_acc, 0.0)
 
     tokens = state.warmup_tokens
     refill_ok = (tokens < f.warning_token) | (
@@ -656,8 +660,13 @@ def _sync_warmup(
     new_tokens = jnp.maximum(new_tokens - pass_qps, 0.0)
 
     tokens = jnp.where(do_sync, new_tokens, tokens)
-    last_s = jnp.where(do_sync, cur_s, state.warmup_last_s)
-    return state._replace(warmup_tokens=tokens, warmup_last_s=last_s)
+    # second tracking + accumulator reset apply to EVERY slot (a plain rule
+    # flipped to warm-up at runtime must not inherit a historical total)
+    last_s = jnp.where(sync_time, cur_s, state.warmup_last_s)
+    warm_acc = jnp.where(sync_time, 0.0, state.warm_acc)
+    return state._replace(
+        warmup_tokens=tokens, warmup_last_s=last_s, warm_acc=warm_acc
+    )
 
 
 def _check_flow(
@@ -883,7 +892,7 @@ def _check_flow(
         -3.0e38,
     )
 
-    return blocked, wait_ms.astype(jnp.int32), latest, occupying, occ_grant
+    return blocked, wait_ms.astype(jnp.int32), latest, occupying, occ_grant, slots_f
 
 
 def _check_degrade(
@@ -1009,7 +1018,7 @@ def tick(
     eligible = eligible & ~param_block
 
     if "flow" in features:
-        flow_block, wait_ms, latest_passed, occupying, occ_grant = _check_flow(
+        flow_block, wait_ms, latest_passed, occupying, occ_grant, fslots = _check_flow(
             cfg, state, rules, acq, now_ms, eligible, occupy="occupy" in features
         )
         flow_block = flow_block & eligible
@@ -1019,6 +1028,7 @@ def tick(
         flow_block = zero_block
         occupying = zero_block
         occ_grant = None
+        fslots = None
         wait_ms = jnp.zeros((b,), jnp.int32)
     eligible = eligible & ~flow_block
 
@@ -1124,6 +1134,19 @@ def tick(
             entry_deltas[W.EV_PASS] + entry_deltas[W.EV_OCCUPIED]
         )
     state = state._replace(concurrency=concurrency)
+
+    # warm-up drain accounting: exact per-slot admitted counts this second
+    if "warmup" in features and fslots is not None:
+        K = cfg.flow_rules_per_resource
+        item_f = jnp.repeat(jnp.arange(b), K)
+        adm = passed[item_f]
+        acc_add = T.small_scatter_add(
+            cfg,
+            jnp.zeros((cfg.max_flow_rules + 1,), jnp.float32),
+            jnp.where(adm, fslots, jnp.int32(-1)),
+            jnp.where(adm, acq.count[item_f].astype(jnp.float32), 0.0),
+        )
+        state = state._replace(warm_acc=state.warm_acc + acc_add)
 
     # param pass counting into the sketch (only admitted traffic consumes
     # the per-value budget, like the token bucket decrement in

@@ -56,6 +56,7 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
         latest_passed_ms=rep,
         warmup_tokens=rep,
         warmup_last_s=rep,
+        warm_acc=rep,
         occ_tokens=rep,
         occ_epoch=rep,
         cb_state=rep,

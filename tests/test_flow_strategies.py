@@ -1,0 +1,105 @@
+"""Flow-rule strategy semantics: RELATE (meter another resource's node),
+CHAIN (context-scoped metering), and the warm-up controller's cold-start
+ramp (FlowRuleChecker.selectNodeByRequesterAndStrategy:115,
+WarmUpController.java:65-112)."""
+
+import pytest
+
+import sentinel_tpu as st
+
+
+def test_strategy_relate_meters_reference_resource(client, vt):
+    """Writes are limited by READ traffic: the rule on 'write' watches
+    'read''s node (the classic read/write contention example)."""
+    client.flow_rules.load(
+        [
+            st.FlowRule(
+                resource="write",
+                count=5,
+                strategy=st.STRATEGY_RELATE,
+                ref_resource="read",
+            )
+        ]
+    )
+    # no read traffic → writes sail through
+    for _ in range(10):
+        with client.entry("write"):
+            pass
+    # heavy read traffic fills the REFERENCE node's window over the limit
+    vt.advance(1100)
+    for _ in range(6):
+        with client.entry("read"):
+            pass
+    with pytest.raises(st.FlowException):
+        client.entry("write")
+    # reads themselves are not limited by the rule on 'write'
+    with client.entry("read"):
+        pass
+
+
+def test_strategy_chain_scopes_to_context(client, vt):
+    """CHAIN: the rule applies only to entries made under the named
+    context, metering that context's DefaultNode."""
+    client.flow_rules.load(
+        [
+            st.FlowRule(
+                resource="svc",
+                count=2,
+                strategy=st.STRATEGY_CHAIN,
+                ref_resource="ctx-a",
+            )
+        ]
+    )
+    # other contexts: unlimited by this rule
+    with client.context("ctx-b"):
+        for _ in range(5):
+            with client.entry("svc"):
+                pass
+    # the named context: capped at 2
+    with client.context("ctx-a"):
+        ok = blocked = 0
+        for _ in range(5):
+            try:
+                with client.entry("svc"):
+                    pass
+                ok += 1
+            except st.FlowException:
+                blocked += 1
+    assert ok == 2 and blocked == 3
+
+
+def test_warm_up_cold_start_ramp(client, vt):
+    """Cold system: admission starts near count/coldFactor and reaches the
+    full count as traffic sustains (Guava warm-up token bucket)."""
+    count = 30
+    client.flow_rules.load(
+        [
+            st.FlowRule(
+                resource="warm",
+                count=count,
+                control_behavior=st.CONTROL_WARM_UP,
+                warm_up_period_sec=4,
+                cold_factor=3,
+            )
+        ]
+    )
+
+    def offered_second():
+        ok = 0
+        for _ in range(count * 2):
+            vt.advance(1000 // (count * 2))
+            try:
+                with client.entry("warm"):
+                    pass
+                ok += 1
+            except st.FlowException:
+                pass
+        return ok
+
+    first = offered_second()
+    # cold: roughly count/coldFactor (10), certainly well under full rate
+    assert first <= count * 0.6, first
+    rates = [offered_second() for _ in range(6)]
+    # warmed: the last seconds admit (close to) the full count
+    assert rates[-1] >= count * 0.9, rates
+    assert rates[-1] > first
