@@ -56,6 +56,7 @@ from sentinel_tpu.core.rules import (
 )
 from sentinel_tpu.ops import degrade as D
 from sentinel_tpu.ops import gsketch as GS
+from sentinel_tpu.ops import rtq as RQ
 from sentinel_tpu.ops import param as P
 from sentinel_tpu.ops import tables as T
 from sentinel_tpu.ops import window as W
@@ -105,6 +106,8 @@ class EngineState(NamedTuple):
     # global observability sketch for tail resources (ops/gsketch.py);
     # [1,1,1,1]-shaped dummy when sketch_stats is off
     gs: GS.SketchState
+    # ENTRY-node RT quantile histogram (ops/rtq.py)
+    rtq: RQ.RtqState
 
 
 class RuleSet(NamedTuple):
@@ -187,6 +190,15 @@ def init_state(cfg: EngineConfig) -> EngineState:
             counts=jnp.zeros((1, 1, 1, GS.PLANES), jnp.int32),
             epochs=jnp.full((1,), -2, jnp.int32),
         ),
+        rtq=RQ.init_rtq(rtq_config(cfg)),
+    )
+
+
+def rtq_config(cfg: EngineConfig) -> RQ.RtqConfig:
+    return RQ.RtqConfig(
+        sample_count=cfg.second_sample_count,
+        window_ms=cfg.second_window_ms,
+        max_rt=float(cfg.statistic_max_rt),
     )
 
 
@@ -352,6 +364,10 @@ def _process_completions(
     )
     state, hist = _stat_update(
         cfg, state, now_ms, rows, deltas, rt, entry_deltas, entry_rt, entry_rt_min
+    )
+    # service-level RT quantiles over inbound completions (ops/rtq.py)
+    state = state._replace(
+        rtq=RQ.add(state.rtq, now_ms, comp.rt, inb & (comp.rt > 0), rtq_config(cfg))
     )
     if cfg.sketch_stats:
         rt_q = jnp.round(

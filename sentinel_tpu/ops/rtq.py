@@ -1,0 +1,120 @@
+"""Service-level RT quantiles — windowed log-bucket histogram.
+
+The north star calls for RT quantile tracking (t-digest's role); a
+log-spaced fixed-bin histogram achieves the same queries (p50/p90/p99/...)
+with a pure tensor update: completions one-hot into 64 bins whose edges
+grow geometrically up to statistic_max_rt, giving ~11% worst-case relative
+error per bucket — far below the noise of RT distributions — at the cost
+of ONE [B, 64] contraction per completion batch.
+
+Scope is the global ENTRY node (inbound traffic), like the system rules'
+RT inputs; the reference tracks only avg/min RT, so this is a net add.
+Window bucketing follows the ops/window.py epoch scheme.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BINS = 64
+
+
+class RtqConfig(NamedTuple):
+    sample_count: int
+    window_ms: int
+    max_rt: float  # statistic_max_rt
+
+    @property
+    def interval_ms(self) -> int:
+        return self.sample_count * self.window_ms
+
+    @property
+    def wcfg(self):
+        """The shared epoch-window scheme (ops/window.py) — one source of
+        truth for bucket ids and validity."""
+        from sentinel_tpu.ops import window as W
+
+        return W.WindowConfig(self.sample_count, self.window_ms)
+
+
+class RtqState(NamedTuple):
+    counts: jax.Array  # int32 [nb, BINS]
+    epochs: jax.Array  # int32 [nb]
+
+
+def init_rtq(cfg: RtqConfig) -> RtqState:
+    return RtqState(
+        counts=jnp.zeros((cfg.sample_count, BINS), jnp.int32),
+        epochs=jnp.full((cfg.sample_count,), -(cfg.sample_count + 1), jnp.int32),
+    )
+
+
+def _log_scale(cfg: RtqConfig) -> float:
+    return (BINS - 1) / float(np.log2(cfg.max_rt + 2.0))
+
+
+def bin_of(rt_ms: jax.Array, cfg: RtqConfig) -> jax.Array:
+    """int32 bin per rt (log2-spaced edges)."""
+    x = jnp.log2(jnp.maximum(rt_ms, 0.0) + 1.0) * _log_scale(cfg)
+    return jnp.clip(x.astype(jnp.int32), 0, BINS - 1)
+
+
+def bin_upper_edge(b: int, cfg: RtqConfig) -> float:
+    """Upper RT edge of bin b (host-side, for quantile readout)."""
+    return float(2.0 ** ((b + 1) / _log_scale(cfg)) - 1.0)
+
+
+def add(
+    state: RtqState,
+    now_ms,
+    rt_ms: jax.Array,  # f32 [B]
+    valid: jax.Array,  # bool [B]
+    cfg: RtqConfig,
+) -> RtqState:
+    from sentinel_tpu.ops import window as W
+
+    wid = W._wid(now_ms, cfg.wcfg)
+    idx = wid % cfg.sample_count
+    stale = state.epochs[idx] != wid
+
+    def reset(s):
+        return RtqState(counts=s.counts.at[idx].set(0), epochs=s.epochs.at[idx].set(wid))
+
+    state = jax.lax.cond(stale, reset, lambda s: s, state)
+    bins = bin_of(rt_ms, cfg)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, BINS), 1)
+    onehot = ((bins[:, None] == iota) & valid[:, None]).astype(jnp.bfloat16)
+    hist = jax.lax.dot_general(
+        onehot,
+        jnp.ones((rt_ms.shape[0], 1), jnp.bfloat16),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0].astype(jnp.int32)
+    return state._replace(counts=state.counts.at[idx].add(hist))
+
+
+def windowed_counts(state: RtqState, now_ms, cfg: RtqConfig) -> jax.Array:
+    from sentinel_tpu.ops import window as W
+
+    wid = W._wid(now_ms, cfg.wcfg)
+    valid = (state.epochs > wid - cfg.sample_count) & (state.epochs <= wid)
+    return jnp.sum(state.counts * valid[:, None], axis=0)  # [BINS]
+
+
+def quantiles(
+    counts: np.ndarray, qs: Sequence[float], cfg: RtqConfig
+) -> dict:
+    """Host-side readout: {q: upper-edge RT of the bin reaching q}."""
+    total = int(counts.sum())
+    out = {}
+    if total == 0:
+        return {q: 0.0 for q in qs}
+    cum = np.cumsum(counts)
+    for q in qs:
+        b = int(np.searchsorted(cum, q * total))
+        out[q] = round(bin_upper_edge(min(b, BINS - 1), cfg), 3)
+    return out

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import gsketch as GS
+from sentinel_tpu.ops import rtq as RQ
 from sentinel_tpu.ops import window as W
 
 
@@ -74,6 +75,7 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
             else rep,
             epochs=rep,
         ),
+        rtq=RQ.RtqState(counts=rep, epochs=rep),
     )
 
 

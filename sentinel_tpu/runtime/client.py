@@ -719,6 +719,17 @@ class SentinelClient:
         except TypeError:
             pass  # unhashable param value — not trackable
 
+    def rt_quantiles(self, qs=(0.5, 0.9, 0.99)) -> Dict[float, float]:
+        """Service-level inbound RT quantiles over the trailing window
+        (ops/rtq.py log-bucket histogram; ~11% bucket resolution)."""
+        from sentinel_tpu.ops import rtq as RQ
+
+        rcfg = E.rtq_config(self.cfg)
+        now = jnp.int32(self.time.now_ms())
+        with self._engine_lock:
+            counts = np.asarray(RQ.windowed_counts(self._state.rtq, now, rcfg))
+        return RQ.quantiles(counts, qs, rcfg)
+
     def top_params(self, resource: str, n: int = 16) -> list:
         """[(value, sightings)] — the hottest parameter values seen."""
         with self._hot_params_lock:

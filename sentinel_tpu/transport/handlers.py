@@ -178,6 +178,15 @@ class DefaultHandlerGroup:
             root["children"].append(node)
         return CommandResponse.of_success(root)
 
+    @command_mapping("rtQuantiles", "inbound RT quantiles (p50/p90/p99)")
+    def rt_quantiles(self, req: CommandRequest) -> CommandResponse:
+        qs = [float(x) for x in (req.param("q") or "0.5,0.9,0.99").split(",")]
+        out = self.client.rt_quantiles(tuple(qs))
+        # keys match the advertised percent form: p50 / p90 / p99 / p99.9
+        return CommandResponse.of_success(
+            {f"p{round(q * 100, 3):g}": v for q, v in out.items()}
+        )
+
     @command_mapping("systemStatus", "system adaptive-protection inputs")
     def system_status(self, req: CommandRequest) -> CommandResponse:
         load, cpu = self.client._sys.sample()

@@ -1,0 +1,60 @@
+"""RT quantile histogram (ops/rtq.py): log-bucket accuracy vs numpy
+percentiles, window expiry, and the client/command read path."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import sentinel_tpu as st
+from sentinel_tpu.ops import rtq as RQ
+
+
+def test_bins_monotone_and_bounded():
+    cfg = RQ.RtqConfig(2, 500, 5000.0)
+    rts = jnp.asarray([0.0, 1.0, 10.0, 100.0, 1000.0, 5000.0, 99999.0])
+    bins = np.asarray(RQ.bin_of(rts, cfg))
+    assert list(bins) == sorted(bins)
+    assert bins[-1] == RQ.BINS - 1
+    # every bin's upper edge exceeds its lower edge by <= ~12%+1ms
+    for b in range(RQ.BINS - 1):
+        lo, hi = RQ.bin_upper_edge(b - 1, cfg), RQ.bin_upper_edge(b, cfg)
+        assert hi > lo
+
+
+def test_quantiles_close_to_numpy():
+    cfg = RQ.RtqConfig(2, 500, 5000.0)
+    s = RQ.init_rtq(cfg)
+    rng = np.random.default_rng(0)
+    rts = rng.lognormal(mean=3.0, sigma=1.0, size=4000).astype(np.float32)
+    s = RQ.add(s, jnp.int32(100), jnp.asarray(rts), jnp.ones(4000, bool), cfg)
+    counts = np.asarray(RQ.windowed_counts(s, jnp.int32(200), cfg))
+    assert counts.sum() == 4000
+    est = RQ.quantiles(counts, (0.5, 0.9, 0.99), cfg)
+    for q in (0.5, 0.9, 0.99):
+        true = float(np.percentile(rts, q * 100))
+        assert true * 0.85 <= est[q] <= true * 1.3, (q, est[q], true)
+
+
+def test_window_expiry():
+    cfg = RQ.RtqConfig(2, 500, 5000.0)
+    s = RQ.init_rtq(cfg)
+    s = RQ.add(s, jnp.int32(0), jnp.asarray([50.0]), jnp.asarray([True]), cfg)
+    assert np.asarray(RQ.windowed_counts(s, jnp.int32(400), cfg)).sum() == 1
+    assert np.asarray(RQ.windowed_counts(s, jnp.int32(2000), cfg)).sum() == 0
+
+
+def test_client_rt_quantiles_and_command(client, vt):
+    from sentinel_tpu.transport import build_default_handlers
+    from sentinel_tpu.transport.command import CommandRequest
+
+    client.flow_rules.load([st.FlowRule(resource="svc", count=1000)])
+    for rt in (5, 10, 20, 40, 400):
+        with client.entry("svc", inbound=True):
+            vt.advance(rt)
+    q = client.rt_quantiles((0.5, 0.99))
+    assert 15 <= q[0.5] <= 30  # median around 20ms
+    assert 300 <= q[0.99] <= 600
+    reg = build_default_handlers(client)
+    out = reg.handle("rtQuantiles", CommandRequest(parameters={"q": "0.5"}))
+    assert out.success and "p50" in out.result
