@@ -139,6 +139,18 @@ class DashboardServer:
                 params.setdefault(k, v[-1])
             body = ""
         route = (method, parsed.path.rstrip("/") or "/")
+        if route == ("GET", "/"):
+            # the static UI page (dashboard/ui.py) — no data inside, so it
+            # is served without auth; its fetches carry the bearer token
+            from sentinel_tpu.dashboard.ui import PAGE
+
+            payload = PAGE.encode("utf-8")
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/html; charset=utf-8")
+            handler.send_header("Content-Length", str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+            return
         fn = self._routes().get(route)
         try:
             import hmac

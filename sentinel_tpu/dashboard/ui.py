@@ -1,0 +1,138 @@
+"""Minimal dashboard web UI — a single static page over the JSON API.
+
+The reference ships an AngularJS 1.x SPA with ECharts; this is the same
+idea at minimum viable scale with zero dependencies (vanilla JS + canvas):
+machine discovery table, per-app top resources, live QPS chart polling
+/metric once a second, and rule listings via the machine round-trip.
+Served by DashboardServer at GET /.
+"""
+
+PAGE = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>sentinel-tpu dashboard</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 1.5rem; color: #222; }
+  h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+  table { border-collapse: collapse; margin: .5rem 0; }
+  td, th { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem; }
+  th { background: #f3f3f3; text-align: left; }
+  .muted { color: #888; } .ok { color: #0a0 ; } .bad { color: #c00; }
+  canvas { border: 1px solid #ddd; margin-top: .5rem; }
+  select, input, button { font-size: .9rem; margin-right: .5rem; }
+  #err { color: #c00; font-size: .85rem; }
+</style>
+</head>
+<body>
+<h1>sentinel-tpu dashboard</h1>
+<div>
+  <label>app <select id="app"></select></label>
+  <label>resource <select id="res"></select></label>
+  <input id="token" placeholder="auth token (if set)" size="18">
+  <span id="err"></span>
+</div>
+
+<h2>machines</h2>
+<table id="machines"><tr><th>app</th><th>ip:port</th><th>hostname</th><th>pid</th><th>health</th></tr></table>
+
+<h2>qps <span class="muted" id="resname"></span></h2>
+<canvas id="chart" width="860" height="220"></canvas>
+<div class="muted">green: pass/s &nbsp; red: block/s &nbsp; (trailing 5 min, 1 s points)</div>
+
+<h2>flow rules <span class="muted">(first healthy machine)</span></h2>
+<table id="rules"><tr><th>resource</th><th>count</th><th>grade</th><th>behavior</th><th>limitApp</th></tr></table>
+
+<script>
+const $ = id => document.getElementById(id);
+const hdrs = () => $("token").value ? {"Authorization": "Bearer " + $("token").value} : {};
+async function j(url) {
+  const r = await fetch(url, {headers: hdrs()});
+  if (!r.ok) throw new Error(url + " -> " + r.status);
+  return r.json();
+}
+let apps = {}, series = [];
+
+async function refreshApps() {
+  apps = await j("/apps");
+  const sel = $("app"), cur = sel.value;
+  sel.innerHTML = "";
+  Object.keys(apps).forEach(a => sel.add(new Option(a, a)));
+  if (cur && apps[cur] !== undefined) sel.value = cur;
+  const t = $("machines");
+  t.innerHTML = "<tr><th>app</th><th>ip:port</th><th>hostname</th><th>pid</th><th>health</th></tr>";
+  for (const [app, ms] of Object.entries(apps)) for (const m of ms) {
+    const row = t.insertRow();
+    row.innerHTML = `<td>${app}</td><td>${m.ip}:${m.port}</td><td>${m.hostname}</td>` +
+      `<td>${m.pid}</td><td class="${m.healthy ? "ok" : "bad"}">${m.healthy ? "healthy" : "stale"}</td>`;
+  }
+}
+
+async function refreshResources() {
+  const app = $("app").value;
+  if (!app) return;
+  const top = await j(`/metric/top?app=${encodeURIComponent(app)}`);
+  const sel = $("res"), cur = sel.value;
+  sel.innerHTML = "";
+  top.forEach(r => sel.add(new Option(r, r)));
+  if (cur && top.includes(cur)) sel.value = cur;
+}
+
+async function refreshChart() {
+  const app = $("app").value, res = $("res").value;
+  if (!app || !res) return;
+  const since = Date.now() - 5 * 60 * 1000;
+  series = await j(`/metric?app=${encodeURIComponent(app)}&identity=${encodeURIComponent(res)}&startTime=${since}`);
+  $("resname").textContent = res;
+  const c = $("chart"), ctx = c.getContext("2d");
+  ctx.clearRect(0, 0, c.width, c.height);
+  if (!series.length) return;
+  const t0 = since, t1 = Date.now();
+  const ymax = Math.max(5, ...series.map(p => Math.max(p.pass_qps, p.block_qps))) * 1.15;
+  const X = ts => (ts - t0) / (t1 - t0) * (c.width - 40) + 35;
+  const Y = v  => c.height - 18 - v / ymax * (c.height - 30);
+  ctx.strokeStyle = "#ddd"; ctx.fillStyle = "#888"; ctx.font = "11px sans-serif";
+  for (let i = 0; i <= 4; i++) {
+    const v = ymax / 4 * i, y = Y(v);
+    ctx.beginPath(); ctx.moveTo(35, y); ctx.lineTo(c.width - 5, y); ctx.stroke();
+    ctx.fillText(v.toFixed(0), 2, y + 4);
+  }
+  const line = (key, color) => {
+    ctx.strokeStyle = color; ctx.lineWidth = 1.5; ctx.beginPath();
+    series.forEach((p, i) => i ? ctx.lineTo(X(p.timestamp), Y(p[key]))
+                               : ctx.moveTo(X(p.timestamp), Y(p[key])));
+    ctx.stroke();
+  };
+  line("pass_qps", "#2a2");
+  line("block_qps", "#c33");
+}
+
+async function refreshRules() {
+  const app = $("app").value;
+  const m = (apps[app] || []).find(m => m.healthy);
+  const t = $("rules");
+  t.innerHTML = "<tr><th>resource</th><th>count</th><th>grade</th><th>behavior</th><th>limitApp</th></tr>";
+  if (!m) return;
+  const rules = await j(`/rules?ip=${m.ip}&port=${m.port}&type=flow`);
+  for (const r of rules) {
+    const row = t.insertRow();
+    row.innerHTML = `<td>${r.resource}</td><td>${r.count}</td>` +
+      `<td>${r.grade == 1 ? "QPS" : "THREAD"}</td><td>${r.controlBehavior ?? r.control_behavior ?? 0}</td><td>${r.limitApp ?? r.limit_app ?? "default"}</td>`;
+  }
+}
+
+async function tick() {
+  try {
+    await refreshApps();
+    await refreshResources();
+    await refreshChart();
+    await refreshRules();
+    $("err").textContent = "";
+  } catch (e) { $("err").textContent = String(e); }
+}
+tick();
+setInterval(tick, 1000);
+</script>
+</body>
+</html>
+"""

@@ -92,6 +92,22 @@ def test_fetcher_catchup_window():
     assert start2 == (now - 5000) + 1000
 
 
+def test_dashboard_serves_ui_page():
+    dash = DashboardServer(host="127.0.0.1", port=0, fetch_metrics=False,
+                           auth_token="tok")
+    dash.start()
+    try:
+        rsp = urllib.request.urlopen(f"http://127.0.0.1:{dash.port}/", timeout=3)
+        body = rsp.read().decode()
+        assert rsp.headers["Content-Type"].startswith("text/html")
+        # the static page is reachable without the token; its data fetches
+        # (e.g. /apps) still require it
+        for frag in ("sentinel-tpu dashboard", 'id="chart"', "/metric/top"):
+            assert frag in body
+    finally:
+        dash.stop()
+
+
 def test_dashboard_auth_token():
     """Operator routes require the bearer token; heartbeats stay open."""
     import urllib.error
