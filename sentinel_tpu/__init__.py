@@ -77,6 +77,7 @@ from sentinel_tpu.core.api import (
     reset,
     trace,
     entry_async,
+    register_init_func,
     try_entry,
 )
 
@@ -109,6 +110,7 @@ __all__ = [
     "context",
     "entry",
     "entry_async",
+    "register_init_func",
     "get_client",
     "init",
     "load_authority_rules",

@@ -13,20 +13,38 @@ from sentinel_tpu.core import rules as R
 
 _client = None
 _client_lock = threading.Lock()
+_init_funcs: list = []
+
+
+def register_init_func(fn, order: int = 0):
+    """Register a one-time init callback run when the process-wide client
+    first starts, ordered ascending — the InitFunc SPI + @InitOrder analog
+    (init/InitExecutor.java:41-64).  Receives the SentinelClient."""
+    _init_funcs.append((order, len(_init_funcs), fn))
 
 
 def init(**kwargs):
     """Create (or return) the process-wide SentinelClient.
 
-    Analog of Env.java:31-38 — the singleton CtSph + one-time init.
+    Analog of Env.java:31-38 — the singleton CtSph + one-time init
+    (InitExecutor.doInit running the registered InitFuncs exactly once).
     """
     global _client
     with _client_lock:
         if _client is None:
             from sentinel_tpu.runtime.client import SentinelClient
 
-            _client = SentinelClient(**kwargs)
-            _client.start()
+            c = SentinelClient(**kwargs)
+            c.start()
+            try:
+                for _, _, fn in sorted(_init_funcs):
+                    fn(c)
+            except Exception:
+                # a failing init func must not leave a half-initialized
+                # singleton behind: tear down and let the caller retry
+                c.stop()
+                raise
+            _client = c
         return _client
 
 

@@ -45,6 +45,10 @@ PAGE = r"""<!doctype html>
 
 <script>
 const $ = id => document.getElementById(id);
+// every server-sourced string goes through esc(): machine fields arrive via
+// the UNAUTHENTICATED heartbeat endpoint and must never reach innerHTML raw
+const esc = s => String(s).replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const hdrs = () => $("token").value ? {"Authorization": "Bearer " + $("token").value} : {};
 async function j(url) {
   const r = await fetch(url, {headers: hdrs()});
@@ -63,8 +67,9 @@ async function refreshApps() {
   t.innerHTML = "<tr><th>app</th><th>ip:port</th><th>hostname</th><th>pid</th><th>health</th></tr>";
   for (const [app, ms] of Object.entries(apps)) for (const m of ms) {
     const row = t.insertRow();
-    row.innerHTML = `<td>${app}</td><td>${m.ip}:${m.port}</td><td>${m.hostname}</td>` +
-      `<td>${m.pid}</td><td class="${m.healthy ? "ok" : "bad"}">${m.healthy ? "healthy" : "stale"}</td>`;
+    row.innerHTML = `<td>${esc(app)}</td><td>${esc(m.ip)}:${esc(m.port)}</td>` +
+      `<td>${esc(m.hostname)}</td><td>${esc(m.pid)}</td>` +
+      `<td class="${m.healthy ? "ok" : "bad"}">${m.healthy ? "healthy" : "stale"}</td>`;
   }
 }
 
@@ -116,8 +121,9 @@ async function refreshRules() {
   const rules = await j(`/rules?ip=${m.ip}&port=${m.port}&type=flow`);
   for (const r of rules) {
     const row = t.insertRow();
-    row.innerHTML = `<td>${r.resource}</td><td>${r.count}</td>` +
-      `<td>${r.grade == 1 ? "QPS" : "THREAD"}</td><td>${r.controlBehavior ?? r.control_behavior ?? 0}</td><td>${r.limitApp ?? r.limit_app ?? "default"}</td>`;
+    row.innerHTML = `<td>${esc(r.resource)}</td><td>${esc(r.count)}</td>` +
+      `<td>${r.grade == 1 ? "QPS" : "THREAD"}</td>` +
+      `<td>${esc(r.controlBehavior ?? 0)}</td><td>${esc(r.limitApp ?? "default")}</td>`;
   }
 }
 
@@ -129,9 +135,11 @@ async function tick() {
     await refreshRules();
     $("err").textContent = "";
   } catch (e) { $("err").textContent = String(e); }
+  // self-rescheduling chain: a slow machine round-trip must not pile up
+  // overlapping ticks racing each other's DOM rewrites
+  setTimeout(tick, 1000);
 }
 tick();
-setInterval(tick, 1000);
 </script>
 </body>
 </html>

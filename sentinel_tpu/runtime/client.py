@@ -223,6 +223,12 @@ class SentinelClient:
         # when off, every entry is a pass-through and nothing is counted
         self.enabled = True
 
+        # custom entry hooks — the custom-ProcessorSlot SPI analog
+        # (sentinel-demo-slot-chain-spi): each hook sees (resource, origin,
+        # args) before the engine check and may raise a BlockException to
+        # reject; exit-side extension points are the metrics SPI
+        self.entry_hooks: List[Any] = []
+
         self.registry = Registry(self.cfg)
         self.flow_rules = RuleManager(self, "flow")
         self.degrade_rules = RuleManager(self, "degrade")
@@ -584,6 +590,17 @@ class SentinelClient:
             return e
         ctx_name, ctx_origin = _ctx if _ctx is not None else CTX.current()
         origin = origin if origin is not None else ctx_origin
+        # custom-slot hooks: a raised BlockException is carried as a
+        # pre-verdict so the ENGINE records the block (stats + block log +
+        # SPI, like a custom ProcessorSlot's exception flowing through
+        # StatisticSlot) and the ORIGINAL exception is rethrown at the end
+        hook_exc: Optional[ERR.BlockException] = None
+        for hook in self.entry_hooks:
+            try:
+                hook(resource, origin, args)
+            except ERR.BlockException as he:
+                hook_exc = he
+                break
         rid = self.registry.resource_id(resource)
         if rid is None:
             e = _PassThroughEntry(self, resource)
@@ -618,7 +635,10 @@ class SentinelClient:
                 self._note_hot_param(resource, param_value)
 
         pre_verdict, cluster_wait = 0, 0
-        if self._cluster_flow_by_res or self._cluster_param_by_res:
+        if hook_exc is not None:
+            code = getattr(hook_exc, "code", 0)
+            pre_verdict = code if code > 0 else ERR.BLOCK_FLOW
+        elif self._cluster_flow_by_res or self._cluster_param_by_res:
             pre_verdict, cluster_wait = self._cluster_check(
                 resource, count, prioritized, param_value
             )
@@ -649,7 +669,11 @@ class SentinelClient:
         if verdict not in (ERR.PASS, ERR.PASS_WAIT):
             # the engine already counted the block; here only the
             # observability side-channels fire (block log + extension SPI)
-            exc = ERR.exception_for_verdict(verdict, resource)
+            exc = (
+                hook_exc
+                if hook_exc is not None
+                else ERR.exception_for_verdict(verdict, resource)
+            )
             if self.block_log is not None:
                 self.block_log.log(
                     self.time.wall_ms(), resource, type(exc).__name__, origin or "", count

@@ -137,3 +137,40 @@ def test_hot_param_cap_decimates(client, vt):
     top = client.top_params("cap", 1)
     assert top[0][0] == "hot"  # survivors are the hottest
     assert len(client._hot_params["cap"]) <= cap
+
+
+def test_custom_entry_hook_and_init_funcs(client, vt):
+    """Custom-slot SPI analog: an entry hook can reject; InitFunc analog:
+    registered callbacks run once at api.init in order."""
+    calls = []
+
+    def deny_vip(resource, origin, args):
+        calls.append(resource)
+        if resource == "forbidden":
+            raise st.BlockException("custom: forbidden")
+
+    client.entry_hooks.append(deny_vip)
+    with client.entry("ok-res"):
+        pass
+    with pytest.raises(st.BlockException):
+        client.entry("forbidden")
+    assert calls == ["ok-res", "forbidden"]
+    # hook-raised blocks flow through the engine's accounting (the custom
+    # slot's exception still passes StatisticSlot in the reference)
+    assert client.stats.resource("forbidden")["blockQps"] == 1
+
+    import sentinel_tpu.core.api as api
+
+    ran = []
+    api.reset()
+    api._init_funcs.clear()
+    st.register_init_func(lambda c: ran.append("b"), order=2)
+    st.register_init_func(lambda c: ran.append("a"), order=1)
+    c = api.init(cfg=client.cfg, time_source=client.time, mode="sync")
+    try:
+        assert ran == ["a", "b"]
+        api.init()  # second call: no re-run
+        assert ran == ["a", "b"]
+    finally:
+        api.reset()
+        api._init_funcs.clear()
