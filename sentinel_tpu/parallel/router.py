@@ -1,0 +1,68 @@
+"""Host-layer shard-by-resource routing — the multi-host story (SURVEY §2.9).
+
+A multi-host deployment splits the resource space across hosts: each host's
+engine owns the exact rows for its shard (decisions + stats stay local, no
+cross-host chatter on the hot path), and GLOBAL budgets ride the cluster
+token protocol exactly as in a single-host deployment.  This router is the
+host-layer piece: deterministic resource→shard assignment and a fan-out
+`check_batch` that groups a mixed batch per shard.
+
+Within one host, chips scale via the SPMD row sharding (parallel/spmd.py,
+ICI); ACROSS hosts, this router is the DCN-level partitioning.  Shards are
+any objects with the SentinelClient surface — in-process clients in tests,
+remote host stubs in a real deployment.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def shard_of(resource: str, n_shards: int) -> int:
+    """Deterministic, process-independent shard assignment (crc32 — the
+    same stability argument as the RLS flow-id derivation)."""
+    return zlib.crc32(resource.encode("utf-8")) % n_shards
+
+
+class ShardRouter:
+    def __init__(self, shards: Sequence[Any]):
+        assert shards, "at least one shard"
+        self.shards = list(shards)
+
+    def shard_for(self, resource: str):
+        return self.shards[shard_of(resource, len(self.shards))]
+
+    def entry(self, resource: str, **kw):
+        """Single entry routes to the owning shard (SphU.entry surface)."""
+        return self.shard_for(resource).entry(resource, **kw)
+
+    def check_batch(
+        self,
+        resources: Sequence[str],
+        counts: Optional[Sequence[int]] = None,
+        **kw,
+    ) -> List[Tuple[int, int]]:
+        """Mixed-shard bulk check: group per shard, one check_batch per
+        shard, results restored to input order."""
+        n = len(resources)
+        groups: Dict[int, List[int]] = {}
+        for i, r in enumerate(resources):
+            groups.setdefault(shard_of(r, len(self.shards)), []).append(i)
+        out: List[Optional[Tuple[int, int]]] = [None] * n
+        for s, idxs in groups.items():
+            sub = self.shards[s].check_batch(
+                [resources[i] for i in idxs],
+                counts=[counts[i] for i in idxs] if counts else None,
+                **kw,
+            )
+            for j, i in enumerate(idxs):
+                out[i] = sub[j]
+        return out  # type: ignore[return-value]
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Merged per-resource stats across shards (disjoint key spaces)."""
+        merged: Dict[str, Dict[str, float]] = {}
+        for s in self.shards:
+            merged.update(s.stats.snapshot())
+        return merged
