@@ -41,28 +41,72 @@ class ShardRouter:
         self,
         resources: Sequence[str],
         counts: Optional[Sequence[int]] = None,
+        origins: Optional[Sequence[str]] = None,
+        params: Optional[Sequence[Any]] = None,
+        prioritized: Optional[Sequence[bool]] = None,
         **kw,
     ) -> List[Tuple[int, int]]:
-        """Mixed-shard bulk check: group per shard, one check_batch per
-        shard, results restored to input order."""
+        """Mixed-shard bulk check: group per shard (EVERY per-item sequence
+        sliced with its group), shards consulted concurrently — one DCN
+        round-trip of latency, not one per shard — results restored to
+        input order."""
         n = len(resources)
         groups: Dict[int, List[int]] = {}
         for i, r in enumerate(resources):
             groups.setdefault(shard_of(r, len(self.shards)), []).append(i)
         out: List[Optional[Tuple[int, int]]] = [None] * n
-        for s, idxs in groups.items():
-            sub = self.shards[s].check_batch(
-                [resources[i] for i in idxs],
-                counts=[counts[i] for i in idxs] if counts else None,
+
+        def pick(seq, idxs):
+            return [seq[i] for i in idxs] if seq is not None else None
+
+        def run(s, idxs):
+            return self.shards[s].check_batch(
+                pick(resources, idxs),
+                counts=pick(counts, idxs),
+                origins=pick(origins, idxs),
+                params=pick(params, idxs),
+                prioritized=pick(prioritized, idxs),
                 **kw,
             )
+
+        if len(groups) == 1:
+            ((s, idxs),) = groups.items()
+            results = {s: run(s, idxs)}
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+                futures = {s: pool.submit(run, s, idxs) for s, idxs in groups.items()}
+                results = {s: f.result() for s, f in futures.items()}
+        for s, idxs in groups.items():
             for j, i in enumerate(idxs):
-                out[i] = sub[j]
+                out[i] = results[s][j]
         return out  # type: ignore[return-value]
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
-        """Merged per-resource stats across shards (disjoint key spaces)."""
+        """Merged per-resource stats across shards.  A resource can appear
+        on several hosts (cluster-mode traffic through load-balanced
+        ingress), so numeric fields are SUMMED, not overwritten; minRt
+        takes the min of observed values."""
         merged: Dict[str, Dict[str, float]] = {}
         for s in self.shards:
-            merged.update(s.stats.snapshot())
+            for name, stats in s.stats.snapshot().items():
+                prev = merged.get(name)
+                if prev is None:
+                    merged[name] = dict(stats)
+                    continue
+                for k, v in stats.items():
+                    if k == "minRt":
+                        nonzero = [x for x in (prev[k], v) if x > 0]
+                        prev[k] = min(nonzero) if nonzero else 0.0
+                    elif k == "avgRt":
+                        pass  # recomputed below from summed successes
+                    else:
+                        prev[k] = prev[k] + v
+                # weighted avgRt over summed successes
+                s_prev = prev["successQps"] - stats["successQps"]
+                if prev["successQps"] > 0:
+                    prev["avgRt"] = (
+                        prev["avgRt"] * s_prev + stats["avgRt"] * stats["successQps"]
+                    ) / prev["successQps"]
         return merged

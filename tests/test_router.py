@@ -42,6 +42,35 @@ def test_router_entry_and_batch(client_factory, vt):
     assert r0 in snap and r1 in snap
 
 
+def test_router_slices_per_item_sequences(client_factory, vt):
+    """origins/params/prioritized must be sliced with their shard group —
+    forwarding them unsliced applies item 0's param to every shard."""
+    hosts = [client_factory(), client_factory()]
+    router = ShardRouter(hosts)
+    r0 = next(f"p{i}" for i in range(100) if shard_of(f"p{i}", 2) == 0)
+    r1 = next(f"q{i}" for i in range(100) if shard_of(f"q{i}", 2) == 1)
+    for h, r in ((hosts[0], r0), (hosts[1], r1)):
+        h.param_flow_rules.load([st.ParamFlowRule(resource=r, count=1, param_idx=0)])
+    # one hot value per resource; the second hit of the SAME value blocks,
+    # a different value passes — alignment errors cross these up
+    res = [r0, r1, r0, r1]
+    par = ["u1", "u2", "u1", "zz"]
+    out = router.check_batch(res, params=par)
+    assert [v for v, _ in out] == [0, 0, 3, 0]  # only the repeated (r0,u1) blocks
+
+
+def test_router_snapshot_merges_shared_resources(client_factory, vt):
+    hosts = [client_factory(), client_factory()]
+    router = ShardRouter(hosts)
+    for h in hosts:
+        h.flow_rules.load([st.FlowRule(resource="both", count=100)])
+        for _ in range(3):
+            with h.entry("both"):
+                vt.advance(2)
+    snap = router.snapshot()
+    assert snap["both"]["passQps"] == 6  # summed, not overwritten
+
+
 def test_router_with_global_cluster_budget(client_factory, vt):
     """Both hosts defer a cluster-mode rule to ONE token service: the
     global cap holds across shards (cross-host budget via tokens, the
