@@ -19,21 +19,35 @@ DEFAULT_TIMEOUT_S = 3.0
 
 
 class SentinelApiClient:
-    def __init__(self, timeout_s: float = DEFAULT_TIMEOUT_S):
+    def __init__(
+        self, timeout_s: float = DEFAULT_TIMEOUT_S, auth_token: Optional[str] = None
+    ):
+        # auth_token is the MACHINE-side command-plane bearer token — sent
+        # on every request so machines running SimpleHttpCommandCenter with
+        # auth enabled still accept dashboard pulls and rule pushes
         self.timeout_s = timeout_s
+        self.auth_token = auth_token
 
     # -- raw --------------------------------------------------------------
+
+    def _headers(self) -> dict:
+        from sentinel_tpu.utils.authn import bearer_header
+
+        return bearer_header(self.auth_token)
 
     def _get(self, ip: str, port: int, command: str, **params) -> str:
         qs = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
         url = f"http://{ip}:{port}/{command}" + (f"?{qs}" if qs else "")
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as rsp:
+        req = urllib.request.Request(url, headers=self._headers())
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as rsp:
             return rsp.read().decode("utf-8")
 
     def _post(self, ip: str, port: int, command: str, **params) -> str:
         url = f"http://{ip}:{port}/{command}"
         body = urllib.parse.urlencode(params).encode("ascii")
-        req = urllib.request.Request(url, data=body, method="POST")
+        req = urllib.request.Request(
+            url, data=body, method="POST", headers=self._headers()
+        )
         with urllib.request.urlopen(req, timeout=self.timeout_s) as rsp:
             return rsp.read().decode("utf-8")
 

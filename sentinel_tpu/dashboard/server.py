@@ -52,24 +52,32 @@ class DynamicRulePublisher:
 class DashboardServer:
     def __init__(
         self,
-        host: str = "0.0.0.0",
+        host: Optional[str] = None,
         port: int = 8080,
         fetch_metrics: bool = True,
         rule_provider: Optional[DynamicRuleProvider] = None,
         rule_publisher: Optional[DynamicRulePublisher] = None,
         auth_token: Optional[str] = None,
+        machine_token: Optional[str] = None,
     ):
-        # auth_token gates every operator route with a bearer token (the
-        # AuthController/login-filter analog); machine heartbeats stay open
-        # like the reference's excluded /registry endpoints
-        self.auth_token = auth_token
+        from sentinel_tpu.utils.authn import default_bind_host, normalize_token
+
+        # auth_token gates every route — including /registry/machine — with
+        # a bearer token (the AuthController/login-filter analog).  The
+        # reference leaves registry open, but an open registry feeds the
+        # proxy-target allowlist and the metric fetcher, so when auth is on,
+        # heartbeats must carry the token too (HeartbeatSender auth_token=).
+        # machine_token is what THIS server sends to each machine's command
+        # plane (SimpleHttpCommandCenter auth_token=) on proxy/metric calls.
+        self.auth_token = normalize_token(auth_token)
         self.discovery = AppManagement()
         self.repository = InMemoryMetricsRepository()
-        self.api = SentinelApiClient()
+        self.api = SentinelApiClient(auth_token=machine_token)
         self.fetcher = MetricFetcher(self.discovery, self.repository, self.api)
         self.rule_provider = rule_provider
         self.rule_publisher = rule_publisher
-        self.host = host
+        # default bind is loopback; a wider bind is explicit opt-in
+        self.host = default_bind_host(host)
         self.requested_port = port
         self.port: Optional[int] = None
         self._fetch_metrics = fetch_metrics
@@ -153,26 +161,29 @@ class DashboardServer:
             return
         fn = self._routes().get(route)
         try:
-            import hmac
+            from sentinel_tpu.utils.authn import check_bearer
 
-            if (
-                self.auth_token is not None
-                and route != ("POST", "/registry/machine")
-                and not hmac.compare_digest(
-                    # bytes, not str: compare_digest(str) demands ASCII and
-                    # would raise on an arbitrary client-supplied header
-                    (handler.headers.get("Authorization") or "").encode(
-                        "utf-8", "surrogateescape"
-                    ),
-                    f"Bearer {self.auth_token}".encode("utf-8"),
-                )
+            if not check_bearer(
+                handler.headers.get("Authorization"), self.auth_token
             ):
                 code, result = 401, {"error": "unauthorized"}
+            elif route == ("POST", "/registry/machine") and not handler.headers.get(
+                "X-Sentinel-Heartbeat"
+            ):
+                # custom-header requirement = CSRF guard: registrations feed
+                # the proxy allowlist and the metric fetcher, and a cross-
+                # site form POST (which can reach a loopback bind from the
+                # operator's browser) cannot carry a custom header
+                code, result = 403, {"error": "missing X-Sentinel-Heartbeat"}
             elif fn is None:
                 code, result = 404, {"error": f"no route {route[0]} {route[1]}"}
             else:
                 code, result = fn(params, body)
-        except (OSError, ValueError, KeyError) as e:
+        except ValueError as e:
+            # parameter validation (missing/unknown machine, bad values) is
+            # a client error, not a server fault
+            code, result = 400, {"error": str(e)}
+        except (OSError, KeyError) as e:
             code, result = 500, {"error": f"{type(e).__name__}: {e}"}
         payload = json.dumps(result).encode("utf-8")
         handler.send_response(code)
@@ -250,7 +261,18 @@ class DashboardServer:
         ip, port = params.get("ip"), params.get("port")
         if not (ip and port):
             raise ValueError("ip and port are required")
-        return ip, int(port)
+        port = int(port)
+        # proxy routes (/rules, /tree, /cluster/mode) cause server-side HTTP
+        # requests to ip:port — only allow targets that actually registered
+        # via heartbeat, so the dashboard can't be used as an SSRF relay
+        known = {
+            (m.ip, m.port)
+            for app in self.discovery.apps()
+            for m in self.discovery.machines(app)
+        }
+        if (ip, port) not in known:
+            raise ValueError(f"unknown machine {ip}:{port} (not in discovery)")
+        return ip, port
 
     def _get_rules(self, params, body):
         type_ = params.get("type", "flow")
@@ -275,7 +297,7 @@ class DashboardServer:
         # the one machine given by ip/port (reference round-trip semantics)
         targets = []
         if params.get("ip") and params.get("port"):
-            targets = [(params["ip"], int(params["port"]))]
+            targets = [self._machine_of(params)]
         elif app:
             targets = [(m.ip, m.port) for m in self.discovery.machines(app, only_healthy=True)]
         if not targets:

@@ -1,14 +1,17 @@
 """Build + load the native host library.
 
 Compiled lazily with g++ into the package directory (falls back to a
-temp dir when the package is read-only); cached by source mtime.  When no
-toolchain is available, ``load_native()`` returns None and callers use
-the pure-Python fallbacks.
+temp dir when the package is read-only); the artifact name embeds a hash
+of the source, so a stale or foreign binary is never loaded — only a
+.so produced from the exact sentinel_host.cpp present on disk.  Binaries
+are never committed to version control.  When no toolchain is available,
+``load_native()`` returns None and callers use the pure-Python fallbacks.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -21,10 +24,16 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
+def _src_digest() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
 def _so_path() -> str:
+    name = f"_sentinel_host-{_src_digest()}.so"
     base = os.path.dirname(__file__)
     if os.access(base, os.W_OK):
-        return os.path.join(base, "_sentinel_host.so")
+        return os.path.join(base, name)
     # never a shared world-writable path: a pre-planted .so there would be
     # loaded into this process — use a per-user 0700 cache dir and refuse
     # anything not owned by us
@@ -35,13 +44,17 @@ def _so_path() -> str:
     st = os.stat(d)
     if st.st_uid != os.getuid() or (st.st_mode & 0o022):
         d = tempfile.mkdtemp(prefix="sentinel_tpu_native_")
-    return os.path.join(d, "_sentinel_host.so")
+    return os.path.join(d, name)
 
 
 def _build(so: str) -> bool:
+    # compile to a temp name, rename into place: a g++ killed mid-write
+    # must never leave a truncated artifact at the final (hash-named,
+    # existence-is-freshness) path
+    tmp = f"{so}.tmp.{os.getpid()}"
     try:
         r = subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, _SRC],
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
             capture_output=True,
             text=True,
             timeout=120,
@@ -51,9 +64,29 @@ def _build(so: str) -> bool:
 
             record_log().warning("native build failed: %s", r.stderr[-2000:])
             return False
+        os.replace(tmp, so)
+        # reap binaries from superseded source revisions (and the legacy
+        # unhashed name from pre-hash checkouts)
+        d = os.path.dirname(so)
+        for name in os.listdir(d):
+            stale = name == "_sentinel_host.so" or (
+                name.startswith("_sentinel_host-")
+                and name.endswith(".so")
+                and os.path.join(d, name) != so
+            )
+            if stale:
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
         return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -89,8 +122,9 @@ def load_native() -> Optional[ctypes.CDLL]:
             return _LIB
         _TRIED = True
         so = _so_path()
-        fresh = os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC)
-        if not fresh and not _build(so):
+        # the hash in the filename ties the binary to this exact source —
+        # existence is sufficient freshness
+        if not os.path.exists(so) and not _build(so):
             return None
         try:
             _LIB = _bind(ctypes.CDLL(so))

@@ -898,6 +898,13 @@ class SentinelClient:
             with self._lock:
                 acq = self._acquires[: self.cfg.batch_size]
                 self._acquires = self._acquires[self.cfg.batch_size :]
+            # Overflow entries spilled when the ring was FULL, so they
+            # postdate everything that was in the ring at spill time; the
+            # ring must drain first.  Consuming spill only when the ring
+            # drains short (= empty) keeps spill after all pre-spill ring
+            # entries; it can land after post-spill pushes, a bounded
+            # delay in the "processed late" direction only — never a jump
+            # ahead — which circuit-breaker probe resolution tolerates.
             comp = self._comp_ring.drain(self.cfg.complete_batch_size)
             n_comp = len(comp[0])
             if n_comp < self.cfg.complete_batch_size and self._comp_overflow:

@@ -22,12 +22,17 @@ def start_command_center(
     cluster=None,
     metric_searcher=None,
     writable_registry=None,
-    host: str = "0.0.0.0",
+    host=None,
     port: int = DEFAULT_PORT,
+    auth_token=None,
 ) -> SimpleHttpCommandCenter:
-    """Build the default handler set and serve it (CommandCenterInitFunc)."""
+    """Build the default handler set and serve it (CommandCenterInitFunc).
+
+    Binds loopback by default; pass ``host='0.0.0.0'`` (ideally with
+    ``auth_token``) to serve the dashboard across machines.
+    """
     registry = build_default_handlers(client, cluster, metric_searcher, writable_registry)
-    center = SimpleHttpCommandCenter(registry, host=host, port=port)
+    center = SimpleHttpCommandCenter(registry, host=host, port=port, auth_token=auth_token)
     center.start()
     return center
 

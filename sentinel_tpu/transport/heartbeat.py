@@ -35,13 +35,37 @@ class HeartbeatSender:
     def __init__(
         self,
         app_name: str,
-        command_port: int,
-        dashboard_addresses: List[str],
+        command_port: Optional[int] = None,
+        dashboard_addresses: List[str] = (),
         interval_s: float = DEFAULT_INTERVAL_S,
         ip: Optional[str] = None,
+        auth_token: Optional[str] = None,
+        center=None,
     ):
+        # auth_token is the DASHBOARD's bearer token: when the dashboard
+        # runs with auth, /registry/machine requires it too (an open
+        # registry would feed its proxy allowlist and metric fetcher).
+        # Passing center= (the SimpleHttpCommandCenter) derives both the
+        # port and the advertised ip: a loopback-bound center must
+        # advertise 127.0.0.1 — advertising the NIC ip would make the
+        # dashboard dial an address nothing listens on.
         self.app_name = app_name
+        if center is not None:
+            if command_port is None:
+                command_port = center.port
+                if command_port is None:
+                    raise ValueError("center is not started yet (center.port is None)")
+            if ip is None:
+                if center.host in ("127.0.0.1", "localhost", "::1"):
+                    ip = "127.0.0.1"
+                elif center.host not in ("", "0.0.0.0", "::"):
+                    # bound to one concrete NIC address: advertise exactly
+                    # that — _local_ip() could pick a different interface
+                    ip = center.host
+        if command_port is None:
+            raise ValueError("command_port or center is required")
         self.command_port = command_port
+        self.auth_token = auth_token
         self.addresses = [a.strip() for a in dashboard_addresses if a.strip()]
         self.interval_s = interval_s
         self.ip = ip or _local_ip()
@@ -87,8 +111,17 @@ class HeartbeatSender:
         addr = self.addresses[self._idx % len(self.addresses)]
         url = f"http://{addr}/registry/machine"
         try:
+            from sentinel_tpu.utils.authn import bearer_header
+
+            # the custom header doubles as CSRF proof: a cross-site form
+            # POST cannot set it, so a browser on the operator's machine
+            # can't forge registrations into a loopback-bound dashboard
+            headers = {"X-Sentinel-Heartbeat": "1", **bearer_header(self.auth_token)}
             req = urllib.request.Request(
-                url, data=params.encode("ascii"), method="POST"
+                url,
+                data=params.encode("ascii"),
+                method="POST",
+                headers=headers,
             )
             with urllib.request.urlopen(req, timeout=timeout_s) as rsp:
                 ok = 200 <= rsp.status < 300

@@ -26,6 +26,7 @@ MAX_PORT_PROBES = 100
 
 class _Handler(BaseHTTPRequestHandler):
     registry: CommandRegistry = None  # set by server factory
+    auth_token: Optional[str] = None  # set by server factory
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # route access logs to command log
@@ -34,6 +35,16 @@ class _Handler(BaseHTTPRequestHandler):
         command_center_log().info("%s - %s", self.address_string(), fmt % args)
 
     def _dispatch(self, body: str = "") -> None:
+        from sentinel_tpu.utils.authn import check_bearer
+
+        if not check_bearer(self.headers.get("Authorization"), self.auth_token):
+            payload = b"unauthorized"
+            self.send_response(401)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
         parsed = urllib.parse.urlparse(self.path)
         name = parsed.path.strip("/")
         params = {k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()}
@@ -70,9 +81,26 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class SimpleHttpCommandCenter:
-    def __init__(self, registry: CommandRegistry, host: str = "0.0.0.0", port: int = DEFAULT_PORT):
+    """Command-plane HTTP server.
+
+    ``host=None`` binds 127.0.0.1; serving other machines (mutating
+    commands: setRules, setSwitch, setClusterMode) requires an explicit
+    ``host='0.0.0.0'``, ideally with ``auth_token`` — when a token is set
+    every command requires ``Authorization: Bearer``.
+    """
+
+    def __init__(
+        self,
+        registry: CommandRegistry,
+        host: Optional[str] = None,
+        port: int = DEFAULT_PORT,
+        auth_token: Optional[str] = None,
+    ):
+        from sentinel_tpu.utils.authn import default_bind_host, normalize_token
+
         self.registry = registry
-        self.host = host
+        self.auth_token = normalize_token(auth_token)
+        self.host = default_bind_host(host)
         self.requested_port = port
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
@@ -81,7 +109,11 @@ class SimpleHttpCommandCenter:
     def start(self) -> None:
         if self._server is not None:
             return
-        handler = type("BoundHandler", (_Handler,), {"registry": self.registry})
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"registry": self.registry, "auth_token": self.auth_token},
+        )
         last_err = None
         for probe in range(MAX_PORT_PROBES):
             try:

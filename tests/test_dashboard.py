@@ -109,7 +109,9 @@ def test_dashboard_serves_ui_page():
 
 
 def test_dashboard_auth_token():
-    """Operator routes require the bearer token; heartbeats stay open."""
+    """With auth on, EVERY route — including /registry/machine — needs the
+    token: an open registry would feed the proxy-target allowlist and the
+    metric fetcher (SSRF via fake machine registration)."""
     import urllib.error
 
     dash = DashboardServer(host="127.0.0.1", port=0, fetch_metrics=False,
@@ -124,13 +126,38 @@ def test_dashboard_auth_token():
             f"{base}/apps", headers={"Authorization": "Bearer s3cret"}
         )
         assert json.load(urllib.request.urlopen(req, timeout=3)) == {}
-        # heartbeat registration is exempt (machines don't hold the token)
+        hb_body = urllib.parse.urlencode(
+            {"app": "a", "ip": "1.1.1.1", "port": "8719"}
+        ).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/registry/machine", data=hb_body, method="POST"
+                ),
+                timeout=3,
+            )
+        assert ei.value.code == 401
+        # a forged form-POST can't set the CSRF header even with the token
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/registry/machine",
+                    data=hb_body,
+                    method="POST",
+                    headers={"Authorization": "Bearer s3cret"},
+                ),
+                timeout=3,
+            )
+        assert ei.value.code == 403
+        # machines carry the token + heartbeat header (HeartbeatSender)
         hb = urllib.request.Request(
             f"{base}/registry/machine",
-            data=urllib.parse.urlencode(
-                {"app": "a", "ip": "1.1.1.1", "port": "8719"}
-            ).encode(),
+            data=hb_body,
             method="POST",
+            headers={
+                "Authorization": "Bearer s3cret",
+                "X-Sentinel-Heartbeat": "1",
+            },
         )
         assert urllib.request.urlopen(hb, timeout=3).status == 200
     finally:
@@ -145,9 +172,12 @@ def live_stack(client):
     center = start_command_center(client, host="127.0.0.1", port=0)
     dash = DashboardServer(host="127.0.0.1", port=0, fetch_metrics=False)
     dash.start()
+    # center= wiring derives port AND the loopback advertised ip — a
+    # loopback-bound command center must never advertise the NIC ip
     hb = HeartbeatSender(
-        client.app_name, center.port, [f"127.0.0.1:{dash.port}"], ip="127.0.0.1"
+        client.app_name, dashboard_addresses=[f"127.0.0.1:{dash.port}"], center=center
     )
+    assert hb.ip == "127.0.0.1" and hb.command_port == center.port
     assert hb.send_once()
     yield client, center, dash
     dash.stop()
