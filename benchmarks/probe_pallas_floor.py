@@ -1,0 +1,186 @@
+"""Measure pallas per-call and per-step floors + plan variants on TPU."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = 131072
+    TB = 2048
+    nT = B // TB
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 16384, B, dtype=np.int32))
+    vals = jnp.asarray(rng.integers(0, 200, (B, 5), dtype=np.int32))
+
+    K = 96
+
+    def bench(name, fn):
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(0))
+        ts = []
+        for r in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jfn(r))
+            ts.append(time.perf_counter() - t0)
+        print(f"{name:46s} {min(ts)/K*1000:8.3f} ms")
+
+    def scan_wrap(body):
+        def fn(seed):
+            def step(c, i):
+                o = body(i + c)
+                return jnp.sum(o.astype(jnp.float32)).astype(jnp.int32) % 3, None
+            c, _ = jax.lax.scan(step, jnp.int32(seed), jnp.arange(K))
+            return c
+        return fn
+
+    # 1. trivial pallas copy kernel, 64 grid steps
+    def copy_call(x):
+        def kern(i_ref, o_ref):
+            o_ref[...] = i_ref[...] + 1
+
+        return pl.pallas_call(
+            kern,
+            grid=(nT,),
+            in_specs=[pl.BlockSpec((1, 1, TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, 1, TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((nT, 1, TB), jnp.int32),
+        )(x)
+
+    ids3 = ids.reshape(nT, 1, TB)
+    bench("copy kernel 64 steps", scan_wrap(lambda i: copy_call(ids3 + i)))
+
+    # 2. single-dot-per-step scatter (1 plane, 1 digit), n_lo variants
+    for n, n_lo in [(16392, 512), (16392, 128), (16384, 128), (16384, 512), (32777, 128)]:
+        n_hi = (n + n_lo - 1) // n_lo
+
+        def sc_call(idv, n=n, n_hi=n_hi, n_lo=n_lo):
+            def kern(i_ref, o_ref):
+                t = pl.program_id(0)
+
+                @pl.when(t == 0)
+                def _():
+                    o_ref[...] = jnp.zeros_like(o_ref)
+
+                k = i_ref[0, 0, :]
+                ok = (k >= 0) & (k < n)
+                safe = jnp.where(ok, k, 0)
+                hi = safe // n_lo
+                lo = safe - hi * n_lo
+                oki = ok.astype(jnp.int32)[:, None]
+                ih = jax.lax.broadcasted_iota(jnp.int32, (TB, n_hi), 1)
+                il = jax.lax.broadcasted_iota(jnp.int32, (TB, n_lo), 1)
+                Hi = ((hi[:, None] == ih) & (oki > 0)).astype(jnp.float32)
+                Lo = (lo[:, None] == il).astype(jnp.float32)
+                o_ref[...] += jax.lax.dot_general(
+                    Hi, Lo, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            return pl.pallas_call(
+                kern,
+                grid=(nT,),
+                in_specs=[pl.BlockSpec((1, 1, TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec((n_hi, n_lo), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct((n_hi, n_lo), jnp.float32),
+            )(idv)
+
+        bench(f"scatter 1dot n={n} n_lo={n_lo}", scan_wrap(lambda i, f=sc_call: f(ids3 + i)))
+
+    # 3. 5-plane 1-digit scatter with n_lo=128
+    n, n_lo = 16392, 128
+    n_hi = (n + n_lo - 1) // n_lo
+    vals3 = jnp.asarray(vals).reshape(nT, TB, 5).transpose(0, 2, 1)
+
+    def sc5_call(idv, vv):
+        def kern(i_ref, v_ref, o_ref):
+            t = pl.program_id(0)
+
+            @pl.when(t == 0)
+            def _():
+                o_ref[...] = jnp.zeros_like(o_ref)
+
+            k = i_ref[0, 0, :]
+            ok = (k >= 0) & (k < n)
+            safe = jnp.where(ok, k, 0)
+            hi = safe // n_lo
+            lo = safe - hi * n_lo
+            oki = ok.astype(jnp.int32)[:, None]
+            ih = jax.lax.broadcasted_iota(jnp.int32, (TB, n_hi), 1)
+            il = jax.lax.broadcasted_iota(jnp.int32, (TB, n_lo), 1)
+            Hi = ((hi[:, None] == ih) & (oki > 0)).astype(jnp.float32)
+            Lo = (lo[:, None] == il).astype(jnp.float32)
+            for p in range(5):
+                LoV = Lo * v_ref[0, p, :].astype(jnp.float32)[:, None]
+                o_ref[p] += jax.lax.dot_general(
+                    Hi, LoV, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+        return pl.pallas_call(
+            kern,
+            grid=(nT,),
+            in_specs=[
+                pl.BlockSpec((1, 1, TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 5, TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((5, n_hi, n_lo), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((5, n_hi, n_lo), jnp.float32),
+        )(idv, vv)
+
+    bench("scatter 5 planes 1 digit n_lo=128", scan_wrap(lambda i: sc5_call(ids3 + i, vals3)))
+
+    # 4. TB variants for the 1-dot scatter
+    for TBv in [4096, 8192]:
+        nTv = B // TBv
+        idsv = ids.reshape(nTv, 1, TBv)
+        n, n_lo = 16392, 128
+        n_hi = (n + n_lo - 1) // n_lo
+
+        def sc_call2(idv, TBv=TBv, nTv=nTv, n=n, n_hi=n_hi, n_lo=n_lo):
+            def kern(i_ref, o_ref):
+                t = pl.program_id(0)
+
+                @pl.when(t == 0)
+                def _():
+                    o_ref[...] = jnp.zeros_like(o_ref)
+
+                k = i_ref[0, 0, :]
+                ok = (k >= 0) & (k < n)
+                safe = jnp.where(ok, k, 0)
+                hi = safe // n_lo
+                lo = safe - hi * n_lo
+                oki = ok.astype(jnp.int32)[:, None]
+                ih = jax.lax.broadcasted_iota(jnp.int32, (TBv, n_hi), 1)
+                il = jax.lax.broadcasted_iota(jnp.int32, (TBv, n_lo), 1)
+                Hi = ((hi[:, None] == ih) & (oki > 0)).astype(jnp.float32)
+                Lo = (lo[:, None] == il).astype(jnp.float32)
+                o_ref[...] += jax.lax.dot_general(
+                    Hi, Lo, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            return pl.pallas_call(
+                kern,
+                grid=(nTv,),
+                in_specs=[pl.BlockSpec((1, 1, TBv), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec((n_hi, n_lo), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct((n_hi, n_lo), jnp.float32),
+            )(idv)
+
+        bench(f"scatter 1dot TB={TBv}", scan_wrap(lambda i, f=sc_call2, iv=idsv: f(iv + i)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Isolate the ~1.2 ms pallas cost: per-call vs per-step vs scan-related."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = 131072
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 16384, B, dtype=np.int32))
+
+    K = 96
+
+    def bench(name, fn):
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(0))
+        ts = []
+        for r in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jfn(r))
+            ts.append(time.perf_counter() - t0)
+        print(f"{name:46s} {min(ts)/K*1000:8.3f} ms")
+
+    def scan_wrap(body):
+        def fn(seed):
+            def step(c, i):
+                o = body(i + c)
+                return jnp.sum(o.astype(jnp.float32)).astype(jnp.int32) % 3, None
+            c, _ = jax.lax.scan(step, jnp.int32(seed), jnp.arange(K))
+            return c
+        return fn
+
+    def copy_call(x, nsteps, par=False):
+        TBv = B // nsteps
+        x3 = x.reshape(nsteps, 1, TBv)
+
+        def kern(i_ref, o_ref):
+            o_ref[...] = i_ref[...] + 1
+
+        cp = {}
+        if par:
+            cp = dict(
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("parallel",)
+                )
+            )
+        return pl.pallas_call(
+            kern,
+            grid=(nsteps,),
+            in_specs=[pl.BlockSpec((1, 1, TBv), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, 1, TBv), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((nsteps, 1, TBv), jnp.int32),
+            **cp,
+        )(x3)
+
+    # XLA baseline
+    bench("xla x+1 in scan", scan_wrap(lambda i: ids + i))
+    # pallas copy with 1, 4, 64 steps
+    bench("pallas copy 1 step", scan_wrap(lambda i: copy_call(ids + i, 1)))
+    bench("pallas copy 4 steps", scan_wrap(lambda i: copy_call(ids + i, 4)))
+    bench("pallas copy 64 steps", scan_wrap(lambda i: copy_call(ids + i, 64)))
+    bench("pallas copy 64 steps parallel", scan_wrap(lambda i: copy_call(ids + i, 64, par=True)))
+
+    # two pallas calls per scan step
+    bench(
+        "2x pallas copy 1 step",
+        scan_wrap(lambda i: copy_call(copy_call(ids + i, 1), 1)),
+    )
+
+    # pallas copy outside scan: pipelined dispatches
+    cp1 = jax.jit(lambda x: copy_call(x, 1))
+    y = cp1(ids)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    for r in range(K):
+        y = cp1(y)
+    jax.block_until_ready(y)
+    print(f"{'pallas copy pipelined dispatches':46s} {(time.perf_counter()-t0)/K*1000:8.3f} ms")
+
+    # XLA comparison outside scan
+    xp = jax.jit(lambda x: x + 1)
+    y = xp(ids)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    for r in range(K):
+        y = xp(y)
+    jax.block_until_ready(y)
+    print(f"{'xla x+1 pipelined dispatches':46s} {(time.perf_counter()-t0)/K*1000:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

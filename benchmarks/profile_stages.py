@@ -1,0 +1,166 @@
+"""Per-stage device times for the engine tick at bench shape (slope-timed)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.timing import device_time_ms, scan_op
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.config import EngineConfig
+    from sentinel_tpu.core.rules import FlowRule, DegradeRule, ParamFlowRule
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.ops import param as P
+    from sentinel_tpu.runtime.registry import Registry
+
+    B = 131072
+    n_ruled = 10000
+    cfg = EngineConfig(
+        max_resources=16384,
+        max_nodes=16384,
+        max_flow_rules=16384,
+        max_degrade_rules=16384,
+        max_param_rules=64,
+        flow_rules_per_resource=1,
+        degrade_rules_per_resource=1,
+        param_rules_per_resource=1,
+        batch_size=B,
+        complete_batch_size=B,
+        enable_minute_window=False,
+        use_mxu_tables=True,
+        sketch_stats=True,
+    )
+    reg = Registry(cfg)
+    flow_rules, degrade_rules, param_rules = [], [], []
+    for i in range(n_ruled):
+        name = f"res-{i+1}"
+        reg.resource_id(name)
+        flow_rules.append(FlowRule(resource=name, count=1000.0))
+        degrade_rules.append(DegradeRule(resource=name, grade=0, count=50.0, time_window=10))
+        if i < 60:
+            param_rules.append(ParamFlowRule(resource=name, param_idx=0, count=100.0))
+    ruleset = E.compile_ruleset(
+        cfg, reg, flow_rules=flow_rules, degrade_rules=degrade_rules,
+        param_rules=param_rules,
+    )
+    state = E.init_state(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(
+        np.where(
+            (r := (rng.zipf(1.3, B) - 1) % ((1 << 20) - 1) + 1) <= n_ruled,
+            r, cfg.node_rows + r,
+        ).astype(np.int32)
+    )
+    acq = E.empty_acquire(cfg)._replace(
+        res=ids,
+        count=jnp.ones((B,), jnp.int32),
+        param_hash=jnp.asarray(
+            rng.integers(1, 1 << 20, (B, cfg.param_dims), dtype=np.int32)
+        ),
+    )
+    comp = E.empty_complete(cfg)._replace(
+        res=ids,
+        rt=jnp.abs(jnp.asarray(rng.normal(3.0, 1.0, B), dtype=np.float32)),
+        success=jnp.ones((B,), jnp.int32),
+    )
+    elig = ids != cfg.trash_row
+
+    def bench(name, body, **kw):
+        dt = device_time_ms(scan_op(body), **kw)
+        print(f"{name:44s} {dt:9.3f} ms")
+
+    now = jnp.int32(12345)
+    bench(
+        "_process_completions (no degrade)",
+        lambda i: E._process_completions(
+            cfg, state, ruleset, comp._replace(rt=comp.rt + i), now + i, frozenset()
+        ).concurrency,
+    )
+    bench(
+        "_process_completions (degrade)",
+        lambda i: E._process_completions(
+            cfg, state, ruleset, comp._replace(rt=comp.rt + i), now + i,
+            frozenset({"degrade"}),
+        ).concurrency,
+    )
+    bench(
+        "_check_authority",
+        lambda i: E._check_authority(cfg, ruleset, acq._replace(res=ids + (i % 2))),
+    )
+    bench(
+        "_check_system",
+        lambda i: E._check_system(
+            cfg, state, ruleset, acq, now + i, jnp.float32(0.1), jnp.float32(0.1), elig
+        ),
+    )
+    bench(
+        "_check_param",
+        lambda i: E._check_param(cfg, state, ruleset, acq, now + i, elig)[0],
+    )
+    prows0 = P.pair_rows(
+        jnp.zeros((B,), jnp.int32), acq.param_hash[:, 0], cfg.param_depth,
+        cfg.param_width,
+    )
+    wtab0 = P.class_tables(
+        state.pcms, state.pcms_epochs, jnp.asarray(ruleset.param.class_k), now, cfg
+    )
+    bench(
+        "P.estimate alone",
+        lambda i: P.estimate(cfg, wtab0 + i, prows0, jnp.zeros((B,), jnp.int32)),
+    )
+    bench(
+        "P.add alone",
+        lambda i: P.add(state.pcms, jnp.int32(0), prows0 + i, jnp.ones((B,), jnp.int32), cfg),
+    )
+    bench(
+        "_check_flow",
+        lambda i: E._check_flow(cfg, state, ruleset, acq, now + i, elig)[0],
+    )
+    bench(
+        "_check_degrade",
+        lambda i: E._check_degrade(cfg, state, ruleset, acq, now + i, elig)[0],
+    )
+
+    # ---- flow internals ----
+    from sentinel_tpu.ops import tables as T
+    from sentinel_tpu.ops import window as W2
+    from sentinel_tpu.ops.rank import grouped_exclusive_cumsum_small
+
+    f = ruleset.flow
+    res_l = jnp.minimum(acq.res, cfg.max_resources)
+    bench(
+        "flow: slots big_gather",
+        lambda i: T.big_gather(cfg, f.res_rules, res_l + (i % 2), cfg.max_resources + 1, max_int=cfg.max_flow_rules),
+    )
+    slots_f = T.big_gather(cfg, f.res_rules, res_l, cfg.max_resources + 1, max_int=cfg.max_flow_rules).reshape(-1)
+    packed13 = T.pack_fields([f.enabled, f.limit_app, f.strategy, f.ref_node, f.ref_ctx,
+                              f.grade, f.count, f.behavior, f.max_queue_ms,
+                              f.warning_token, f.slope, state.warmup_tokens,
+                              state.occ_tokens])
+    bench("flow: fields small_gather", lambda i: T.small_gather_fields(cfg, packed13 + i, slots_f))
+    bench("flow: latest small_gather_int", lambda i: T.small_gather_int(cfg, jnp.round(state.latest_passed_ms).astype(jnp.int32) + i, slots_f))
+    cntf = jnp.ones((slots_f.shape[0],), jnp.float32)
+    ks = cfg.node_rows + cfg.max_flow_rules + 1
+    bench(
+        "flow: rank3 small",
+        lambda i: grouped_exclusive_cumsum_small(slots_f + i % 2, [cntf, cntf, cntf], slots_f > 0, ks)[0],
+    )
+    sec_cfg = W2.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    def wsum_gather(i):
+        wsum = W2.window_event(state.win_sec, now + i, sec_cfg, W2.EV_PASS)
+        return T.big_gather(cfg, jnp.stack([wsum, state.concurrency], axis=1),
+                            jnp.minimum(acq.res, cfg.node_rows - 1), cfg.node_rows, max_int=(1 << 24))
+    bench("flow: wsum+conc big_gather", wsum_gather)
+
+
+if __name__ == "__main__":
+    main()

@@ -127,10 +127,17 @@ class EngineConfig:
     enable_minute_window: bool = True
     # circuit-breaker window buckets (per-rule interval / cb_sample_count)
     cb_sample_count: int = 2
-    # param-flow count-min sketch
-    cms_depth: int = 4
-    cms_width: int = 4096
-    cms_sample_count: int = 2  # time buckets over each rule's duration
+    # param-flow hashed-row store (ops/param.py v2): rows are
+    # hash(rule, value) in [0, param_width) per depth; all rules share one
+    # bucket grid of param_sample_count x param_bucket_ms; distinct rule
+    # durations group into <= param_classes window classes; each entry
+    # carries param_dims hashed argument lanes
+    param_depth: int = 2
+    param_width: int = 1 << 14
+    param_sample_count: int = 8
+    param_bucket_ms: int = 500
+    param_classes: int = 4
+    param_dims: int = 2
     # top-k tracking for hot params
     topk_k: int = 32
     # statistic max RT clamp (SentinelConfig.java:63)
@@ -147,6 +154,17 @@ class EngineConfig:
     sketch_depth: int = 2
     sketch_width: int = 1 << 14  # CMS eps = e/width of window volume
     sketch_capacity: int = 1 << 22  # max interned sketch resources
+
+    def __post_init__(self):
+        # the native completion ring transports exactly two hot-param
+        # release lanes (sx_event.aux0/aux1); a wider engine batch would
+        # silently leak THREAD-grade concurrency for the extra lanes, so
+        # reject it here instead
+        if not (1 <= self.param_dims <= 2):
+            raise ValueError(
+                f"param_dims must be 1 or 2 (ring transport carries two "
+                f"release lanes); got {self.param_dims}"
+            )
 
     # dtype policy: counters int32, rt sums float32
     @property
@@ -185,7 +203,7 @@ def small_engine_config(**kw) -> EngineConfig:
         max_param_rules=8,
         batch_size=64,
         complete_batch_size=64,
-        cms_width=512,
+        param_width=512,
     )
     base.update(kw)
     return EngineConfig(**base)

@@ -50,24 +50,26 @@ class EventRing:
         rt_ms: float = 0.0,
         error: int = 0,
         user_tag: int = 0,
+        aux0: int = 0,
+        aux1: int = 0,
     ) -> bool:
         if self._lib is not None:
             return (
                 self._lib.sx_ring_push(
                     self._ring, res, count, origin_id, param_hash, flags,
-                    rt_ms, error, user_tag,
+                    rt_ms, error, user_tag, aux0, aux1,
                 )
                 == 0
             )
         with self._dq_lock:
             if len(self._dq) >= self.capacity:
                 return False
-            self._dq.append((res, count, origin_id, param_hash, flags, rt_ms, error, user_tag))
+            self._dq.append((res, count, origin_id, param_hash, flags, rt_ms, error, user_tag, aux0, aux1))
             return True
 
     def drain(self, max_n: int) -> Tuple[np.ndarray, ...]:
         """(res, count, origin_id, param_hash, flags, rt_ms, error,
-        user_tag) arrays of length n <= max_n."""
+        user_tag, aux0, aux1) arrays of length n <= max_n."""
         res = np.empty(max_n, np.int32)
         count = np.empty(max_n, np.int32)
         origin = np.empty(max_n, np.int32)
@@ -76,20 +78,23 @@ class EventRing:
         rt = np.empty(max_n, np.float32)
         err = np.empty(max_n, np.int32)
         tag = np.empty(max_n, np.int32)
+        aux0 = np.empty(max_n, np.int32)
+        aux1 = np.empty(max_n, np.int32)
         if self._lib is not None:
             cp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
             n = self._lib.sx_ring_drain(
                 self._ring, max_n, cp(res), cp(count), cp(origin), cp(ph),
-                cp(flags), cp(rt), cp(err), cp(tag),
+                cp(flags), cp(rt), cp(err), cp(tag), cp(aux0), cp(aux1),
             )
         else:
             n = 0
             with self._dq_lock:
                 while n < max_n and self._dq:
                     row = self._dq.popleft()
-                    res[n], count[n], origin[n], ph[n], flags[n], rt[n], err[n], tag[n] = row
+                    (res[n], count[n], origin[n], ph[n], flags[n], rt[n],
+                     err[n], tag[n], aux0[n], aux1[n]) = row
                     n += 1
-        return tuple(a[:n] for a in (res, count, origin, ph, flags, rt, err, tag))
+        return tuple(a[:n] for a in (res, count, origin, ph, flags, rt, err, tag, aux0, aux1))
 
     def __len__(self) -> int:
         if self._lib is not None:

@@ -38,6 +38,8 @@ struct sx_event {
     float   rt_ms;    // completions
     int32_t error;    // completions
     int32_t user_tag; // round-trips to the drainer (e.g. future index)
+    int32_t aux0;     // completions: hot-param release lane 0
+    int32_t aux1;     // completions: hot-param release lane 1
 };
 
 struct sx_slot {
@@ -76,7 +78,8 @@ void sx_ring_free(sx_ring* r) {
 // push one event; returns 0 on success, -1 if the ring is full
 int32_t sx_ring_push(sx_ring* r, int32_t res, int32_t count, int32_t origin_id,
                      int32_t param_hash, int32_t flags, float rt_ms,
-                     int32_t error, int32_t user_tag) {
+                     int32_t error, int32_t user_tag, int32_t aux0,
+                     int32_t aux1) {
     uint64_t pos = r->head.load(std::memory_order_relaxed);
     for (;;) {
         sx_slot& s = r->slots[pos & r->mask];
@@ -87,7 +90,7 @@ int32_t sx_ring_push(sx_ring* r, int32_t res, int32_t count, int32_t origin_id,
                                               std::memory_order_relaxed))
             {
                 s.ev = {res, count, origin_id, param_hash, flags, rt_ms,
-                        error, user_tag};
+                        error, user_tag, aux0, aux1};
                 s.seq.store(pos + 1, std::memory_order_release);
                 return 0;
             }
@@ -104,7 +107,8 @@ int32_t sx_ring_push(sx_ring* r, int32_t res, int32_t count, int32_t origin_id,
 // scheme stays correct with several.
 int64_t sx_ring_drain(sx_ring* r, int64_t max_n, int32_t* res, int32_t* count,
                       int32_t* origin_id, int32_t* param_hash, int32_t* flags,
-                      float* rt_ms, int32_t* error, int32_t* user_tag) {
+                      float* rt_ms, int32_t* error, int32_t* user_tag,
+                      int32_t* aux0, int32_t* aux1) {
     int64_t n = 0;
     while (n < max_n) {
         uint64_t pos = r->tail.load(std::memory_order_relaxed);
@@ -119,6 +123,7 @@ int64_t sx_ring_drain(sx_ring* r, int64_t max_n, int32_t* res, int32_t* count,
             res[n] = e.res; count[n] = e.count; origin_id[n] = e.origin_id;
             param_hash[n] = e.param_hash; flags[n] = e.flags;
             rt_ms[n] = e.rt_ms; error[n] = e.error; user_tag[n] = e.user_tag;
+            aux0[n] = e.aux0; aux1[n] = e.aux1;
             s.seq.store(pos + r->mask + 1, std::memory_order_release);
             ++n;
         } else {

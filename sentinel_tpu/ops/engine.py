@@ -50,6 +50,7 @@ from sentinel_tpu.core.rules import (
     CONTROL_WARM_UP,
     CONTROL_WARM_UP_RATE_LIMITER,
     GRADE_QPS,
+    GRADE_THREAD,
     STRATEGY_CHAIN,
     STRATEGY_DIRECT,
     STRATEGY_RELATE,
@@ -79,6 +80,13 @@ def _rank(cfg: EngineConfig, keys, values, eligible, key_space: int):
     return grouped_exclusive_cumsum(keys, values, eligible)
 
 
+def _fan(x, K: int):
+    """Per-item -> per-(item, rule-lane) fan-out: x[repeat(arange(b), K)]
+    expressed as jnp.repeat, which lowers to broadcast+reshape instead of a
+    serialized row gather (~1.2 ms at B=128K, measured)."""
+    return x if K == 1 else jnp.repeat(x, K, axis=0)
+
+
 class EngineState(NamedTuple):
     win_sec: W.WindowState  # [node_rows] second window (2 x 500 ms default)
     win_min: W.WindowState  # [node_rows] minute window (60 x 1 s default)
@@ -100,9 +108,10 @@ class EngineState(NamedTuple):
     cb_retry_ms: jax.Array  # int32 [D+1]
     cb_counts: jax.Array  # int32 [D+1, nbc, 3]
     cb_epochs: jax.Array  # int32 [D+1, nbc]
-    # per param-rule count-min sketch
-    cms: jax.Array  # int32 [P+1, nbp, depth, width]
-    cms_epochs: jax.Array  # int32 [P+1, nbp]
+    # hashed (rule,value) param store (ops/param.py v2)
+    pcms: jax.Array  # int32 [depth, Q, nbp] windowed counts
+    pcms_epochs: jax.Array  # int32 [nbp] global bucket epochs
+    pconc: jax.Array  # int32 [depth, Q] per-(rule,value) concurrency
     # global observability sketch for tail resources (ops/gsketch.py);
     # [1,1,1,1]-shaped dummy when sketch_stats is off
     gs: GS.SketchState
@@ -129,7 +138,7 @@ class AcquireBatch(NamedTuple):
     ctx_node: jax.Array  # int32 [B] context DefaultNode row (trash if none)
     ctx_name: jax.Array  # int32 [B] interned context name (-1 default)
     inbound: jax.Array  # int32 [B] 1 = entrance context (EntranceNode)
-    param_hash: jax.Array  # int32 [B] hashed hot param (0 none)
+    param_hash: jax.Array  # int32 [B, param_dims] hashed hot-param lanes (0 none)
     # host-decided verdict override (0 = none): a cluster token denial is
     # injected here so the device still records the block into the stat
     # windows (the reference counts cluster blocks through StatisticSlot the
@@ -147,6 +156,7 @@ class CompleteBatch(NamedTuple):
     rt: jax.Array  # float32 [B2] response time ms
     success: jax.Array  # int32 [B2] completions (usually 1)
     error: jax.Array  # int32 [B2] business exceptions (Tracer.trace)
+    param_hash: jax.Array  # int32 [B2, param_dims] — THREAD-grade release lanes
 
 
 class TickOutput(NamedTuple):
@@ -179,11 +189,14 @@ def init_state(cfg: EngineConfig) -> EngineState:
         cb_retry_ms=jnp.zeros((Dn + 1,), dtype=jnp.int32),
         cb_counts=jnp.zeros((Dn + 1, cfg.cb_sample_count, 3), dtype=jnp.int32),
         cb_epochs=jnp.full((Dn + 1, cfg.cb_sample_count), -10, dtype=jnp.int32),
-        cms=jnp.zeros(
-            (Pn + 1, cfg.cms_sample_count, cfg.cms_depth, cfg.cms_width),
+        pcms=jnp.zeros(
+            (cfg.param_depth, cfg.param_width, cfg.param_sample_count),
             dtype=jnp.int32,
         ),
-        cms_epochs=jnp.full((Pn + 1, cfg.cms_sample_count), -10, dtype=jnp.int32),
+        pcms_epochs=jnp.full(
+            (cfg.param_sample_count,), -(cfg.param_sample_count + 1), dtype=jnp.int32
+        ),
+        pconc=jnp.zeros((cfg.param_depth, cfg.param_width), dtype=jnp.int32),
         gs=GS.init_sketch(sketch_config(cfg))
         if cfg.sketch_stats
         else GS.SketchState(
@@ -224,7 +237,7 @@ def empty_acquire(cfg: EngineConfig, b: Optional[int] = None) -> AcquireBatch:
         ctx_node=jnp.full((b,), trash, dtype=jnp.int32),
         ctx_name=jnp.full((b,), -1, dtype=jnp.int32),
         inbound=z,
-        param_hash=z,
+        param_hash=jnp.zeros((b, cfg.param_dims), dtype=jnp.int32),
         pre_verdict=z,
     )
 
@@ -241,6 +254,7 @@ def empty_complete(cfg: EngineConfig, b: Optional[int] = None) -> CompleteBatch:
         rt=jnp.zeros((b,), dtype=jnp.float32),
         success=z,
         error=z,
+        param_hash=jnp.zeros((b, cfg.param_dims), dtype=jnp.int32),
     )
 
 
@@ -248,10 +262,20 @@ def _stat_rows(cfg: EngineConfig, res, ctx_node, origin_node, with_nodes: bool):
     """Stat rows an item writes to: the per-resource ClusterNode row, plus
     (with the "nodes" feature) the context DefaultNode and origin rows
     (StatisticSlot.java:54-123).  The global ENTRY node is handled by a
-    masked reduction instead of a scatter lane — its row is fixed."""
+    masked reduction instead of a scatter lane — its row is fixed.
+
+    Trash-row lanes are remapped to an out-of-range sentinel so every
+    scatter path DROPS them: the trash row stays identically zero, which
+    keeps the two backends bit-identical regardless of which fan-out branch
+    a tick takes.  (The sentinel must be LARGE, not -1 — JAX array indexing
+    wraps negatives NumPy-style, which would land on the last row.)"""
+
+    def clean(x):
+        return jnp.where(x == cfg.trash_row, jnp.int32(2**30), x)
+
     if with_nodes:
-        return jnp.concatenate([res, ctx_node, origin_node])
-    return res
+        return jnp.concatenate([clean(res), clean(ctx_node), clean(origin_node)])
+    return clean(res)
 
 
 def _stat_update(
@@ -259,36 +283,47 @@ def _stat_update(
     state: EngineState,
     now_ms,
     rows,  # [N] or [3N] stat rows
-    deltas,  # int32 [same, NUM_EVENTS]
+    deltas,  # int32 [same, len(plane_idx)]
     rt,  # float32 [same] or None
     entry_deltas,  # int32 [NUM_EVENTS] — ENTRY-node contribution (reduction)
     entry_rt,  # f32 scalar or None
     entry_rt_min,  # f32 scalar or None — min inbound RT this tick
+    plane_idx: tuple = tuple(range(W.NUM_EVENTS)),  # which events deltas carry
 ) -> EngineState:
     """Land one batch of stat events.
 
     CPU path: scatter-add per window (exact, incl. per-row minRt).
     MXU path: one-hot-matmul histogram → dense column add (ops/tables.py);
-    per-row minRt is skipped (ENTRY-row min is kept via min_into_row)."""
+    per-row minRt is skipped (ENTRY-row min is kept via min_into_row).
+
+    ``plane_idx`` names the event planes ``deltas`` carries — the acquire
+    side only writes PASS/OCCUPIED/BLOCK and the completion side only
+    SUCCESS/EXCEPTION, so contracting just those planes cuts the histogram
+    matmuls ~40%."""
     sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
     min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
     erow = cfg.entry_node_row
 
     if cfg.use_mxu_tables:
-        hist = T.histogram(cfg, rows, deltas, cfg.node_rows)
-        hist = hist.at[erow].add(entry_deltas)
-        rt_hist = None
+        vals = deltas
         if rt is not None:
             # quantize to 1/8 ms so the RT plane rides the exact bf16 digit
             # path (values ≤ statistic_max_rt*8 < 2^16) instead of a slow
-            # f32 contraction; RT is clamped like the reference's
+            # f32 contraction, and FUSE it into the counts histogram so the
+            # one-hot build is shared; RT is clamped like the reference's
             # statisticMaxRt (SentinelConfig.java:63)
             rt_q = jnp.round(
                 jnp.minimum(rt, float(cfg.statistic_max_rt)) * 8.0
             ).astype(jnp.int32)
-            rt_hist = (
-                T.histogram(cfg, rows, rt_q, cfg.node_rows).astype(jnp.float32) / 8.0
-            )
+            vals = jnp.concatenate([deltas, rt_q[:, None]], axis=1)
+        h = T.histogram(cfg, rows, vals, cfg.node_rows)
+        hist_small = h[:, : len(plane_idx)]
+        hist = jnp.zeros((cfg.node_rows, W.NUM_EVENTS), hist_small.dtype)
+        hist = hist.at[:, jnp.asarray(plane_idx)].set(hist_small)
+        hist = hist.at[erow].add(entry_deltas)
+        rt_hist = None
+        if rt is not None:
+            rt_hist = h[:, -1].astype(jnp.float32) / 8.0
             rt_hist = rt_hist.at[erow].add(entry_rt)
         win_sec = W.add_dense(state.win_sec, now_ms, hist, rt_hist, sec_cfg)
         if entry_rt_min is not None:
@@ -298,6 +333,9 @@ def _stat_update(
             win_min = W.add_dense(state.win_min, now_ms, hist, rt_hist, min_cfg)
         return state._replace(win_sec=win_sec, win_min=win_min), hist
     # CPU/scatter path
+    if len(plane_idx) != W.NUM_EVENTS:
+        full = jnp.zeros((deltas.shape[0], W.NUM_EVENTS), deltas.dtype)
+        deltas = full.at[:, jnp.asarray(plane_idx)].set(deltas)
     win_sec = W.add_batch(state.win_sec, now_ms, rows, deltas, rt, sec_cfg)
     win_sec = W.WindowState(
         counts=win_sec.counts.at[erow, W.current_index(now_ms, sec_cfg), :].add(
@@ -343,14 +381,10 @@ def _process_completions(
     valid = comp.res != cfg.trash_row
     with_nodes = "nodes" in features
 
-    rows = _stat_rows(cfg, comp.res, comp.ctx_node, comp.origin_node, with_nodes)
-    deltas1 = jnp.zeros((b, W.NUM_EVENTS), dtype=jnp.int32)
-    deltas1 = deltas1.at[:, W.EV_SUCCESS].set(comp.success)
-    deltas1 = deltas1.at[:, W.EV_EXCEPTION].set(comp.error)
+    deltas1 = jnp.stack(
+        [jnp.where(valid, comp.success, 0), jnp.where(valid, comp.error, 0)], axis=1
+    )  # planes (SUCCESS, EXCEPTION) only — the exit path writes nothing else
     rt1 = jnp.where(valid, comp.rt, 0.0)
-    fan = 3 if with_nodes else 1
-    deltas = jnp.tile(deltas1, (fan, 1)) if with_nodes else deltas1
-    rt = jnp.tile(rt1, (fan,)) if with_nodes else rt1
     inb = valid & (comp.inbound > 0)
     entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
     entry_deltas = entry_deltas.at[W.EV_SUCCESS].set(jnp.sum(jnp.where(inb, comp.success, 0)))
@@ -362,9 +396,35 @@ def _process_completions(
     entry_rt_min = jnp.min(
         jnp.where(inb & (comp.rt > 0), comp.rt, jnp.float32(W.RT_MIN_INIT))
     )
-    state, hist = _stat_update(
-        cfg, state, now_ms, rows, deltas, rt, entry_deltas, entry_rt, entry_rt_min
-    )
+
+    def _land(fanned: bool):
+        rows = _stat_rows(cfg, comp.res, comp.ctx_node, comp.origin_node, fanned)
+        f = 3 if fanned else 1
+        return _stat_update(
+            cfg,
+            state,
+            now_ms,
+            rows,
+            jnp.tile(deltas1, (f, 1)) if fanned else deltas1,
+            jnp.tile(rt1, (f,)) if fanned else rt1,
+            entry_deltas,
+            entry_rt,
+            entry_rt_min,
+            plane_idx=(W.EV_SUCCESS, W.EV_EXCEPTION),
+        )
+
+    if with_nodes:
+        # batches whose items carry no ctx/origin rows (the common
+        # decorator-style workload) skip the 3x stat fan-out entirely
+        any_fan = jnp.any(
+            valid
+            & ((comp.ctx_node != cfg.trash_row) | (comp.origin_node != cfg.trash_row))
+        )
+        state, hist = jax.lax.cond(
+            any_fan, lambda: _land(True), lambda: _land(False)
+        )
+    else:
+        state, hist = _land(False)
     # service-level RT quantiles over inbound completions (ops/rtq.py)
     state = state._replace(
         rtq=RQ.add(state.rtq, now_ms, comp.rt, inb & (comp.rt > 0), rtq_config(cfg))
@@ -391,12 +451,59 @@ def _process_completions(
         # (the histogram already carries the ENTRY-row reduction)
         concurrency = state.concurrency - hist[:, W.EV_SUCCESS]
     else:
+        fan = 3 if with_nodes else 1
+        rows = _stat_rows(cfg, comp.res, comp.ctx_node, comp.origin_node, with_nodes)
         dec = jnp.tile(jnp.where(valid, comp.success, 0), (fan,))
         concurrency = state.concurrency.at[rows].add(-dec, mode="drop")
         concurrency = concurrency.at[cfg.entry_node_row].add(
             -entry_deltas[W.EV_SUCCESS]
         )
     concurrency = jnp.maximum(concurrency, 0)
+
+    # THREAD-grade param release (ParamFlowSlot.exit: decreaseThreadCount)
+    if "param" in features:
+        KPp = cfg.param_rules_per_resource
+        res_lp = jnp.minimum(comp.res, cfg.max_resources)
+        pslots = T.big_gather(
+            cfg,
+            rules.param.res_params,
+            res_lp,
+            cfg.max_resources + 1,
+            max_int=cfg.max_param_rules,
+        )
+        pslots_f = pslots.reshape(-1)
+        pgc = T.small_gather_fields(
+            cfg,
+            T.pack_fields(
+                [rules.param.enabled, rules.param.grade, rules.param.lane]
+            ),
+            pslots_f,
+        )
+        lane_c = pgc[:, 2].astype(jnp.int32)
+        lane_oh_c = jnp.clip(lane_c, 0, cfg.param_dims - 1)[
+            :, None
+        ] == jax.lax.broadcasted_iota(jnp.int32, (1, cfg.param_dims), 1)
+        ph_c = jnp.sum(jnp.where(lane_oh_c, _fan(comp.param_hash, KPp), 0), axis=1)
+        ph_c = jnp.where(lane_c >= 0, ph_c, 0)
+        rel = (
+            (pgc[:, 0] > 0)
+            & (pgc[:, 1].astype(jnp.int32) == GRADE_THREAD)
+            & (ph_c != 0)
+            & _fan(valid, KPp)
+        )
+
+        def _release():
+            prows_c = P.pair_rows(pslots_f, ph_c, cfg.param_depth, cfg.param_width)
+            return P.conc_add(
+                cfg,
+                state.pconc,
+                jnp.where(rel[:, None], prows_c, -1),
+                jnp.zeros_like(_fan(comp.success, KPp)),
+                _fan(comp.success, KPp),
+            )
+
+        pconc = jax.lax.cond(jnp.any(rel), _release, lambda: state.pconc)
+        state = state._replace(pconc=pconc)
 
     if "degrade" not in features:
         return state._replace(concurrency=concurrency)
@@ -429,10 +536,10 @@ def _process_completions(
     g_grade = dg[:, 1].astype(jnp.int32)
     g_count = dg[:, 2]
     g_idx = dg[:, 3].astype(jnp.int32)
-    active = enabled & valid[item]
+    active = enabled & _fan(valid, KD)
 
-    is_err = (comp.error[item] > 0) & active
-    is_slow = (g_grade == D.GRADE_SLOW_RATIO) & (comp.rt[item] > g_count) & active
+    is_err = (_fan(comp.error, KD) > 0) & active
+    is_slow = (g_grade == D.GRADE_SLOW_RATIO) & (_fan(comp.rt, KD) > g_count) & active
     upd = jnp.stack(
         [
             jnp.where(active, 1, 0),
@@ -560,10 +667,13 @@ def _check_param(
     now_ms,
     eligible,
 ):
-    """ParamFlowSlot: per-parameter-value windowed CMS limiting
-    (ParamFlowChecker.passLocalCheck:78-188, token bucket → windowed budget).
+    """ParamFlowSlot: per-parameter-value limiting over hashed rows
+    (ParamFlowChecker.passLocalCheck:78-188 — QPS grade as a windowed
+    budget, THREAD grade as per-value concurrency; paramIdx dispatch via
+    per-resource hash lanes).
 
-    Returns (blocked[B], cms, cms_epochs, cur_idx, pslots_f, p_applicable).
+    Returns (blocked[B], pcms, pcms_epochs, cur_idx, prows, qps_add_mask,
+    thread_add_mask).
     """
     KP = cfg.param_rules_per_resource
     b = acq.res.shape[0]
@@ -572,17 +682,51 @@ def _check_param(
     slots_f = slots.reshape(-1)
     item = jnp.repeat(jnp.arange(b), KP)
 
-    cms, cms_epochs, cur_idx = P.refresh_columns(
-        state.cms, state.cms_epochs, rules.param.window_ms, now_ms
-    )
+    pcms, pcms_epochs, cur_idx = P.refresh(state.pcms, state.pcms_epochs, now_ms, cfg)
 
     pg = T.small_gather_fields(
-        cfg, T.pack_fields([rules.param.enabled, rules.param.threshold]), slots_f
+        cfg,
+        T.pack_fields(
+            [
+                rules.param.enabled,
+                rules.param.threshold,
+                rules.param.grade,
+                rules.param.cls,
+                rules.param.lane,
+            ]
+        ),
+        slots_f,
     )
     enabled = pg[:, 0] > 0
-    ph = acq.param_hash[item]
+    grade = pg[:, 2].astype(jnp.int32)
+    cls = pg[:, 3].astype(jnp.int32)
+    lane = pg[:, 4].astype(jnp.int32)
+
+    # the rule's param_idx was lane-assigned at compile; pick that hash
+    # lane via a tiny one-hot sum (take_along_axis serializes on TPU)
+    ph_all = _fan(acq.param_hash, KP)  # [N, M]
+    lane_oh = jnp.clip(lane, 0, cfg.param_dims - 1)[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (1, cfg.param_dims), 1
+    )
+    ph = jnp.sum(jnp.where(lane_oh, ph_all, 0), axis=1)
+    ph = jnp.where(lane >= 0, ph, 0)
     applicable = enabled & (ph != 0)
-    est = P.estimate(cms, cms_epochs, rules.param.window_ms, slots_f, ph, now_ms)
+
+    prows = P.pair_rows(slots_f, ph, cfg.param_depth, cfg.param_width)  # [N, depth]
+    wtab = P.class_tables(
+        pcms, pcms_epochs, jnp.asarray(rules.param.class_k), now_ms, cfg
+    )
+    est = P.estimate(cfg, wtab, prows, cls)
+    # the concurrency gathers only run when a THREAD-grade rule exists
+    any_thread = jnp.any(
+        jnp.asarray(rules.param.enabled)
+        & (jnp.asarray(rules.param.grade) == GRADE_THREAD)
+    )
+    conc_est = jax.lax.cond(
+        any_thread,
+        lambda: P.conc_estimate(cfg, state.pconc, prows),
+        lambda: jnp.zeros((prows.shape[0],), jnp.float32),
+    )
 
     # per-value exception items (ParamFlowItem): hashes are raw int32 bits,
     # so they go through the exact int gather; thresholds pack as f32
@@ -595,13 +739,20 @@ def _check_param(
     item_thr = jnp.max(jnp.where(is_item, it, 0.0), axis=1)
     thr = jnp.where(has_item, item_thr, pg[:, 1])
 
-    cnt = acq.count[item].astype(jnp.float32)
-    elig_f = eligible[item] & applicable
+    cnt = _fan(acq.count, KP).astype(jnp.float32)
+    elig_f = _fan(eligible, KP) & applicable
+    # within-tick rank keyed by the exact (value, rule) pair — the int32
+    # wrap of the mix only ever MERGES groups, which over-counts
+    # conservatively (sort-based rank: the key space is unbounded)
     key = ph * jnp.int32(KP + 1) + slots_f
     (rank,) = grouped_exclusive_cumsum(key, [cnt], elig_f)
-    blocked_f = applicable & (est + rank + cnt > thr)
+    is_thread = grade == GRADE_THREAD
+    over = jnp.where(is_thread, conc_est, est) + rank + cnt > thr
+    blocked_f = applicable & over
     blocked = (blocked_f & elig_f).reshape(b, KP).any(axis=1)
-    return blocked, cms, cms_epochs, cur_idx, slots_f, applicable
+    qps_add = applicable & ~is_thread
+    thread_add = applicable & is_thread
+    return blocked, pcms, pcms_epochs, cur_idx, prows, qps_add, thread_add
 
 
 def _fold_occupied(cfg: EngineConfig, state: EngineState, rules: RuleSet, now_ms):
@@ -749,13 +900,13 @@ def _check_flow(
     ).astype(jnp.float32)
     enabled = fg[:, 0] > 0
     la = fg[:, 1].astype(jnp.int32)
-    origin = acq.origin_id[item]
+    origin = _fan(acq.origin_id, K)
     la_all = la.reshape(b, K)  # [B, K]
     named = ((la_all >= 0) & (la_all == acq.origin_id[:, None])).any(axis=1)  # [B]
     match = (
         (la == RT.LIMIT_ANY)
         | ((la >= 0) & (la == origin))
-        | ((la == RT.LIMIT_OTHER) & (origin >= 0) & ~named[item])
+        | ((la == RT.LIMIT_OTHER) & (origin >= 0) & ~_fan(named, K))
     )
     applicable = enabled & match
 
@@ -763,9 +914,9 @@ def _check_flow(
     strategy = fg[:, 2].astype(jnp.int32)
     ref_node = fg[:, 3].astype(jnp.int32)
     ref_ctx = fg[:, 4].astype(jnp.int32)
-    direct_node = jnp.where(la == RT.LIMIT_ANY, acq.res[item], acq.origin_node[item])
-    chain_ok = (ref_ctx >= 0) & (ref_ctx == acq.ctx_name[item])
-    chain_node = jnp.where(chain_ok, acq.ctx_node[item], -1)
+    direct_node = jnp.where(la == RT.LIMIT_ANY, _fan(acq.res, K), _fan(acq.origin_node, K))
+    chain_ok = (ref_ctx >= 0) & (ref_ctx == _fan(acq.ctx_name, K))
+    chain_node = jnp.where(chain_ok, _fan(acq.ctx_node, K), -1)
     node = jnp.where(
         strategy == STRATEGY_DIRECT,
         direct_node,
@@ -778,7 +929,7 @@ def _check_flow(
     grade = fg[:, 5].astype(jnp.int32)
     rcount = fg[:, 6]
     behavior = jnp.where(grade == GRADE_QPS, fg[:, 7].astype(jnp.int32), CONTROL_DEFAULT)
-    cnt = acq.count[item].astype(jnp.float32)
+    cnt = _fan(acq.count, K).astype(jnp.float32)
 
     # --- per-entry warm-up threshold (WarmUpController.canPass)
     rest = fg[:, 11]
@@ -802,7 +953,7 @@ def _check_flow(
 
     # --- within-tick ranks (key: decision node; RL keys by rule slot)
     key = jnp.where(is_rl, jnp.int32(cfg.node_rows) + slots_f, node_safe)
-    elig_f = eligible[item] & applicable
+    elig_f = _fan(eligible, K) & applicable
     rank_tok, rank_thr, rank_cost = _rank(
         cfg,
         key,
@@ -864,7 +1015,7 @@ def _check_flow(
         # row can borrow ahead — the fold knows where to land the deferred
         # PASS (LIMIT_ANY + DIRECT; origin/relate/chain meter other nodes)
         cand = (
-            (acq.prio[item] > 0)
+            (_fan(acq.prio, K) > 0)
             & (behavior == CONTROL_DEFAULT)
             & (grade == GRADE_QPS)
             & (la == RT.LIMIT_ANY)
@@ -873,10 +1024,20 @@ def _check_flow(
             & elig_f
             & qps_block
         )
-        (rank_occ,) = _rank(
-            cfg, slots_f, [cnt], cand, cfg.max_flow_rules + 1
+
+        # the occupy rank pass only runs when the batch carries prioritized
+        # items at all (lax.cond skips ~1.2 ms of rank work for the common
+        # all-normal batch)
+        def _occ_rank(cand):
+            (rank_occ,) = _rank(cfg, slots_f, [cnt], cand, cfg.max_flow_rules + 1)
+            return cand & (pool + rank_occ + cnt <= rcount)  # maxOccupyRatio=1
+
+        granted = jax.lax.cond(
+            jnp.any(cand),
+            _occ_rank,
+            lambda cand: jnp.zeros_like(cand),
+            cand,
         )
-        granted = cand & (pool + rank_occ + cnt <= rcount)  # maxOccupyRatio=1
         # an item occupies iff its ONLY failure was the occupiable QPS check
         still_blocked = (entry_block & ~granted & elig_f).reshape(b, K).any(axis=1)
         occupying = (granted & elig_f).reshape(b, K).any(axis=1) & ~still_blocked
@@ -894,19 +1055,37 @@ def _check_flow(
         occ_grant = (first_lane.reshape(-1), slots_f, cnt)
 
     # pacing delay for admitted rate-limited entries
-    rl_ok = is_rl & applicable & ~entry_block & elig_f & ~blocked[item]
+    rl_ok = is_rl & applicable & ~entry_block & elig_f & ~_fan(blocked, K)
     wait_ms_entry = jnp.where(rl_ok, jnp.maximum(wait, 0.0), 0.0)
     wait_ms = jnp.maximum(jnp.max(wait_ms_entry.reshape(b, K), axis=1), occ_wait)
 
     # advance latestPassedTime for admitted entries (even if a later slot
-    # blocks the request, matching the reference's side-effect order)
-    latest = T.small_scatter_max(
+    # blocks the request, matching the reference's side-effect order).
+    #
+    # Closed form instead of a per-item scatter-max (which costs ~10 ms at
+    # B=128K): replaying RateLimiterController.canPass:50-105 sequentially
+    # over this tick's admitted items, latestPassedTime can reset to `now`
+    # at most once (after the first reset it only grows by costs), so
+    #     L' = l0 + T                 if the bucket stays busy
+    #     L' = now + (T - C_reset)    if item with inclusive prefix C_reset
+    #                                 found the bucket idle (l0 + C <= now)
+    # with T = sum of admitted costs.  The reset item is the FIRST admitted
+    # one, so C_reset ≈ T/n * 1 — we use the per-slot mean admitted cost,
+    # which is exact whenever a slot's within-tick costs are uniform (same
+    # rule + count, the overwhelmingly common case) and off by at most one
+    # cost spread otherwise.  One packed scatter-add replaces the max.
+    sums = T.small_scatter_add(
         cfg,
-        state.latest_passed_ms,
+        jnp.zeros((cfg.max_flow_rules + 1, 2), jnp.float32),
         jnp.where(rl_ok, slots_f, jnp.int32(-1)),
-        jnp.where(rl_ok, expected, -3.0e38),
-        -3.0e38,
+        jnp.stack([jnp.where(rl_ok, cost, 0.0), jnp.where(rl_ok, 1.0, 0.0)], axis=1),
     )
+    T_s, n_s = sums[:, 0], sums[:, 1]
+    mean_cost = T_s / jnp.maximum(n_s, 1.0)
+    cand = jnp.maximum(
+        state.latest_passed_ms + T_s, now_ms.astype(jnp.float32) + T_s - mean_cost
+    )
+    latest = jnp.where(n_s > 0, cand, state.latest_passed_ms)
 
     return blocked, wait_ms.astype(jnp.int32), latest, occupying, occ_grant, slots_f
 
@@ -940,23 +1119,31 @@ def _check_degrade(
     open_due = (st == D.CB_OPEN) & retry_due
     half = st == D.CB_HALF_OPEN
 
-    probe_cand = open_due & enabled & eligible[item]
-    # one probe per rule: first eligible candidate by rank
-    (p_rank,) = _rank(
-        cfg,
-        jnp.minimum(slots_f, cfg.max_degrade_rules),
-        [jnp.ones_like(slots_f, dtype=jnp.float32)],
-        probe_cand,
-        cfg.max_degrade_rules + 1,
+    probe_cand = open_due & enabled & _fan(eligible, KD)
+
+    # one probe per rule: first eligible candidate by rank — the rank pass
+    # only runs when some breaker is actually due (lax.cond: the all-closed
+    # steady state pays nothing)
+    def _probe_rank(cand):
+        (p_rank,) = _rank(
+            cfg,
+            jnp.minimum(slots_f, cfg.max_degrade_rules),
+            [jnp.ones_like(slots_f, dtype=jnp.float32)],
+            cand,
+            cfg.max_degrade_rules + 1,
+        )
+        return cand & (p_rank < 0.5)
+
+    probe = jax.lax.cond(
+        jnp.any(probe_cand), _probe_rank, lambda cand: jnp.zeros_like(cand), probe_cand
     )
-    probe = probe_cand & (p_rank < 0.5)
 
     entry_block = enabled & (open_wait | (open_due & ~probe) | half)
-    blocked = (entry_block & eligible[item]).reshape(b, KD).any(axis=1)
+    blocked = (entry_block & _fan(eligible, KD)).reshape(b, KD).any(axis=1)
 
     # elected probes flip their breaker OPEN → HALF_OPEN; a probe whose item
     # is blocked by another CB on the same resource must not flip
-    probe_ok = probe & ~blocked[item]
+    probe_ok = probe & ~_fan(blocked, KD)
     Dn1 = cfg.max_degrade_rules + 1
     flip = T.small_scatter_or(
         cfg,
@@ -1025,9 +1212,15 @@ def tick(
     eligible = eligible & ~sys_block
 
     if "param" in features:
-        param_block, cms, cms_epochs, cms_idx, pslots_f, p_applicable = _check_param(
-            cfg, state, rules, acq, now_ms, eligible
-        )
+        (
+            param_block,
+            pcms,
+            pcms_epochs,
+            pcms_idx,
+            prows,
+            p_qps_add,
+            p_thread_add,
+        ) = _check_param(cfg, state, rules, acq, now_ms, eligible)
         param_block = param_block & eligible
     else:
         param_block = zero_block
@@ -1067,7 +1260,7 @@ def tick(
         grant_lane, oslots, ocnt = occ_grant
         b_k = grant_lane.shape[0] // b
         item_g = jnp.repeat(jnp.arange(b), b_k)
-        commit = grant_lane & occupying[item_g]
+        commit = grant_lane & _fan(occupying, b_k)
         add = T.small_scatter_add(
             cfg,
             jnp.zeros((cfg.max_flow_rules + 1,), jnp.float32),
@@ -1097,14 +1290,15 @@ def tick(
     # window's budget is reduced by exactly the borrowed amount.
     with_nodes = "nodes" in features
     rows = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, with_nodes)
-    deltas1 = jnp.zeros((b, W.NUM_EVENTS), dtype=jnp.int32)
-    deltas1 = deltas1.at[:, W.EV_PASS].set(
-        jnp.where(passed & ~occupying, acq.count, 0)
+    # planes (PASS, BLOCK, OCCUPIED) only — the entry path writes no others
+    deltas1 = jnp.stack(
+        [
+            jnp.where(passed & ~occupying, acq.count, 0),
+            jnp.where(valid & ~passed, acq.count, 0),
+            jnp.where(occupying, acq.count, 0),
+        ],
+        axis=1,
     )
-    deltas1 = deltas1.at[:, W.EV_OCCUPIED].set(jnp.where(occupying, acq.count, 0))
-    deltas1 = deltas1.at[:, W.EV_BLOCK].set(jnp.where(valid & ~passed, acq.count, 0))
-    fan = 3 if with_nodes else 1
-    deltas = jnp.tile(deltas1, (fan, 1)) if with_nodes else deltas1
     inb = valid & (acq.inbound > 0)
     entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
     entry_deltas = entry_deltas.at[W.EV_PASS].set(
@@ -1116,9 +1310,33 @@ def tick(
     entry_deltas = entry_deltas.at[W.EV_BLOCK].set(
         jnp.sum(jnp.where(inb & ~passed, acq.count, 0))
     )
-    state, hist = _stat_update(
-        cfg, state, now_ms, rows, deltas, None, entry_deltas, None, None
-    )
+
+    def _land_acq(fanned: bool):
+        rws = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, fanned)
+        f = 3 if fanned else 1
+        return _stat_update(
+            cfg,
+            state,
+            now_ms,
+            rws,
+            jnp.tile(deltas1, (f, 1)) if fanned else deltas1,
+            None,
+            entry_deltas,
+            None,
+            None,
+            plane_idx=(W.EV_PASS, W.EV_BLOCK, W.EV_OCCUPIED),
+        )
+
+    if with_nodes:
+        any_fan = jnp.any(
+            valid
+            & ((acq.ctx_node != cfg.trash_row) | (acq.origin_node != cfg.trash_row))
+        )
+        state, hist = jax.lax.cond(
+            any_fan, lambda: _land_acq(True), lambda: _land_acq(False)
+        )
+    else:
+        state, hist = _land_acq(False)
     if cfg.sketch_stats:
         gvals = jnp.stack(
             [
@@ -1144,6 +1362,8 @@ def tick(
         # entries hold a concurrency slot even though their PASS lands later)
         concurrency = state.concurrency + hist[:, W.EV_PASS] + hist[:, W.EV_OCCUPIED]
     else:
+        fan = 3 if with_nodes else 1
+        rows = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, with_nodes)
         inc = jnp.tile(jnp.where(passed, acq.count, 0), (fan,))
         concurrency = state.concurrency.at[rows].add(inc, mode="drop")
         concurrency = concurrency.at[cfg.entry_node_row].add(
@@ -1155,32 +1375,41 @@ def tick(
     if "warmup" in features and fslots is not None:
         K = cfg.flow_rules_per_resource
         item_f = jnp.repeat(jnp.arange(b), K)
-        adm = passed[item_f]
+        adm = _fan(passed, K)
         acc_add = T.small_scatter_add(
             cfg,
             jnp.zeros((cfg.max_flow_rules + 1,), jnp.float32),
             jnp.where(adm, fslots, jnp.int32(-1)),
-            jnp.where(adm, acq.count[item_f].astype(jnp.float32), 0.0),
+            jnp.where(adm, _fan(acq.count, K).astype(jnp.float32), 0.0),
         )
         state = state._replace(warm_acc=state.warm_acc + acc_add)
 
-    # param pass counting into the sketch (only admitted traffic consumes
-    # the per-value budget, like the token bucket decrement in
-    # ParamFlowChecker.passDefaultLocalCheck)
+    # param pass counting + THREAD concurrency (only admitted traffic
+    # consumes the per-value budget, like the token bucket decrement in
+    # ParamFlowChecker.passDefaultLocalCheck; ParamFlowSlot entry thread++)
     if "param" in features:
         KP = cfg.param_rules_per_resource
-        item_p = jnp.repeat(jnp.arange(b), KP)
-        p_add = p_applicable & passed[item_p]
-        cms = P.add(
-            cms,
-            cms_epochs,
-            cms_idx,
-            jnp.where(p_add, pslots_f, cfg.max_param_rules),
-            acq.param_hash[item_p],
-            jnp.where(p_add, acq.count[item_p], 0),
-            cfg.max_param_rules,
+        adm = _fan(passed, KP)
+        pcms = P.add(
+            pcms,
+            pcms_idx,
+            jnp.where((p_qps_add & adm)[:, None], prows, -1),
+            _fan(acq.count, KP),
+            cfg,
         )
-        state = state._replace(cms=cms, cms_epochs=cms_epochs)
+        thread_mask = p_thread_add & adm
+        pconc = jax.lax.cond(
+            jnp.any(thread_mask),
+            lambda: P.conc_add(
+                cfg,
+                state.pconc,
+                jnp.where(thread_mask[:, None], prows, -1),
+                _fan(acq.count, KP),
+                jnp.zeros_like(_fan(acq.count, KP)),
+            ),
+            lambda: state.pconc,
+        )
+        state = state._replace(pcms=pcms, pcms_epochs=pcms_epochs, pconc=pconc)
 
     return state, TickOutput(verdict=verdict, wait_ms=wait_ms)
 
@@ -1193,12 +1422,19 @@ def compile_ruleset(
     param_rules=(),
     authority_rules=(),
     system_rules=(),
+    param_lanes=None,
 ) -> RuleSet:
-    """Host-side: compile rule objects into a device-resident RuleSet."""
+    """Host-side: compile rule objects into a device-resident RuleSet.
+
+    ``param_lanes``: optional resource -> ordered param_idx list from
+    rule_tensors.param_lanes — pass the host client's map so engine lanes
+    match the hashes the client computes per entry."""
     rs = RuleSet(
         flow=RT.compile_flow_rules(list(flow_rules), cfg, registry),
         degrade=RT.compile_degrade_rules(list(degrade_rules), cfg, registry),
-        param=RT.compile_param_rules(list(param_rules), cfg, registry),
+        param=RT.compile_param_rules(
+            list(param_rules), cfg, registry, lanes=param_lanes
+        ),
         auth=RT.compile_authority_rules(list(authority_rules), cfg, registry),
         system=RT.compile_system_rules(list(system_rules), cfg),
     )

@@ -1,0 +1,407 @@
+"""Pallas TPU kernels for the engine's table primitives.
+
+The matmul formulation in ops/mxu_table.py is MXU-correct but pays HBM for
+every intermediate: each scatter/gather materializes [B, n_lo] one-hot and
+one-hot*value tensors (~134 MB each at B=128K), and a tick makes ~15 such
+calls — the measured round-1 tick was memory-bound on exactly this traffic
+plus per-op XLA overhead (benchmarks/profile_prims.py: every table op
+~0.5-0.9 ms regardless of FLOPs).
+
+These kernels keep the same math — two-level one-hot contraction,
+    row id r = hi * n_lo + lo
+    scatter:  out[hi, lo] += sum_b Hi[b,hi] * Lo[b,lo] * v[b]
+    gather:   out[b] = rowsum((Hi @ table[hi]) * Lo)
+— but build Hi/Lo tiles in VMEM per block and never write them to HBM.
+
+Precision scheme (measured on v5e): Mosaic lowers a DEFAULT-precision f32
+dot to ONE bf16 pass — exact only for integer operands <= 256.  So integer
+payloads are decomposed into base-256 digit planes (each exact at the full
+bf16 MXU rate, same trick as ops/mxu_table.py) and recombined after the
+contraction, while genuinely-float payloads use Precision.HIGHEST (6-pass
+bf16, exact for f32 products with a 0/1 one-hot side).
+
+Reference analog: none — this layer replaces the per-request LongAdder /
+ConcurrentHashMap machinery (StatisticSlot.java, ParameterMetric.java) with
+batched device kernels; cited call sites live in ops/engine.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_TB = 2048  # items per grid step for scatter/gather
+#: rank kernel chunk: the [C, C] same-key mask is C^2 f32 in VMEM
+_TB_RANK = 1024
+
+_DEFAULT = jax.lax.Precision.DEFAULT  # one bf16 pass on Mosaic
+_HIGHEST = jax.lax.Precision.HIGHEST  # six bf16 passes — f32-exact
+
+
+@functools.cache
+def available() -> bool:
+    """Pallas TPU kernels need a real TPU backend (Mosaic)."""
+    import os
+
+    if os.environ.get("SENTINEL_NO_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _plan(n: int, n_lo: int = 512):
+    n_lo = min(n_lo, max(128, n))
+    n_lo = max(n_lo, 128)
+    n_hi = max((n + n_lo - 1) // n_lo, 1)
+    return n_hi, n_lo
+
+
+def _ndigits(max_int: int) -> int:
+    return max(1, (int(max_int).bit_length() + 7) // 8)
+
+
+def _pad_to(x, m, fill):
+    pad = (-x.shape[0]) % m
+    if pad:
+        fill_arr = jnp.full((pad,) + x.shape[1:], fill, x.dtype)
+        x = jnp.concatenate([x, fill_arr])
+    return x
+
+
+def _onehots_f32(ids, ok, n_hi, n_lo):
+    # NOTE: Mosaic can't reshape 1-bit vectors to 2D, so the valid mask is
+    # widened to int32 before gaining an axis
+    safe = jnp.where(ok, ids, 0)
+    hi = safe // n_lo
+    lo = safe - hi * n_lo
+    tb = ids.shape[0]
+    oki = ok.astype(jnp.int32)[:, None]
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (tb, n_hi), 1)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (tb, n_lo), 1)
+    Hi = ((hi[:, None] == iota_h) & (oki > 0)).astype(jnp.float32)
+    Lo = (lo[:, None] == iota_l).astype(jnp.float32)
+    return Hi, Lo
+
+
+_C00 = (((0,), (0,)), ((), ()))  # [TB,A] x [TB,B] -> [A,B]
+_C10 = (((1,), (0,)), ((), ()))  # [A,TB] x [TB,B] -> [A,B]
+
+
+# ---------------------------------------------------------------------------
+# scatter-add / histogram
+# ---------------------------------------------------------------------------
+
+
+def scatter_add(
+    ids: jax.Array, values: jax.Array, n: int, max_int: int = 65535
+) -> jax.Array:
+    """Dense [n, P] histogram: out[r, p] = sum over items with id r of
+    values[item, p]; ids outside [0, n) are dropped.
+
+    Integer values ride base-256 digit planes (one DEFAULT-precision dot
+    per digit, exact); float values use one HIGHEST dot per plane.
+    Returns f32 (integer-valued when inputs are ints)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[:, None]
+    P = values.shape[1]
+    is_int = jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_
+    nd = _ndigits(max_int) if is_int else 1
+    n_hi, n_lo = _plan(n)
+    ids_p = _pad_to(ids.astype(jnp.int32), _TB, -1)
+    nT = ids_p.shape[0] // _TB
+    vals_p = _pad_to(values.astype(jnp.int32 if is_int else jnp.float32), _TB, 0)
+    ids3 = ids_p.reshape(nT, 1, _TB)
+    vals3 = vals_p.reshape(nT, _TB, P).transpose(0, 2, 1)  # [nT, P, TB]
+
+    def kernel(ids_ref, vals_ref, out_ref):
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        k = ids_ref[0, 0, :]
+        ok = (k >= 0) & (k < n)
+        Hi, Lo = _onehots_f32(k, ok, n_hi, n_lo)
+        for p in range(P):
+            if is_int:
+                v_int = vals_ref[0, p, :]
+                for d in range(nd):
+                    dig = ((v_int >> (8 * d)) & 0xFF).astype(jnp.float32)
+                    LoV = Lo * dig[:, None]
+                    out_ref[p * nd + d, :, :] += jax.lax.dot_general(
+                        Hi,
+                        LoV,
+                        _C00,
+                        preferred_element_type=jnp.float32,
+                        precision=_DEFAULT,
+                    )
+            else:
+                LoV = Lo * vals_ref[0, p, :][:, None]
+                out_ref[p, :, :] += jax.lax.dot_general(
+                    Hi,
+                    LoV,
+                    _C00,
+                    preferred_element_type=jnp.float32,
+                    precision=_HIGHEST,
+                )
+
+    PD = P * nd if is_int else P
+    out = pl.pallas_call(
+        kernel,
+        grid=(nT,),
+        in_specs=[
+            pl.BlockSpec((1, 1, _TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, P, _TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (PD, n_hi, n_lo), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((PD, n_hi, n_lo), jnp.float32),
+    )(ids3, vals3)
+    out = out.reshape(PD, n_hi * n_lo)[:, :n]
+    if is_int and nd > 1:
+        out = out.reshape(P, nd, n)
+        scale = jnp.asarray([float(1 << (8 * d)) for d in range(nd)], jnp.float32)
+        out = jnp.einsum("pdn,d->pn", out, scale)
+    out = out.T  # [n, P]
+    return out[:, 0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# gather
+# ---------------------------------------------------------------------------
+
+
+def gather(
+    ids: jax.Array, table: jax.Array, n: int, max_int: Optional[int] = None
+) -> jax.Array:
+    """out [B, P] = table[ids] with zeros for ids outside [0, n).
+
+    With ``max_int`` (nonnegative int tables; pass (1<<32)-1 to ride raw
+    bits) the table is split into base-256 digit planes outside the kernel
+    and contracted at DEFAULT precision; otherwise one HIGHEST dot per
+    plane.  Returns f32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    squeeze = table.ndim == 1
+    if squeeze:
+        table = table[:, None]
+    P = table.shape[1]
+    is_int = jnp.issubdtype(table.dtype, jnp.integer)
+    use_digits = is_int and max_int is not None
+    nd = _ndigits(max_int) if use_digits else 1
+    n_hi, n_lo = _plan(n)
+    pad_rows = n_hi * n_lo - n
+
+    if use_digits:
+        t = table.astype(jnp.int32)
+        if pad_rows:
+            t = jnp.concatenate([t, jnp.zeros((pad_rows, P), jnp.int32)])
+        u = t.astype(jnp.uint32)
+        # digit planes [n_pad, P*nd] in order d*P + p (XLA-side, fused)
+        td = jnp.concatenate(
+            [((u >> (8 * d)) & 0xFF).astype(jnp.float32) for d in range(nd)], axis=1
+        )
+        tab3 = td.T.reshape(nd * P, n_hi, n_lo)
+    else:
+        t32 = table.astype(jnp.float32)
+        if pad_rows:
+            t32 = jnp.concatenate([t32, jnp.zeros((pad_rows, P), jnp.float32)])
+        tab3 = t32.T.reshape(P, n_hi, n_lo)
+    PD = tab3.shape[0]
+
+    ids_p = _pad_to(ids.astype(jnp.int32), _TB, -1)
+    nT = ids_p.shape[0] // _TB
+    ids3 = ids_p.reshape(nT, 1, _TB)
+
+    def kernel(ids_ref, tab_ref, out_ref):
+        k = ids_ref[0, 0, :]
+        ok = (k >= 0) & (k < n)
+        Hi, Lo = _onehots_f32(k, ok, n_hi, n_lo)
+        for p in range(P):
+            if use_digits:
+                sel = jnp.zeros((_TB, n_lo), jnp.float32)
+                for d in range(nd):
+                    sel_d = jax.lax.dot_general(
+                        Hi,
+                        tab_ref[d * P + p],
+                        _C10,
+                        preferred_element_type=jnp.float32,
+                        precision=_DEFAULT,
+                    )
+                    sel = sel + sel_d * float(1 << (8 * d))
+            else:
+                sel = jax.lax.dot_general(
+                    Hi,
+                    tab_ref[p],
+                    _C10,
+                    preferred_element_type=jnp.float32,
+                    precision=_HIGHEST,
+                )
+            out_ref[0, p, :] = jnp.sum(sel * Lo, axis=1)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nT,),
+        in_specs=[
+            pl.BlockSpec((1, 1, _TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (PD, n_hi, n_lo), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, P, _TB), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((nT, P, _TB), jnp.float32),
+    )(ids3, tab3)
+    out = out.transpose(1, 0, 2).reshape(P, nT * _TB)[:, : ids.shape[0]].T  # [B, P]
+    return out[:, 0] if squeeze else out
+
+
+def gather_int(ids: jax.Array, table: jax.Array, n: int) -> jax.Array:
+    """Bit-exact int32 gather (signed payloads — hashes, absolute
+    engine-ms): the raw 32 bits split into two unsigned 16-bit half planes
+    (each f32-exact through the digit path) and recombine with integer
+    ops — a single f32 can't carry 32 bits of mantissa."""
+    shape = table.shape
+    flat = table.reshape(n, -1).astype(jnp.uint32)
+    P = flat.shape[1]
+    halves = jnp.concatenate(
+        [(flat >> 16).astype(jnp.int32), (flat & 0xFFFF).astype(jnp.int32)], axis=1
+    )  # [n, 2P]
+    g = gather(ids, halves, n, max_int=65535)
+    hi_i = jnp.round(g[:, :P]).astype(jnp.uint32)
+    lo_i = jnp.round(g[:, P:]).astype(jnp.uint32)
+    out = ((hi_i << 16) | lo_i).astype(jnp.int32)
+    return out.reshape((ids.shape[0],) + shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# grouped exclusive rank (three phases, no cross-chunk serialization)
+# ---------------------------------------------------------------------------
+
+
+def grouped_rank(
+    keys: jax.Array,
+    values: Sequence[jax.Array],
+    eligible: jax.Array,
+    key_space: int,
+) -> tuple:
+    """Grouped exclusive cumsum over a dense small key space.
+
+    For each item: sum of values of ELIGIBLE items earlier in the batch
+    with the same key.  Three phases so chunks never serialize on a shared
+    accumulator:
+      A) per-chunk per-key totals (pallas histogram, independent chunks)
+      B) exclusive prefix over the chunk axis (one triangular matmul)
+      C) per-chunk: own-offset gather + strictly-lower-triangular same-key
+         matmul (pallas, independent chunks)
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C = _TB_RANK
+    nv = len(values)
+    n_hi, n_lo = _plan(key_space)
+    keys_p = _pad_to(keys.astype(jnp.int32), C, -1)
+    b = keys.shape[0]
+    nT = keys_p.shape[0] // C
+    vals = jnp.stack(
+        [jnp.where(eligible, v.astype(jnp.float32), 0.0) for v in values], axis=1
+    )  # [B, nv]
+    vals = _pad_to(vals, C, 0.0)
+    keys3 = keys_p.reshape(nT, 1, C)
+    vals3 = vals.reshape(nT, C, nv).transpose(0, 2, 1)  # [nT, nv, C]
+
+    # --- phase A: per-chunk histograms -------------------------------------
+    def hist_kernel(keys_ref, vals_ref, out_ref):
+        k = keys_ref[0, 0, :]
+        ok = (k >= 0) & (k < key_space)
+        Hi, Lo = _onehots_f32(k, ok, n_hi, n_lo)
+        for p in range(nv):
+            LoV = Lo * vals_ref[0, p, :][:, None]
+            out_ref[0, p, :, :] = jax.lax.dot_general(
+                Hi,
+                LoV,
+                _C00,
+                preferred_element_type=jnp.float32,
+                precision=_HIGHEST,
+            )
+
+    hists = pl.pallas_call(
+        hist_kernel,
+        grid=(nT,),
+        in_specs=[
+            pl.BlockSpec((1, 1, C), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nv, C), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nv, n_hi, n_lo), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((nT, nv, n_hi, n_lo), jnp.float32),
+    )(keys3, vals3)
+
+    # --- phase B: exclusive prefix over chunks (strict lower triangular) ---
+    tril = jnp.tril(jnp.ones((nT, nT), jnp.float32), k=-1)
+    offs = jnp.matmul(tril, hists.reshape(nT, -1), precision=_HIGHEST).reshape(
+        nT, nv, n_hi, n_lo
+    )
+
+    # --- phase C: offset gather + within-chunk triangular -------------------
+    def rank_kernel(keys_ref, vals_ref, offs_ref, out_ref):
+        k = keys_ref[0, 0, :]
+        ok = (k >= 0) & (k < key_space)
+        Hi, Lo = _onehots_f32(k, ok, n_hi, n_lo)
+        iota_r = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+        iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+        oki = ok.astype(jnp.int32)
+        same = (
+            (k[:, None] == k[None, :])
+            & (iota_c < iota_r)
+            & (oki[:, None] > 0)
+            & (oki[None, :] > 0)
+        ).astype(jnp.float32)
+        v_cols = vals_ref[0].T  # [C, nv]
+        within = jax.lax.dot_general(
+            same,
+            v_cols,
+            _C10,
+            preferred_element_type=jnp.float32,
+            precision=_HIGHEST,
+        )  # [C, nv]
+        for p in range(nv):
+            sel = jax.lax.dot_general(
+                Hi,
+                offs_ref[0, p],
+                _C10,
+                preferred_element_type=jnp.float32,
+                precision=_HIGHEST,
+            )
+            out_ref[0, p, :] = jnp.sum(sel * Lo, axis=1) + within[:, p]
+
+    out = pl.pallas_call(
+        rank_kernel,
+        grid=(nT,),
+        in_specs=[
+            pl.BlockSpec((1, 1, C), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nv, C), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, nv, n_hi, n_lo), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, nv, C), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nT, nv, C), jnp.float32),
+    )(keys3, vals3, offs)
+    out = out.transpose(1, 0, 2).reshape(nv, nT * C)[:, :b]
+    return tuple(out[p] for p in range(nv))

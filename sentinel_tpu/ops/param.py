@@ -1,23 +1,35 @@
-"""Hot-parameter statistics: windowed count-min sketch.
+"""Hot-parameter statistics: hashed (rule, value) rows on a global window.
 
 The reference tracks per-parameter-value token buckets in LRU CacheMaps
 capped at 4000×duration / 200k keys per rule (ParameterMetric.java:35-118).
 That design — pointer-chasing hash maps with per-key CAS — cannot batch.
-Here each param rule owns a time-bucketed count-min sketch:
 
-    cms    : int32 [P+1, nb, depth, width]
-    epochs : int32 [P+1, nb]
+v1 here kept one small CMS *per rule* with per-rule time buckets; reading
+it required a per-item advanced-indexing gather that XLA serializes
+(~21 ms/tick at B=128K, measured).  v2 inverts the layout so every op is a
+dense contraction:
 
-Passes scatter-add into the current time bucket of the rule's sketch (one
-cell per depth row); the windowed estimate is  sum over valid time buckets
-of  min over depth.  Overestimation is bounded by the classic CMS (eps =
-e/width, delta = e^-depth) bound, so enforcement at threshold T admits at
-most T and may over-block by ~eps * window-mass — the conservative
-direction for a rate limiter.  (SALSA-style exact slots for hot keys are a
-planned refinement, see PAPERS.md.)
+    pcms   : int32 [depth, Q, nb]   windowed counts; row = hash_d(rule, value)
+    epochs : int32 [nb]             ONE global bucket grid (param_bucket_ms)
+    pconc  : int32 [depth, Q]       per-(rule,value) concurrency (THREAD grade)
 
-Bucket rotation follows the same epoch scheme as ops/window.py, but with a
-PER-RULE bucket length (rules have independent durationInSec).
+- All rules share the global bucket grid, so the current column is a single
+  dense histogram target (ops/tables.py MXU path) and stale-column reset is
+  the same epoch scheme as ops/window.py.
+- A rule's window is its ``durationInSec`` expressed in buckets
+  (win_k = duration*1000 / param_bucket_ms, capped at nb; longer durations
+  clamp to the nb-bucket window with the threshold scaled to preserve the
+  RATE — divergence documented in compile_param_rules).
+- Distinct win_k values are grouped into ≤ param_classes "duration
+  classes"; the windowed table per class is a masked sum over recent
+  buckets (elementwise), and an item reads its rule's class plane.
+- Estimates take min over depth rows — classic CMS: collisions only
+  overestimate, so enforcement over-blocks with probability bounded by
+  eps = e/Q per depth, delta = e^-depth (the conservative direction for a
+  limiter).  THREAD concurrency uses the same row structure.
+
+Reference: ParamFlowChecker.passLocalCheck:78-188 (QPS + THREAD dispatch),
+ParamFlowSlot.java:60-75 (entry/exit thread count).
 """
 
 from __future__ import annotations
@@ -26,6 +38,9 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.ops import tables as T
 
 # depth-row hash multipliers (odd constants, splitmix-ish)
 _MULTS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F, 0x165667B1, 0x9E3779B9)
@@ -46,63 +61,128 @@ def cms_cell(h: jax.Array, depth: int, width: int) -> jax.Array:
     return jnp.stack(cols, axis=-1)
 
 
-def refresh_columns(
-    cms: jax.Array,  # int32 [P+1, nb, depth, width]
-    epochs: jax.Array,  # int32 [P+1, nb]
-    window_ms: jax.Array,  # int32 [P+1] per-rule bucket length
-    now_ms: jax.Array,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Zero each rule's current time bucket if it holds an old epoch.
+def pair_rows(slots: jax.Array, hashes: jax.Array, depth: int, width: int) -> jax.Array:
+    """int32 [N, depth] — pcms row per depth for (rule slot, value hash).
 
-    Returns (cms, epochs, cur_idx[P+1]).
-    """
-    nb = cms.shape[1]
-    wid = (now_ms // jnp.maximum(window_ms, 1)).astype(jnp.int32)  # [P+1]
-    idx = wid % nb
-    onehot = jax.nn.one_hot(idx, nb, dtype=jnp.int32)  # [P+1, nb]
-    stale = (jnp.take_along_axis(epochs, idx[:, None], axis=1)[:, 0] != wid).astype(
-        jnp.int32
+    The slot is folded into the hash input so distinct rules' identical
+    values land on independent rows."""
+    mixed = hashes.astype(jnp.uint32) * jnp.uint32(0x01000193) ^ (
+        slots.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
     )
-    keep = 1 - onehot * stale[:, None]  # [P+1, nb] — 0 where a stale current bucket
-    cms = cms * keep[:, :, None, None]
-    epochs = jnp.where((onehot == 1) & (stale[:, None] == 1), wid[:, None], epochs)
-    return cms, epochs, idx
+    return cms_cell(mixed.astype(jnp.int32), depth, width)
+
+
+def _wid(now_ms, cfg: EngineConfig):
+    return (now_ms // cfg.param_bucket_ms).astype(jnp.int32)
+
+
+def refresh(
+    pcms: jax.Array, epochs: jax.Array, now_ms, cfg: EngineConfig
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Zero the global current bucket if stale; returns (pcms, epochs, idx)."""
+    nb = cfg.param_sample_count
+    wid = _wid(now_ms, cfg)
+    idx = wid % nb
+    stale = epochs[idx] != wid
+
+    def reset(args):
+        p, e = args
+        return p.at[:, :, idx].set(0), e.at[idx].set(wid)
+
+    pcms, epochs = jax.lax.cond(stale, reset, lambda a: a, (pcms, epochs))
+    return pcms, epochs, idx
+
+
+def class_tables(
+    pcms: jax.Array,  # [depth, Q, nb] — already refreshed
+    epochs: jax.Array,  # [nb]
+    class_k: jax.Array,  # int32 [C] — window length in buckets per class
+    now_ms,
+    cfg: EngineConfig,
+) -> jax.Array:
+    """f32 [depth, Q, C]: windowed totals per duration class.
+
+    Class c sums buckets whose epoch lies in (wid - k_c, wid] — the k_c
+    most recent grid positions (masked elementwise; stale columns excluded
+    by their epoch, identical to ops/window.py validity)."""
+    wid = _wid(now_ms, cfg)
+    # [C, nb] validity masks
+    valid = (epochs[None, :] > wid - class_k[:, None]) & (epochs[None, :] <= wid)
+    return jnp.einsum(
+        "dqb,cb->dqc",
+        pcms.astype(jnp.float32),
+        valid.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
 
 
 def estimate(
-    cms: jax.Array,  # int32 [P+1, nb, depth, width]
-    epochs: jax.Array,  # int32 [P+1, nb]
-    window_ms: jax.Array,  # int32 [P+1]
-    slots: jax.Array,  # int32 [N] rule slot per query
-    hashes: jax.Array,  # int32 [N]
-    now_ms: jax.Array,
+    cfg: EngineConfig,
+    wtab: jax.Array,  # [depth, Q, C] from class_tables
+    rows: jax.Array,  # [N, depth] from pair_rows
+    cls: jax.Array,  # int32 [N] — rule's duration class per item
 ) -> jax.Array:
-    """float32 [N] — windowed CMS estimate for (rule, value) pairs."""
-    nb, depth, width = cms.shape[1], cms.shape[2], cms.shape[3]
-    cols = cms_cell(hashes, depth, width)  # [N, depth]
-    # gather [N, nb, depth]
-    vals = cms[slots[:, None, None], jnp.arange(nb)[None, :, None], jnp.arange(depth)[None, None, :], cols[:, None, :]]
-    per_bucket = jnp.min(vals, axis=2)  # [N, nb] min over depth
-    wid = (now_ms // jnp.maximum(window_ms[slots], 1)).astype(jnp.int32)  # [N]
-    valid = (epochs[slots] > (wid[:, None] - nb)) & (epochs[slots] <= wid[:, None])
-    return jnp.sum(jnp.where(valid, per_bucket, 0), axis=1).astype(jnp.float32)
+    """f32 [N] — windowed CMS estimate (min over depth) for each item."""
+    C = wtab.shape[2]
+    # class selection as a tiny one-hot contraction — take_along_axis lowers
+    # to a serialized per-item gather on TPU
+    cls_oh = (
+        jnp.clip(cls, 0, C - 1)[:, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+    ).astype(jnp.float32)
+    ests = []
+    for d in range(wtab.shape[0]):
+        g = T.big_gather(
+            cfg,
+            wtab[d].astype(jnp.int32),
+            rows[:, d],
+            cfg.param_width,
+            max_int=(1 << 24) - 1,
+        )  # [N, C]
+        ests.append(jnp.sum(g.astype(jnp.float32) * cls_oh, axis=1))
+    return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
+
+
+def conc_estimate(
+    cfg: EngineConfig, pconc: jax.Array, rows: jax.Array
+) -> jax.Array:
+    """f32 [N] — current concurrency estimate (min over depth)."""
+    ests = []
+    for d in range(pconc.shape[0]):
+        g = T.big_gather(
+            cfg, pconc[d], rows[:, d], cfg.param_width, max_int=(1 << 24) - 1
+        )
+        ests.append(g)
+    return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
 
 
 def add(
-    cms: jax.Array,
-    epochs: jax.Array,  # already refreshed this tick
-    cur_idx: jax.Array,  # int32 [P+1] current bucket per rule
-    slots: jax.Array,  # int32 [N] (trash slot P for no-op)
-    hashes: jax.Array,  # int32 [N]
+    pcms: jax.Array,  # [depth, Q, nb] — refreshed this tick
+    cur_idx,  # int32 — global current bucket
+    rows: jax.Array,  # [N, depth]
     counts: jax.Array,  # int32 [N] (0 for no-op)
-    trash_slot: int,
+    cfg: EngineConfig,
 ) -> jax.Array:
-    """Scatter-add counts into each rule's current time bucket."""
-    depth, width = cms.shape[2], cms.shape[3]
-    cols = cms_cell(hashes, depth, width)  # [N, depth]
-    bidx = cur_idx[slots]  # [N]
-    safe_slots = jnp.minimum(slots, trash_slot)
-    d_idx = jnp.broadcast_to(jnp.arange(depth)[None, :], cols.shape)
-    return cms.at[
-        safe_slots[:, None], bidx[:, None], d_idx, cols
-    ].add(counts[:, None], mode="drop")
+    """Histogram admitted counts into every depth row of the current bucket."""
+    for d in range(pcms.shape[0]):
+        hist = T.histogram(cfg, rows[:, d], counts, cfg.param_width)
+        pcms = pcms.at[d, :, cur_idx].add(hist.astype(pcms.dtype))
+    return pcms
+
+
+def conc_add(
+    cfg: EngineConfig,
+    pconc: jax.Array,  # [depth, Q]
+    rows: jax.Array,  # [N, depth]
+    inc: jax.Array,  # int32 [N] nonnegative acquire counts (0 no-op)
+    dec: jax.Array,  # int32 [N] nonnegative release counts (0 no-op)
+) -> jax.Array:
+    """Apply concurrency deltas; clamped at zero (releases may race ahead
+    of their acquires across host restarts, like curThreadNum clamps).
+    Increments and decrements ride separate nonnegative histograms — the
+    MXU digit planes assume unsigned payloads."""
+    for d in range(pconc.shape[0]):
+        delta = jnp.stack([inc, dec], axis=1)
+        hist = T.histogram(cfg, rows[:, d], delta, cfg.param_width, max_int=65535)
+        pconc = pconc.at[d].add((hist[:, 0] - hist[:, 1]).astype(pconc.dtype))
+    return jnp.maximum(pconc, 0)

@@ -107,7 +107,7 @@ def grouped_exclusive_cumsum_small(
     values: Sequence[jax.Array],
     eligible: jax.Array,
     key_space: int,
-    chunk: int = 2048,
+    chunk: int = 4096,
 ) -> Tuple[jax.Array, ...]:
     """grouped_exclusive_cumsum for a SMALL dense key space — sort-free.
 
@@ -115,12 +115,14 @@ def grouped_exclusive_cumsum_small(
     - cross-chunk: per-chunk per-key totals via one-hot matmul histograms
       [C, key_space], exclusive-prefixed along the chunk axis; each item
       reads its chunk's offset for its key (one-hot dot).
-    - within-chunk: lower-triangular same-key matmul (chunk × chunk), one
-      chunk at a time under lax.scan so the mask never exceeds one chunk.
+    - within-chunk: lower-triangular same-key matmul (chunk × chunk).
+
+    Both levels run under jax.vmap — batched matmuls across all chunks at
+    once.  (lax.map serializes the chunk loop and costs ~2.3 ms vs ~1.0 ms
+    vmapped at B=128K, S=33K, measured on v5e.)
 
     Exact (modulo f32 accumulation order), O(B·key_space + B·chunk) MACs —
-    on TPU this replaces a ~N log N sort network whose cost dominates the
-    tick (measured ~12 ms for 131k items vs ~1 ms here)."""
+    on TPU this replaces a ~N log N sort network."""
     from sentinel_tpu.ops import mxu_table as MX
 
     n = keys.shape[0]
@@ -145,20 +147,19 @@ def grouped_exclusive_cumsum_small(
     vc = jnp.stack([v.reshape(C, chunk) for v in vals_p], axis=-1)  # [C, chunk, nv]
     plan = MX.make_plan(key_space, 512)
 
-    def hist_chunk(args):
-        k, v = args
+    def hist_chunk(k, v):
         Hi, Lo = MX.onehots(k, plan)
         return MX.scatter_add(
             jnp.zeros((key_space, nv), jnp.float32), plan, Hi, Lo, v
         )  # [S, nv]
 
-    hists = jax.lax.map(hist_chunk, (kc, vc))  # [C, S, nv]
+    hists = jax.vmap(hist_chunk)(kc, vc)  # [C, S, nv]
     offsets = jnp.cumsum(hists, axis=0) - hists  # exclusive per-chunk offsets
 
     tri = jnp.tril(jnp.ones((chunk, chunk), jnp.bfloat16), k=-1)
 
-    def chunk_rank(args):
-        k, v, off = args  # [chunk], [chunk, nv], [S, nv]
+    def chunk_rank(k, v, off):
+        # k [chunk], v [chunk, nv], off [S, nv]
         Hi, Lo = MX.onehots(k, plan)
         base = MX.gather(off, plan, Hi, Lo)  # [chunk, nv] f32-exact
         # within-chunk: exact same-key mask, strictly-earlier triangular
@@ -168,7 +169,7 @@ def grouped_exclusive_cumsum_small(
         )
         return base + within
 
-    ranks = jax.lax.map(chunk_rank, (kc, vc, offsets))  # [C, chunk, nv]
+    ranks = jax.vmap(chunk_rank)(kc, vc, offsets)  # [C, chunk, nv]
     ranks = ranks.reshape(C * chunk, nv)[:n]
     return tuple(ranks[:, j] for j in range(nv))
 

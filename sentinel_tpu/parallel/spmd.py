@@ -64,8 +64,11 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
         cb_retry_ms=rep,
         cb_counts=rep,
         cb_epochs=rep,
-        cms=rep,
-        cms_epochs=rep,
+        # the hashed param store shards on its row axis (pcms [depth, Q, nb],
+        # pconc [depth, Q]) — per-(rule,value) budgets scale with chips
+        pcms=NamedSharding(mesh, PS(None, "res", None)),
+        pcms_epochs=rep,
+        pconc=NamedSharding(mesh, PS(None, "res")),
         # the global sketch shards on its width axis (counts [nb, depth,
         # width, planes]) so tail-resource observability scales with chips;
         # with the sketch off the state is a unit dummy — replicate it

@@ -58,7 +58,7 @@ class AcquireRequest:
     ctx_node: int
     ctx_name: int
     inbound: int
-    param_hash: int
+    param_hash: tuple  # param_dims hashed hot-param lanes (0 = none)
     pre_verdict: int = 0  # host-decided verdict (cluster denial) to record
     future: Optional[Future] = None
 
@@ -72,6 +72,7 @@ class Completion:
     rt: float
     success: int
     error: int
+    param_hash: tuple = ()  # THREAD-grade release lanes
 
 
 class Entry:
@@ -91,11 +92,12 @@ class Entry:
         "count",
         "create_ms",
         "wait_ms",
+        "param_hash",
         "_errors",
         "_exited",
     )
 
-    def __init__(self, client, resource, res, origin_node, ctx_node, inbound, count, create_ms, wait_ms=0):
+    def __init__(self, client, resource, res, origin_node, ctx_node, inbound, count, create_ms, wait_ms=0, param_hash=()):
         self.client = client
         self.resource = resource
         self.res = res
@@ -105,6 +107,7 @@ class Entry:
         self.count = count
         self.create_ms = create_ms
         self.wait_ms = wait_ms
+        self.param_hash = param_hash
         self._errors = 0
         self._exited = False
 
@@ -135,6 +138,7 @@ class Entry:
                 rt=rt,
                 success=count if count is not None else self.count,
                 error=self._errors,
+                param_hash=self.param_hash,
             )
         )
 
@@ -246,7 +250,7 @@ class SentinelClient:
         self.cluster = None  # Optional[ClusterStateManager]
         self._cluster_flow_by_res: Dict[str, R.FlowRule] = {}
         self._cluster_param_by_res: Dict[str, R.ParamFlowRule] = {}
-        self._param_idx_by_res: Dict[str, int] = {}
+        self._param_lanes_by_res: Dict[str, list] = {}
         self._cluster_degraded_active = False
         self._cluster_degraded_until = 0.0
         # guards degrade-state transitions AND every ruleset recompile, so
@@ -355,18 +359,21 @@ class SentinelClient:
         local_param = [r for r in param if not r.cluster_mode]
         cluster_param = [r for r in param if r.cluster_mode]
         self._cluster_param_by_res = {r.resource: r for r in cluster_param}
-        # one param index per resource drives the host-side hash, so healthy
-        # (token-service) and degraded (local-engine) modes key off the SAME
-        # argument.  Gateway rules win on shared resources: gateway traffic
-        # supplies the (short) parsed gateway vector as args, and a user
-        # rule's larger param_idx would index past it, zeroing the hash and
-        # disabling param checks entirely for those entries.
-        idx_map: Dict[str, int] = {}
-        for r in self.gateway_param_rules.get():
-            idx_map.setdefault(r.resource, r.param_idx)
-        for r in param:
-            idx_map.setdefault(r.resource, r.param_idx)
-        self._param_idx_by_res = idx_map
+        # per-resource hash LANES: each entry hashes up to param_dims
+        # distinct argument indices; every rule reads the lane its
+        # param_idx was assigned (ParamFlowChecker.java:78 paramIdx
+        # dispatch).  Gateway rules claim lanes first on shared resources:
+        # gateway traffic supplies the (short) parsed gateway vector as
+        # args, and a user rule's larger param_idx would index past it.
+        # Lane 0 also feeds the cluster token request, so healthy
+        # (token-service) and degraded (local-engine) modes throttle the
+        # same argument.
+        from sentinel_tpu.core.rule_tensors import param_lanes
+
+        lane_map = param_lanes(
+            param, self.cfg.param_dims, priority=self.gateway_param_rules.get()
+        )
+        self._param_lanes_by_res = lane_map
 
         if self._cluster_degraded_active:
             local_flow += [r for r in cluster_flow if r.cluster_fallback_to_local]
@@ -381,6 +388,7 @@ class SentinelClient:
                 param_rules=local_param,
                 authority_rules=self.authority_rules.get(),
                 system_rules=self.system_rules.get(),
+                param_lanes=lane_map,
             )
 
     # -- cluster consultation -----------------------------------------------
@@ -621,18 +629,21 @@ class SentinelClient:
             ctx_node = self.cfg.trash_row
             ctx_id = -1
 
-        param_hash = 0
+        M = self.cfg.param_dims
+        param_hashes = [0] * M
         param_value = None
         if args:
-            # hot-param limiting keys off the rule's param index
-            # (ParamFlowRule.paramIdx); same index feeds both the engine
-            # hash and the cluster token request so healthy and degraded
-            # modes throttle the same argument
-            idx = self._param_idx_by_res.get(resource, 0)
-            if 0 <= idx < len(args):
-                param_value = args[idx]
-                param_hash = hash_param(param_value)
-                self._note_hot_param(resource, param_value)
+            # hash one argument per assigned lane (rule param_idx -> lane
+            # mapping from rule_tensors.param_lanes); lane 0's value also
+            # feeds the cluster token request
+            lanes = self._param_lanes_by_res.get(resource) or [0]
+            for li, idx in enumerate(lanes[:M]):
+                if 0 <= idx < len(args):
+                    v = args[idx]
+                    param_hashes[li] = hash_param(v)
+                    if li == 0:
+                        param_value = v
+                    self._note_hot_param(resource, v)
 
         pre_verdict, cluster_wait = 0, 0
         if hook_exc is not None:
@@ -655,7 +666,7 @@ class SentinelClient:
             ctx_node=ctx_node,
             ctx_name=ctx_id,
             inbound=1 if inbound else 0,
-            param_hash=param_hash,
+            param_hash=tuple(param_hashes),
             pre_verdict=pre_verdict,
             future=Future(),
         )
@@ -694,6 +705,7 @@ class SentinelClient:
             count,
             self.time.now_ms(),
             wait_ms,
+            tuple(param_hashes),
         )
         if _push_ctx:
             CTX.push_entry(e)
@@ -835,7 +847,9 @@ class SentinelClient:
                     ctx_node=self.cfg.trash_row,
                     ctx_name=-1,
                     inbound=1 if inbound else 0,
-                    param_hash=hash_param(pv) if pv is not None else 0,
+                    param_hash=(hash_param(pv),) + (0,) * (self.cfg.param_dims - 1)
+                    if pv is not None
+                    else (0,) * self.cfg.param_dims,
                     pre_verdict=pre_verdicts[i],
                     future=Future(),
                 )
@@ -858,6 +872,7 @@ class SentinelClient:
     def _submit_completion(self, c: Completion) -> None:
         from sentinel_tpu.native.ring import FLAG_COMPLETION, FLAG_INBOUND
 
+        ph = tuple(c.param_hash) + (0, 0)
         ok = self._comp_ring.push(
             res=c.res,
             count=c.success,
@@ -866,6 +881,8 @@ class SentinelClient:
             flags=FLAG_COMPLETION | (FLAG_INBOUND if c.inbound else 0),
             rt_ms=c.rt,
             error=c.error,
+            aux0=ph[0],
+            aux1=ph[1],
         )
         if not ok:
             with self._lock:
@@ -919,7 +936,9 @@ class SentinelClient:
                             zip(
                                 *[
                                     (s.res, s.success, s.origin_node, s.ctx_node,
-                                     4 | (1 if s.inbound else 0), s.rt, s.error, 0)
+                                     4 | (1 if s.inbound else 0), s.rt, s.error, 0,
+                                     (tuple(s.param_hash) + (0, 0))[0],
+                                     (tuple(s.param_hash) + (0, 0))[1])
                                     for s in spill
                                 ]
                             ),
@@ -947,6 +966,7 @@ class SentinelClient:
     ) -> None:
         cfg = self.cfg
         B, B2 = cfg.batch_size, cfg.complete_batch_size
+        M = cfg.param_dims
         trash = cfg.trash_row
 
         a = E.empty_acquire(cfg)
@@ -964,14 +984,23 @@ class SentinelClient:
                 ctx_node=jnp.asarray(arr("ctx_node", trash, np.int32)),
                 ctx_name=jnp.asarray(arr("ctx_name", -1, np.int32)),
                 inbound=jnp.asarray(arr("inbound", 0, np.int32)),
-                param_hash=jnp.asarray(arr("param_hash", 0, np.int32)),
+                param_hash=jnp.asarray(
+                    np.asarray(
+                        [
+                            (tuple(r.param_hash) + (0,) * M)[:M]
+                            for r in acq
+                        ]
+                        + [(0,) * M] * (B - n),
+                        dtype=np.int32,
+                    )
+                ),
                 pre_verdict=jnp.asarray(arr("pre_verdict", 0, np.int32)),
             )
         c = E.empty_complete(cfg)
         if comp is not None:
             from sentinel_tpu.native.ring import FLAG_INBOUND
 
-            res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag = comp
+            res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag, aux0_a, aux1_a = comp
             n = len(res_a)
 
             def pad(a, fill, dt):
@@ -979,6 +1008,10 @@ class SentinelClient:
                 out[:n] = a
                 return jnp.asarray(out)
 
+            ph_np = np.zeros((B2, M), dtype=np.int32)
+            ph_np[:n, 0] = aux0_a
+            if M > 1:
+                ph_np[:n, 1] = aux1_a
             c = E.CompleteBatch(
                 res=pad(res_a, trash, np.int32),
                 origin_node=pad(org_a, trash, np.int32),
@@ -987,6 +1020,7 @@ class SentinelClient:
                 rt=pad(rt_a, 0.0, np.float32),
                 success=pad(cnt_a, 0, np.int32),
                 error=pad(err_a, 0, np.int32),
+                param_hash=jnp.asarray(ph_np),
             )
 
         load, cpu = self._sys.sample()

@@ -52,9 +52,18 @@ def _workload(cfg, reg, seed):
         ),
         inbound=jnp.asarray((rng.random(b) < 0.5).astype(np.int32)),
         param_hash=jnp.asarray(
-            np.array(
-                [hash_param(f"v{i % 3}") if r == 7 else 0 for i, r in enumerate(res)],
-                dtype=np.int32,
+            np.stack(
+                [
+                    np.array(
+                        [
+                            hash_param(f"v{i % 3}") if r == 7 else 0
+                            for i, r in enumerate(res)
+                        ],
+                        dtype=np.int32,
+                    )
+                ]
+                + [np.zeros(b, np.int32)] * (cfg.param_dims - 1),
+                axis=1,
             )
         ),
     )

@@ -55,6 +55,51 @@ def test_param_flow_burst(client, vt):
     assert got == 5  # count*duration + burst
 
 
+def test_param_flow_thread_grade(client, vt):
+    """GRADE_THREAD param rules bound per-VALUE concurrency and release on
+    exit (ParamFlowChecker.passLocalCheck THREAD branch,
+    ParamFlowSlot.exit decreaseThreadCount)."""
+    client.param_flow_rules.load(
+        [st.ParamFlowRule(resource="papi", count=2, grade=st.GRADE_THREAD)]
+    )
+    e1 = client.try_entry("papi", args=["k"])
+    e2 = client.try_entry("papi", args=["k"])
+    assert e1 and e2
+    # third concurrent holder of value "k" is rejected...
+    assert client.try_entry("papi", args=["k"]) is None
+    # ...but another value has its own concurrency budget
+    e3 = client.try_entry("papi", args=["other"])
+    assert e3
+    # releasing one "k" holder frees a slot
+    e1.exit()
+    e4 = client.try_entry("papi", args=["k"])
+    assert e4
+    for e in (e2, e3, e4):
+        e.exit()
+
+
+def test_param_flow_multi_index(client, vt):
+    """Two rules with different paramIdx on one resource enforce their own
+    argument lanes (ParamFlowChecker.java:78 paramIdx dispatch)."""
+    client.param_flow_rules.load(
+        [
+            st.ParamFlowRule(resource="mapi", count=50, param_idx=0),
+            st.ParamFlowRule(resource="mapi", count=2, param_idx=1),
+        ]
+    )
+    # distinct idx-0 values keep rule 0 out of the way; idx-1 value "y" is
+    # capped at 2 by the second rule
+    got = sum(
+        1 for i in range(6) if client.try_entry("mapi", args=[f"x{i}", "y"])
+    )
+    assert got == 2
+    # a fresh idx-1 value has its own budget even under one idx-0 value
+    got2 = sum(
+        1 for i in range(6) if client.try_entry("mapi", args=["x0", f"z{0}"])
+    )
+    assert got2 == 2
+
+
 # ---------------- system rules ----------------
 
 
