@@ -15,11 +15,12 @@ The analog of sentinel-api-gateway-adapter-common (1,914 LoC):
 - ``ApiDefinitionManager`` matches request paths to custom API groups
   (api/ApiDefinition + matchers), the GatewayApiMatcherManager analog.
 
-Engine note: the TPU engine hashes ONE parameter per entry (the batch
-carries a single param_hash lane), so the first gateway rule's key drives
-local enforcement per resource; additional keyed rules on the same
-resource share that key.  Cluster-mode gateway rules key off the same
-parameter via the token service.
+Engine note: each entry carries ``EngineConfig.param_dims`` hashed
+argument lanes (rule_tensors.param_lanes assigns lanes per resource,
+gateway rules first).  The first ``param_dims`` DISTINCT param indices on
+a resource get independent enforcement; rules whose index loses the lane
+assignment are not enforced and log a warning at compile.  Lane 0's value
+also feeds cluster-mode token requests.
 """
 
 from __future__ import annotations

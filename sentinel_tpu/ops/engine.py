@@ -553,17 +553,24 @@ def _process_completions(
     Dn1 = cfg.max_degrade_rules + 1
     flat = safe_slots * nbd + g_idx
     cb_counts = T.small_scatter_add(
-        cfg, cb_counts.reshape(Dn1 * nbd, 3), flat, upd
+        cfg, cb_counts.reshape(Dn1 * nbd, 3), flat, upd, max_int=1
     ).reshape(Dn1, nbd, 3)
 
     # --- half-open probe resolution (AbstractCircuitBreaker.java:68-136) --
     half_open = dg[:, 4].astype(jnp.int32) == D.CB_HALF_OPEN
     probe_done = active & half_open
     probe_fail = probe_done & (is_err | is_slow)
-    seen = T.small_scatter_or(cfg, jnp.zeros((Dn1,), jnp.int32), safe_slots, probe_done)
-    failed = T.small_scatter_or(
-        cfg, jnp.zeros((Dn1,), jnp.int32), safe_slots, probe_fail
+    # one fused 2-plane 0/1 histogram for both probe flags
+    sf = T.small_scatter_add(
+        cfg,
+        jnp.zeros((Dn1, 2), jnp.int32),
+        safe_slots,
+        jnp.stack(
+            [probe_done.astype(jnp.int32), probe_fail.astype(jnp.int32)], axis=1
+        ),
+        max_int=1,
     )
+    seen, failed = sf[:, 0], sf[:, 1]
     was_half = state.cb_state == D.CB_HALF_OPEN
     to_open = was_half & (seen > 0) & (failed > 0)
     to_close = was_half & (seen > 0) & (failed == 0)

@@ -20,9 +20,12 @@ bf16 MXU rate, same trick as ops/mxu_table.py) and recombined after the
 contraction, while genuinely-float payloads use Precision.HIGHEST (6-pass
 bf16, exact for f32 products with a 0/1 one-hot side).
 
-Reference analog: none — this layer replaces the per-request LongAdder /
-ConcurrentHashMap machinery (StatisticSlot.java, ParameterMetric.java) with
-batched device kernels; cited call sites live in ops/engine.py.
+STATUS: experimental — NOT wired into the engine.  Measured on v5e
+(benchmarks/check_pallas.py, benchmarks/profile_prims.py), the per-call
+Mosaic overhead and 6-pass HIGHEST dots make these LOSE to the XLA matmul
+path (ops/mxu_table.py) at the engine's shapes; they are kept as the
+starting point for a future fused multi-op megakernel, which is the only
+formulation where pallas wins.  Only benchmarks import this module.
 """
 
 from __future__ import annotations

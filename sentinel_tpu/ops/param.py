@@ -131,10 +131,16 @@ def estimate(
         == jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
     ).astype(jnp.float32)
     ests = []
+    cap = jnp.int32((1 << 24) - 1)
     for d in range(wtab.shape[0]):
+        # saturate at 2^24-1 before the digit-plane gather: values beyond
+        # would WRAP (dropping high bits) and flip the CMS overestimate
+        # into an underestimate; saturation keeps enforcement conservative
+        # for any threshold below ~16.7M-per-window (thresholds above that
+        # cannot trip and are documented as unenforceable)
         g = T.big_gather(
             cfg,
-            wtab[d].astype(jnp.int32),
+            jnp.minimum(wtab[d].astype(jnp.int32), cap),
             rows[:, d],
             cfg.param_width,
             max_int=(1 << 24) - 1,
@@ -148,9 +154,14 @@ def conc_estimate(
 ) -> jax.Array:
     """f32 [N] — current concurrency estimate (min over depth)."""
     ests = []
+    cap = jnp.int32((1 << 24) - 1)
     for d in range(pconc.shape[0]):
         g = T.big_gather(
-            cfg, pconc[d], rows[:, d], cfg.param_width, max_int=(1 << 24) - 1
+            cfg,
+            jnp.minimum(pconc[d], cap),
+            rows[:, d],
+            cfg.param_width,
+            max_int=(1 << 24) - 1,
         )
         ests.append(g)
     return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)

@@ -160,10 +160,12 @@ def small_gather_int(cfg: EngineConfig, table: jax.Array, slots: jax.Array) -> j
 
 
 def small_scatter_add(
-    cfg: EngineConfig, table: jax.Array, slots: jax.Array, values: jax.Array
+    cfg: EngineConfig, table: jax.Array, slots: jax.Array, values: jax.Array,
+    max_int: int = 65535,
 ) -> jax.Array:
     """table [S, ...planes] .at[slots].add(values) — one-hot matmul on MXU.
-    Out-of-range slots are dropped."""
+    Out-of-range slots are dropped.  ``max_int`` bounds integer VALUES for
+    the digit decomposition (pass 1 for 0/1 flags — one bf16 plane)."""
     S = table.shape[0]
     if not cfg.use_mxu_tables:
         return table.at[jnp.where((slots >= 0) & (slots < S), slots, 2**30)].add(
@@ -173,7 +175,7 @@ def small_scatter_add(
     if S > _FLAT_ONEHOT_LIMIT:
         plan = MX.make_plan(S, min(cfg.mxu_n_lo, 128))
         Hi, Lo = MX.onehots(slots, plan, valid=ok)
-        return MX.scatter_add(table, plan, Hi, Lo, values)
+        return MX.scatter_add(table, plan, Hi, Lo, values, max_int=max_int)
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
     onehot = ((jnp.where(ok, slots, 0)[:, None] == iota) & ok[:, None]).astype(
         jnp.float32
@@ -192,9 +194,11 @@ def small_scatter_add(
 def small_scatter_or(
     cfg: EngineConfig, table: jax.Array, slots: jax.Array, flag: jax.Array
 ) -> jax.Array:
-    """Boolean OR-scatter into [S] (0/1 semantics)."""
+    """Boolean OR-scatter into [S] (0/1 semantics) — rides a single-digit
+    integer histogram (flags are 0/1)."""
     hist = small_scatter_add(
-        cfg, jnp.zeros(table.shape, jnp.float32), slots, flag.astype(jnp.float32)
+        cfg, jnp.zeros(table.shape, jnp.int32), slots, flag.astype(jnp.int32),
+        max_int=1,
     )
     return (table.astype(jnp.bool_) | (hist > 0)).astype(table.dtype)
 
