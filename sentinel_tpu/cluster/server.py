@@ -200,11 +200,17 @@ class ClusterTokenServer:
                         )
                         continue
                     # one task per request: pipelined requests on a single
-                    # connection run concurrently in the pool so they
-                    # coalesce into engine micro-batches (xid correlation
-                    # makes out-of-order replies safe); awaiting inline
-                    # would serialize a connection at one tick per request
-                    loop.create_task(self._process_and_reply(req, writer))
+                    # connection run concurrently so they coalesce into
+                    # engine micro-batches (xid correlation makes
+                    # out-of-order replies safe); awaiting inline would
+                    # serialize a connection at one tick per request.
+                    # FLOW requests take the fully-async path (a queued
+                    # future, no worker thread) so in-flight count is
+                    # unbounded; other types go through the worker pool.
+                    if req.type == C.MSG_TYPE_FLOW:
+                        loop.create_task(self._flow_and_reply(req, writer))
+                    else:
+                        loop.create_task(self._process_and_reply(req, writer))
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
@@ -224,6 +230,37 @@ class ClusterTokenServer:
     ) -> None:
         loop = asyncio.get_running_loop()
         rsp = await loop.run_in_executor(self._pool, self._process, req)
+        try:
+            writer.write(P.encode_response(rsp))
+            await writer.drain()
+        except (ConnectionResetError, OSError):
+            pass  # peer vanished mid-reply
+
+    async def _flow_and_reply(
+        self, req: P.ClusterRequest, writer: asyncio.StreamWriter
+    ) -> None:
+        """Thread-free token grant: request_token_async queues the acquire
+        into the decision engine's next micro-batch and the reply writes
+        when its future resolves — no per-request worker, so the in-flight
+        ceiling is the engine batch size, not the pool size."""
+        try:
+            fut = self.service.request_token_async(
+                req.flow_id, req.count, req.priority
+            )
+            # bounded wait: a wedged engine must produce STATUS_FAIL, not a
+            # silently hung connection (the worker-pool path got this from
+            # check_batch's entry timeout)
+            r = await asyncio.wait_for(
+                asyncio.wrap_future(fut),
+                timeout=self.service.client.entry_timeout_s + 1.0,
+            )
+            rsp = P.ClusterResponse(
+                req.xid, req.type, r.status, remaining=r.remaining,
+                wait_ms=r.wait_ms,
+            )
+        except Exception:
+            record_log().exception("token request failed")
+            rsp = P.ClusterResponse(req.xid, req.type, C.STATUS_FAIL)
         try:
             writer.write(P.encode_response(rsp))
             await writer.drain()

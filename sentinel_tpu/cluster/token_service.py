@@ -258,20 +258,61 @@ class DefaultTokenService(TokenService):
     # -- TokenService --------------------------------------------------------
 
     def request_token(self, flow_id: int, count: int = 1, prioritized: bool = False) -> TokenResult:
+        """Blocking token grant — delegates to the async path so the guards
+        and verdict mapping live in exactly one place."""
+        try:
+            return self.request_token_async(flow_id, count, prioritized).result(
+                timeout=self.client.entry_timeout_s
+            )
+        except Exception:
+            return TokenResult(C.STATUS_FAIL)
+
+    def request_token_async(self, flow_id: int, count: int = 1, prioritized: bool = False):
+        """Non-blocking request_token: returns a concurrent Future of
+        TokenResult (or a completed result for no-rule / namespace-guard
+        outcomes).  Lets the TCP server keep thousands of token requests
+        in flight with no thread per request — they coalesce into the
+        decision engine's micro-batches (the TPU-native shape)."""
+        from concurrent.futures import Future as _F
+
+        done = _F()
         rule = self.flow_rules.get_by_id(flow_id)
         if rule is None:
-            return TokenResult(C.STATUS_NO_RULE)
+            done.set_result(TokenResult(C.STATUS_NO_RULE))
+            return done
         ns = self.flow_rules.namespace_of(flow_id) or C.DEFAULT_NAMESPACE
         if not self.limiter.try_pass(ns, self.client.time.now_ms()):
-            return TokenResult(C.STATUS_TOO_MANY_REQUEST)
-        verdict, wait_ms = self.client.check_batch(
-            [flow_resource(flow_id)], counts=[count], prioritized=[prioritized]
-        )[0]
-        if verdict == ERR.PASS:
-            return TokenResult(C.STATUS_OK)
-        if verdict == ERR.PASS_WAIT:
-            return TokenResult(C.STATUS_SHOULD_WAIT, wait_ms=wait_ms)
-        return TokenResult(C.STATUS_BLOCKED)
+            done.set_result(TokenResult(C.STATUS_TOO_MANY_REQUEST))
+            return done
+        # backpressure: with the thread-free TCP path nothing else bounds
+        # in-flight requests, so shed load once the acquire queue exceeds a
+        # few engine batches (the reference's namespace guard plays this
+        # role only when configured tightly)
+        if self.client.pending_acquires() > 4 * self.client.cfg.batch_size:
+            done.set_result(TokenResult(C.STATUS_TOO_MANY_REQUEST))
+            return done
+        f = self.client.submit_acquire(
+            flow_resource(flow_id), count=count, prioritized=prioritized
+        )
+        if f is None:
+            done.set_result(TokenResult(C.STATUS_OK))
+            return done
+
+        def _chain(fut):
+            try:
+                verdict, wait_ms = fut.result()
+            except Exception:
+                done.set_result(TokenResult(C.STATUS_FAIL))
+                return
+            if verdict == ERR.PASS:
+                done.set_result(TokenResult(C.STATUS_OK))
+            elif verdict == ERR.PASS_WAIT:
+                done.set_result(TokenResult(C.STATUS_SHOULD_WAIT, wait_ms=wait_ms))
+            else:
+                done.set_result(TokenResult(C.STATUS_BLOCKED))
+
+        f.add_done_callback(_chain)
+        return done
 
     def request_token_batch(self, flow_id: int, units: int) -> TokenResult:
         """Partial grant: `units` unit-acquires coalesce into one engine

@@ -259,7 +259,11 @@ class SentinelClient:
         self.cluster_retry_interval_s = 5.0
 
         self._sys = SystemStatusSampler()
-        self._tick = E.make_tick(self.cfg, donate=True)
+        # the tick compiles only the stages the loaded rule set needs (the
+        # SPI slot-chain analog: absent slots cost nothing); rule loads that
+        # change the feature set swap in a freshly compiled tick
+        self._features = self._select_features()
+        self._tick = E.make_tick(self.cfg, donate=True, features=self._features)
         self._state = E.init_state(self.cfg)
         self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
         self._rules_dirty = False
@@ -310,7 +314,7 @@ class SentinelClient:
             # Warm the compile cache before serving: the first jitted tick
             # can take tens of seconds; without this, early entry() futures
             # hit entry_timeout_s while XLA compiles.
-            self._run_tick([], None, self.time.now_ms())
+            self._warm_shapes()
             self._thread = threading.Thread(
                 target=self._tick_loop,
                 args=(self._stop_evt,),
@@ -341,6 +345,32 @@ class SentinelClient:
         self._started = False
 
     # -- rule compilation ---------------------------------------------------
+
+    def _select_features(self, local_flow=None, local_param=None) -> frozenset:
+        """Engine stages the current rule set needs.  'nodes' and 'occupy'
+        stay on (their unused paths are runtime-gated and near-free);
+        'warmup' joins when a warm-up shaper exists."""
+        feats = {"nodes", "occupy", "flow"}
+        flow = self.flow_rules.get() if local_flow is None else local_flow
+        param = (
+            (self.param_flow_rules.get() + self.gateway_param_rules.get())
+            if local_param is None
+            else local_param
+        )
+        if self.degrade_rules.get():
+            feats.add("degrade")
+        if param:
+            feats.add("param")
+        if self.authority_rules.get():
+            feats.add("authority")
+        if self.system_rules.get():
+            feats.add("system")
+        if any(
+            r.control_behavior in (R.CONTROL_WARM_UP, R.CONTROL_WARM_UP_RATE_LIMITER)
+            for r in flow
+        ):
+            feats.add("warmup")
+        return frozenset(feats)
 
     def _recompile_rules(self) -> None:
         # cluster-mode rules are enforced via the TokenService, not the local
@@ -390,6 +420,17 @@ class SentinelClient:
                 system_rules=self.system_rules.get(),
                 param_lanes=lane_map,
             )
+            feats = self._select_features(local_flow, local_param)
+            changed = feats != self._features
+            if changed:
+                self._features = feats
+                self._tick = E.make_tick(self.cfg, donate=True, features=feats)
+        # compile the new tick NOW for BOTH batch shapes (outside the
+        # engine lock; _run_tick serializes through its own locking) so the
+        # first post-reload entry doesn't eat the XLA compile inside its
+        # entry_timeout_s window
+        if changed and self._started and self.mode == "threaded":
+            self._warm_shapes()
 
     # -- cluster consultation -----------------------------------------------
 
@@ -794,6 +835,42 @@ class SentinelClient:
 
     # -- bulk API -----------------------------------------------------------
 
+    def submit_acquire(
+        self,
+        resource: str,
+        count: int = 1,
+        prioritized: bool = False,
+        inbound: bool = False,
+    ) -> Optional[Future]:
+        """Non-blocking single acquire: queue the request and return its
+        Future of (verdict, wait_ms), or None for unknown resources
+        (pass-through).  The async surface for event-loop callers (the
+        cluster token server) — thousands of in-flight requests coalesce
+        into engine micro-batches without a thread each."""
+        if not self.enabled:
+            return None
+        rid = self.registry.resource_id(resource)
+        if rid is None:
+            return None
+        req = AcquireRequest(
+            res=rid,
+            count=count,
+            prio=1 if prioritized else 0,
+            origin_id=-1,
+            origin_node=self.cfg.trash_row,
+            ctx_node=self.cfg.trash_row,
+            ctx_name=-1,
+            inbound=1 if inbound else 0,
+            param_hash=(0,) * self.cfg.param_dims,
+            pre_verdict=0,
+            future=Future(),
+        )
+        with self._lock:
+            self._acquires.append(req)
+        if self.mode == "sync":
+            self.tick_once()
+        return req.future
+
     def check_batch(
         self,
         resources: Sequence[str],
@@ -958,6 +1035,26 @@ class SentinelClient:
                 return
             now_ms = None  # subsequent drain loops use fresh time
 
+    def pending_acquires(self) -> int:
+        """Depth of the un-ticked acquire queue (load-shedding probe)."""
+        with self._lock:
+            return len(self._acquires)
+
+    def _warm_shapes(self) -> None:
+        """Compile the tick for both batch shapes (small + full) with
+        no-op batches so serving never waits on XLA."""
+        self._run_tick([], None, self.time.now_ms())
+        if self.cfg.batch_size > 256:
+            filler = AcquireRequest(
+                res=self.cfg.trash_row, count=0, prio=0, origin_id=-1,
+                origin_node=self.cfg.trash_row, ctx_node=self.cfg.trash_row,
+                ctx_name=-1, inbound=0,
+                param_hash=(0,) * self.cfg.param_dims,
+            )
+            # 257 trash-row entries force the full-shape executable; trash
+            # rows are engine no-ops and carry no futures to resolve
+            self._run_tick([filler] * 257, None, self.time.now_ms())
+
     def _run_tick(
         self,
         acq: List[AcquireRequest],
@@ -965,11 +1062,22 @@ class SentinelClient:
         now_ms: Optional[int],
     ) -> None:
         cfg = self.cfg
-        B, B2 = cfg.batch_size, cfg.complete_batch_size
         M = cfg.param_dims
         trash = cfg.trash_row
 
-        a = E.empty_acquire(cfg)
+        # adaptive batch shape: a light tick (queue <= 256) runs at a small
+        # padded shape, anything bigger at the full configured batch — a
+        # mostly-idle CPU-backed tick drops ~10x in cost.  Exactly TWO
+        # shapes exist so both compile during start()/rule-load warmup;
+        # an open-ended power-of-two ladder would push multi-second XLA
+        # compiles into the serving path at the first load spike.
+        def _shape_for(n: int, cap: int) -> int:
+            return min(256, cap) if n <= 256 else cap
+
+        B = _shape_for(len(acq), cfg.batch_size)
+        B2 = _shape_for(0 if comp is None else len(comp[0]), cfg.complete_batch_size)
+
+        a = E.empty_acquire(cfg, b=min(256, cfg.batch_size))
         if acq:
             n = len(acq)
             arr = lambda f, fill, dt: np.asarray(
@@ -996,7 +1104,7 @@ class SentinelClient:
                 ),
                 pre_verdict=jnp.asarray(arr("pre_verdict", 0, np.int32)),
             )
-        c = E.empty_complete(cfg)
+        c = E.empty_complete(cfg, b=min(256, cfg.complete_batch_size))
         if comp is not None:
             from sentinel_tpu.native.ring import FLAG_INBOUND
 
