@@ -88,6 +88,7 @@ def bench_demo_basic() -> dict:
         "offered_qps": offered / 5,
         "host_decisions_per_sec": round(offered / wall),
         "engine_backend": "cpu",
+        "host_cores": os.cpu_count(),
         "config": "#1 demo-basic (FlowRule count=20 @ ~19k QPS offered)",
     }
 
@@ -250,7 +251,7 @@ def bench_degrade_100k() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0) -> dict:
+def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0, native_front: bool = False, procs: int = 1) -> dict:
     _force_cpu()
     import asyncio
     import struct
@@ -287,9 +288,73 @@ def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0) -> dict:
             )
         ],
     )
-    server = ClusterTokenServer(svc, host="127.0.0.1", port=0, workers=64)
-    server.start()
-    port = server.port
+    door = None
+    if native_front:
+        from sentinel_tpu.cluster.front_door import NativeFrontDoor
+
+        door = NativeFrontDoor(port=0)
+        door.follow(svc)
+        decision.attach_front_door(door)
+        door.start()
+        port = door.port
+        server = None
+    else:
+        server = ClusterTokenServer(svc, host="127.0.0.1", port=0, workers=64)
+        server.start()
+        port = server.port
+
+    if procs > 1:
+        # client load in separate processes: a single Python loop saturates
+        # near ~10k msg/s and would measure the CLIENT, not the server
+        import subprocess as sp
+
+        per = max(n_nodes // procs, 1)
+        t0 = time.perf_counter()
+        children = [
+            sp.Popen(
+                [sys.executable, os.path.abspath(__file__), "_client5",
+                 "--port", str(port), "--nodes", str(per),
+                 "--duration", str(duration_s)],
+                stdout=sp.PIPE, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            for _ in range(procs)
+        ]
+        agg = {"ok": 0, "blocked": 0, "other": 0}
+        active = duration_s
+        for ch in children:
+            out, _ = ch.communicate(timeout=duration_s + 120)
+            try:
+                d = json.loads(out.strip().splitlines()[-1])
+                for k in agg:
+                    agg[k] += d.get(k, 0)
+                active = max(active, d.get("active_s", duration_s))
+            except Exception:
+                agg["other"] += 1
+        wall = active  # interpreter/jax startup excluded
+        if server is not None:
+            server.stop()
+        if door is not None:
+            door.stop()
+        decision.stop()
+        if door is not None:
+            door.close()
+        total = sum(agg.values())
+        qps = total / wall if wall > 0 else 0.0
+        return {
+            "metric": "cluster_token_qps@4096_nodes",
+            "value": round(qps),
+            "unit": "tokens/s",
+            "vs_baseline": round(qps / 30000, 4),
+            "nodes": n_nodes,
+            "client_procs": procs,
+            "granted": agg["ok"],
+            "blocked": agg["blocked"],
+            "errors": agg["other"],
+            "engine_backend": "cpu",
+            "front_door": "native-epoll" if native_front else "asyncio",
+            "config": "#5 simulated cluster (4096 TCP nodes -> one token server)",
+        }
 
     stats = {"ok": 0, "blocked": 0, "other": 0}
     stop_at = time.perf_counter() + duration_s
@@ -349,8 +414,13 @@ def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0) -> dict:
     t.start()
     t.join(timeout=duration_s + 120)
     wall = time.perf_counter() - t0
-    server.stop()
+    if server is not None:
+        server.stop()
+    if door is not None:
+        door.stop()
     decision.stop()
+    if door is not None:
+        door.close()
     total = stats["ok"] + stats["blocked"] + stats["other"]
     qps = total / wall if wall > 0 else 0.0
     return {
@@ -363,6 +433,8 @@ def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0) -> dict:
         "blocked": stats["blocked"],
         "errors": stats["other"],
         "engine_backend": "cpu",
+        "host_cores": os.cpu_count(),
+        "front_door": "native-epoll" if native_front else "asyncio",
         "config": "#5 simulated cluster (4096 TCP nodes -> one token server)",
     }
 
@@ -378,26 +450,111 @@ BENCHES = {
 }
 
 
+def _client5(port: int, n_nodes: int, duration_s: float) -> None:
+    """Client-side worker for config #5 multi-process mode: n_nodes
+    connections against an already-running token server; prints counts."""
+    import asyncio
+    import struct
+
+    from sentinel_tpu.cluster import constants as C
+    from sentinel_tpu.cluster import protocol as P
+
+    stats = {"ok": 0, "blocked": 0, "other": 0}
+    stop_at = time.perf_counter() + duration_s  # starts after imports
+    flow_id = 101
+    ns = "bench-ns"
+
+    async def read_frame(reader):
+        head = await reader.readexactly(2)
+        (n,) = struct.unpack(">H", head)
+        return await reader.readexactly(n)
+
+    async def node(idx):
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        except OSError:
+            stats["other"] += 1
+            return
+        try:
+            writer.write(P.encode_request(P.ClusterRequest(xid=0, type=C.MSG_TYPE_PING, namespace=ns)))
+            await writer.drain()
+            await read_frame(reader)
+            xid = 1
+            while time.perf_counter() < stop_at:
+                writer.write(P.encode_request(P.ClusterRequest(
+                    xid=xid, type=C.MSG_TYPE_FLOW, flow_id=flow_id, count=1)))
+                await writer.drain()
+                resp = P.decode_response(await read_frame(reader))
+                if resp.status == C.STATUS_OK:
+                    stats["ok"] += 1
+                elif resp.status == C.STATUS_BLOCKED:
+                    stats["blocked"] += 1
+                else:
+                    stats["other"] += 1
+                xid += 1
+        except (OSError, asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _all():
+        await asyncio.gather(*(node(i) for i in range(n_nodes)))
+
+    t0 = time.perf_counter()
+    asyncio.run(_all())
+    stats["active_s"] = round(time.perf_counter() - t0, 3)
+    print(json.dumps(stats))
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("config", nargs="?", default="all", help="1|3|4|5|all")
+    ap.add_argument("config", nargs="?", default="all", help="1|3|4|5|all|_client5")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--procs", type=int, default=1)
     ap.add_argument("--nodes", type=int, default=4096)
     ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--native-front", action="store_true",
+                    help="config #5: native epoll front door instead of asyncio")
     args = ap.parse_args()
-    results = []
-    keys = list(BENCHES) if args.config == "all" else [args.config]
-    for k in keys:
-        fn = BENCHES[k]
-        if k == "5":
-            r = fn(n_nodes=args.nodes, duration_s=args.duration)
-        else:
-            r = fn()
-        print(json.dumps(r), flush=True)
-        results.append(r)
+    if args.config == "_client5":
+        _client5(args.port, args.nodes, args.duration)
+        return
     if args.config == "all":
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "RESULTS_r2.json")
-        with open(out, "w") as f:
+        # each config in its own process: #1/#5 force the CPU backend with a
+        # process-global jax config flip that must not leak into #3/#4
+        import subprocess as sp
+
+        results = []
+        for k in BENCHES:
+            cmd = [sys.executable, os.path.abspath(__file__), k,
+                   "--nodes", str(args.nodes), "--duration", str(args.duration),
+                   "--procs", str(args.procs)]
+            if args.native_front:
+                cmd.append("--native-front")
+            out = sp.run(cmd, capture_output=True, text=True, timeout=1800)
+            for line in out.stdout.strip().splitlines():
+                try:
+                    r = json.loads(line)
+                except Exception:
+                    continue
+                print(json.dumps(r), flush=True)
+                results.append(r)
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "RESULTS_r2.json")
+        with open(path, "w") as f:
             json.dump(results, f, indent=1)
+        return
+
+    k = args.config
+    fn = BENCHES[k]
+    if k == "5":
+        r = fn(n_nodes=args.nodes, duration_s=args.duration,
+               native_front=args.native_front, procs=args.procs)
+    else:
+        r = fn()
+    print(json.dumps(r), flush=True)
 
 
 if __name__ == "__main__":

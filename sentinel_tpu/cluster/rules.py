@@ -123,6 +123,12 @@ class ClusterFlowRuleManager:
         self._by_id: Dict[int, R.FlowRule] = {}
         self._ns_by_id: Dict[int, str] = {}
         self._on_change = on_change
+        self._listeners: List[Callable[[], None]] = []
+
+    def add_listener(self, fn: Callable[[], None]) -> None:
+        """Fires after every load, AFTER the primary on_change (so engine
+        rule projection runs first and listeners see compiled state)."""
+        self._listeners.append(fn)
 
     def load(self, namespace: str, rules: List[R.FlowRule]) -> None:
         rules = [r for r in rules if r.cluster_mode and r.cluster_flow_id > 0]
@@ -140,6 +146,8 @@ class ClusterFlowRuleManager:
                 self._ns_by_id[r.cluster_flow_id] = namespace
         if self._on_change:
             self._on_change()
+        for fn in list(self._listeners):
+            fn()
 
     def get_by_id(self, flow_id: int) -> Optional[R.FlowRule]:
         return self._by_id.get(flow_id)

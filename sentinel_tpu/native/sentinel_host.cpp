@@ -234,3 +234,447 @@ int32_t sx_intern_count(sx_intern* t, int32_t first_id) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// native front door: epoll TCP server for the cluster token protocol's FLOW
+// fast path (SURVEY §2.9 "native boundary"; the reference's analog is the
+// Netty pipeline in NettyTransportServer.java:88-93).
+//
+// Per-request Python costs ~100-300 us on an asyncio loop; this path does
+// socket -> frame parse -> flow-id map -> acquire ring in C, the Python
+// tick thread drains acquires straight into engine batch columns, and
+// verdicts return through a response ring that this thread writes back to
+// the sockets.  Python runs per TICK, not per request.
+//
+// Protocol subset handled natively: PING (replied inline) and MSG_TYPE_FLOW.
+// Anything else is answered STATUS_FAIL — richer types belong to the Python
+// server (cluster/server.py), which can share the port via a fronting LB in
+// real deployments; here they bind separate ports.
+// ---------------------------------------------------------------------------
+
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <unistd.h>
+#include <fcntl.h>
+#include <time.h>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int8_t ST_TOO_MANY = -2;
+constexpr int8_t ST_FAIL = -1;
+constexpr int8_t ST_OK = 0;
+constexpr int8_t ST_NO_RULE = 5;
+
+struct sx_conn {
+    int fd;
+    uint32_t gen;
+    std::vector<uint8_t> rbuf;
+    std::vector<uint8_t> wbuf;
+    size_t woff = 0;
+    bool closing = false;
+};
+
+struct Pend {
+    int fd;
+    uint32_t gen;
+    int32_t xid;
+};
+
+struct FlowSlot {
+    std::atomic<int64_t> key;
+    std::atomic<int32_t> row;
+};
+
+}  // namespace
+
+struct sx_front {
+    int listen_fd = -1;
+    int epfd = -1;
+    int port = 0;
+    std::atomic<bool> running{false};
+    std::thread io;
+    sx_ring* acq = nullptr;   // front -> tick: res=row, count, flags bit1=prio,
+                              // user_tag=correlation slot
+    sx_ring* resp = nullptr;  // tick -> front: res=corr, count=verdict,
+                              // origin_id=wait_ms
+    std::vector<Pend> pend;
+    std::vector<int32_t> freelist;
+    FlowSlot* fmap = nullptr;
+    uint64_t fmask = 0;
+    std::unordered_map<int, sx_conn*> conns;
+    uint32_t gen_seq = 0;
+    // optional request guard: max FLOW requests per second, -1 = off
+    std::atomic<int64_t> guard_max{-1};
+    int64_t guard_epoch = 0;
+    int64_t guard_count = 0;
+};
+
+extern "C" {
+
+static void sxf_set_nonblock(int fd) {
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+sx_front* sx_front_new(int port, uint64_t ring_pow2, uint64_t pending_cap,
+                       uint64_t fmap_pow2) {
+    auto* f = new (std::nothrow) sx_front();
+    if (!f) return nullptr;
+    f->acq = sx_ring_new(ring_pow2);
+    f->resp = sx_ring_new(ring_pow2);
+    f->fmap = new (std::nothrow) FlowSlot[fmap_pow2];
+    if (!f->acq || !f->resp || !f->fmap) {
+        if (f->acq) sx_ring_free(f->acq);
+        if (f->resp) sx_ring_free(f->resp);
+        delete[] f->fmap;
+        delete f;
+        return nullptr;
+    }
+    f->fmask = fmap_pow2 - 1;
+    for (uint64_t i = 0; i < fmap_pow2; ++i) {
+        f->fmap[i].key.store(0, std::memory_order_relaxed);
+        f->fmap[i].row.store(-1, std::memory_order_relaxed);
+    }
+    // INVARIANT: pending_cap <= ring capacity, so at most pending_cap
+    // responses can ever be in flight and the response ring cannot fill —
+    // sx_front_respond's failure branch is defensive, not expected
+    if (pending_cap > ring_pow2) pending_cap = ring_pow2;
+    f->pend.resize(pending_cap);
+    f->freelist.reserve(pending_cap);
+    for (int64_t i = (int64_t)pending_cap - 1; i >= 0; --i)
+        f->freelist.push_back((int32_t)i);
+
+    auto fail = [&]() {
+        if (f->listen_fd >= 0) close(f->listen_fd);
+        sx_ring_free(f->acq);
+        sx_ring_free(f->resp);
+        delete[] f->fmap;
+        delete f;
+        return (sx_front*)nullptr;
+    };
+    f->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (f->listen_fd < 0) return fail();
+    int one = 1;
+    setsockopt(f->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(f->listen_fd, (sockaddr*)&addr, sizeof addr) != 0 ||
+        listen(f->listen_fd, 1024) != 0) {
+        return fail();
+    }
+    socklen_t alen = sizeof addr;
+    getsockname(f->listen_fd, (sockaddr*)&addr, &alen);
+    f->port = ntohs(addr.sin_port);
+    sxf_set_nonblock(f->listen_fd);
+    return f;
+}
+
+int32_t sx_front_port(sx_front* f) { return f ? f->port : -1; }
+
+// flow_id -> engine row; 0 is not a valid flow id (used as empty marker)
+int32_t sx_front_map_flow(sx_front* f, int64_t flow_id, int32_t row) {
+    if (!f || flow_id == 0) return -1;
+    uint64_t h = (uint64_t)flow_id * 0x9E3779B97F4A7C15ull;
+    for (uint64_t i = 0; i <= f->fmask; ++i) {
+        uint64_t idx = (h + i) & f->fmask;
+        int64_t k = f->fmap[idx].key.load(std::memory_order_acquire);
+        if (k == flow_id || k == 0) {
+            f->fmap[idx].row.store(row, std::memory_order_relaxed);
+            f->fmap[idx].key.store(flow_id, std::memory_order_release);
+            return 0;
+        }
+    }
+    return -1;  // map full
+}
+
+// wipe every flow mapping (rule reload re-adds the live set; clear-all
+// avoids open-addressing tombstones).  Lookups racing a clear observe
+// NO_RULE briefly, matching the asyncio server's reload window.
+void sx_front_clear_flows(sx_front* f) {
+    if (!f) return;
+    for (uint64_t i = 0; i <= f->fmask; ++i) {
+        f->fmap[i].row.store(-1, std::memory_order_relaxed);
+        f->fmap[i].key.store(0, std::memory_order_release);
+    }
+}
+
+// acquire-ring backlog (tick-side: keep draining without a timer wait)
+int64_t sx_front_acq_backlog(sx_front* f) {
+    return f ? sx_ring_size(f->acq) : 0;
+}
+
+void sx_front_set_guard(sx_front* f, int64_t max_per_sec) {
+    if (f) f->guard_max.store(max_per_sec, std::memory_order_relaxed);
+}
+
+static int32_t sxf_lookup(sx_front* f, int64_t flow_id) {
+    uint64_t h = (uint64_t)flow_id * 0x9E3779B97F4A7C15ull;
+    for (uint64_t i = 0; i <= f->fmask; ++i) {
+        uint64_t idx = (h + i) & f->fmask;
+        int64_t k = f->fmap[idx].key.load(std::memory_order_acquire);
+        if (k == flow_id) return f->fmap[idx].row.load(std::memory_order_relaxed);
+        if (k == 0) return -1;
+    }
+    return -1;
+}
+
+static void sxf_queue_resp(sx_conn* c, int32_t xid, uint8_t type, int8_t status,
+                           int32_t remaining, int32_t wait_ms) {
+    // 2-byte BE length + xid(4) type(1) status(1) [+ remaining(4) wait(4)]
+    uint8_t body[14];
+    body[0] = (uint8_t)(xid >> 24); body[1] = (uint8_t)(xid >> 16);
+    body[2] = (uint8_t)(xid >> 8);  body[3] = (uint8_t)xid;
+    body[4] = type;
+    body[5] = (uint8_t)status;
+    size_t n = 6;
+    if (type == 1 || type == 2 || type == 10) {
+        body[6] = (uint8_t)(remaining >> 24); body[7] = (uint8_t)(remaining >> 16);
+        body[8] = (uint8_t)(remaining >> 8);  body[9] = (uint8_t)remaining;
+        body[10] = (uint8_t)(wait_ms >> 24);  body[11] = (uint8_t)(wait_ms >> 16);
+        body[12] = (uint8_t)(wait_ms >> 8);   body[13] = (uint8_t)wait_ms;
+        n = 14;
+    }
+    c->wbuf.push_back((uint8_t)(n >> 8));
+    c->wbuf.push_back((uint8_t)n);
+    c->wbuf.insert(c->wbuf.end(), body, body + n);
+}
+
+static void sxf_flush(sx_front* f, sx_conn* c) {
+    while (c->woff < c->wbuf.size()) {
+        ssize_t w = write(c->fd, c->wbuf.data() + c->woff, c->wbuf.size() - c->woff);
+        if (w > 0) {
+            c->woff += (size_t)w;
+        } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            break;  // EPOLLOUT (level-triggered epoll retries us next loop)
+        } else {
+            c->closing = true;
+            break;
+        }
+    }
+    if (c->woff >= c->wbuf.size()) {
+        c->wbuf.clear();
+        c->woff = 0;
+    } else if (c->woff > (1u << 20)) {
+        c->wbuf.erase(c->wbuf.begin(), c->wbuf.begin() + c->woff);
+        c->woff = 0;
+    }
+}
+
+static bool sxf_guard_ok(sx_front* f) {
+    int64_t mx = f->guard_max.load(std::memory_order_relaxed);
+    if (mx < 0) return true;
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC_COARSE, &ts);
+    if (ts.tv_sec != f->guard_epoch) {
+        f->guard_epoch = ts.tv_sec;
+        f->guard_count = 0;
+    }
+    return ++f->guard_count <= mx;
+}
+
+static void sxf_parse(sx_front* f, sx_conn* c) {
+    size_t off = 0;
+    auto& b = c->rbuf;
+    while (b.size() - off >= 2) {
+        size_t len = ((size_t)b[off] << 8) | b[off + 1];
+        if (b.size() - off - 2 < len) break;
+        const uint8_t* p = b.data() + off + 2;
+        off += 2 + len;
+        if (len < 5) continue;
+        int32_t xid = ((int32_t)p[0] << 24) | ((int32_t)p[1] << 16) |
+                      ((int32_t)p[2] << 8) | (int32_t)p[3];
+        uint8_t type = p[4];
+        if (type == 0) {  // PING — namespace payload ignored (single-tenant door)
+            sxf_queue_resp(c, xid, 0, ST_OK, 0, 0);
+            continue;
+        }
+        if (type != 1 || len < 5 + 13) {  // only FLOW is native
+            sxf_queue_resp(c, xid, type, ST_FAIL, 0, 0);
+            continue;
+        }
+        int64_t flow_id = 0;
+        for (int i = 0; i < 8; ++i) flow_id = (flow_id << 8) | p[5 + i];
+        int32_t count = ((int32_t)p[13] << 24) | ((int32_t)p[14] << 16) |
+                        ((int32_t)p[15] << 8) | (int32_t)p[16];
+        uint8_t prio = p[17];
+        int32_t row = sxf_lookup(f, flow_id);
+        if (row < 0) {
+            sxf_queue_resp(c, xid, 1, ST_NO_RULE, 0, 0);
+            continue;
+        }
+        if (!sxf_guard_ok(f)) {
+            sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
+            continue;
+        }
+        if (f->freelist.empty()) {
+            sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
+            continue;
+        }
+        int32_t corr = f->freelist.back();
+        f->freelist.pop_back();
+        f->pend[corr] = Pend{c->fd, c->gen, xid};
+        if (sx_ring_push(f->acq, row, count, 0, 0, prio ? 2 : 0, 0.0f, 0,
+                         corr, 0, 0) != 0) {
+            f->freelist.push_back(corr);
+            sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
+        }
+    }
+    if (off) b.erase(b.begin(), b.begin() + off);
+}
+
+static void sxf_drain_responses(sx_front* f) {
+    constexpr int64_t MAXB = 8192;
+    static thread_local std::vector<int32_t> corr(MAXB), verdict(MAXB),
+        wait(MAXB), i0(MAXB), i1(MAXB), i2(MAXB), i3(MAXB);
+    static thread_local std::vector<float> f0(MAXB);
+    for (;;) {
+        int64_t n = sx_ring_drain(f->resp, MAXB, corr.data(), verdict.data(),
+                                  wait.data(), i0.data(), i1.data(), f0.data(),
+                                  i2.data(), i3.data(), i0.data(), i1.data());
+        if (n <= 0) break;
+        for (int64_t i = 0; i < n; ++i) {
+            int32_t slot = corr[i];
+            if (slot < 0 || (size_t)slot >= f->pend.size()) continue;
+            Pend pd = f->pend[slot];
+            f->freelist.push_back(slot);
+            auto it = f->conns.find(pd.fd);
+            if (it == f->conns.end() || it->second->gen != pd.gen) continue;
+            sxf_queue_resp(it->second, pd.xid, 1, (int8_t)verdict[i], 0, wait[i]);
+        }
+        if (n < MAXB) break;
+    }
+}
+
+static void sxf_close(sx_front* f, sx_conn* c) {
+    epoll_ctl(f->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    f->conns.erase(c->fd);
+    delete c;
+}
+
+static void sxf_io_loop(sx_front* f) {
+    f->epfd = epoll_create1(0);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = f->listen_fd;
+    epoll_ctl(f->epfd, EPOLL_CTL_ADD, f->listen_fd, &ev);
+    std::vector<epoll_event> evs(256);
+    uint8_t buf[65536];
+    while (f->running.load(std::memory_order_relaxed)) {
+        int n = epoll_wait(f->epfd, evs.data(), (int)evs.size(), 1);
+        for (int i = 0; i < n; ++i) {
+            int fd = evs[i].data.fd;
+            if (fd == f->listen_fd) {
+                for (;;) {
+                    int cfd = accept(f->listen_fd, nullptr, nullptr);
+                    if (cfd < 0) break;
+                    sxf_set_nonblock(cfd);
+                    int one = 1;
+                    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+                    auto* c = new sx_conn();
+                    c->fd = cfd;
+                    c->gen = ++f->gen_seq;
+                    f->conns[cfd] = c;
+                    epoll_event cev{};
+                    cev.events = EPOLLIN;
+                    cev.data.fd = cfd;
+                    epoll_ctl(f->epfd, EPOLL_CTL_ADD, cfd, &cev);
+                }
+                continue;
+            }
+            auto it = f->conns.find(fd);
+            if (it == f->conns.end()) continue;
+            sx_conn* c = it->second;
+            for (;;) {
+                ssize_t r = read(fd, buf, sizeof buf);
+                if (r > 0) {
+                    c->rbuf.insert(c->rbuf.end(), buf, buf + r);
+                    if (r < (ssize_t)sizeof buf) break;
+                } else if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+                    break;
+                } else {
+                    c->closing = true;
+                    break;
+                }
+            }
+            if (!c->closing) sxf_parse(f, c);
+        }
+        sxf_drain_responses(f);
+        std::vector<sx_conn*> dead;
+        for (auto& kv : f->conns) {
+            sxf_flush(f, kv.second);
+            if (kv.second->closing && kv.second->woff >= kv.second->wbuf.size())
+                dead.push_back(kv.second);
+        }
+        for (auto* c : dead) sxf_close(f, c);
+    }
+    for (auto& kv : f->conns) {
+        close(kv.first);
+        delete kv.second;
+    }
+    f->conns.clear();
+    close(f->epfd);
+    f->epfd = -1;
+}
+
+int32_t sx_front_start(sx_front* f) {
+    if (!f || f->running.load()) return -1;
+    f->running.store(true);
+    f->io = std::thread(sxf_io_loop, f);
+    return 0;
+}
+
+void sx_front_stop(sx_front* f) {
+    if (!f) return;
+    if (f->running.exchange(false) && f->io.joinable()) f->io.join();
+}
+
+void sx_front_free(sx_front* f) {
+    if (!f) return;
+    sx_front_stop(f);
+    if (f->listen_fd >= 0) close(f->listen_fd);
+    sx_ring_free(f->acq);
+    sx_ring_free(f->resp);
+    delete[] f->fmap;
+    delete f;
+}
+
+// tick side: drain pending FLOW acquires into batch columns.
+// prio[i] receives 1 for prioritized requests (bit1 of the event flags).
+int64_t sx_front_drain_acquires(sx_front* f, int64_t max_n, int32_t* row,
+                                int32_t* count, int32_t* prio, int32_t* corr) {
+    static thread_local std::vector<int32_t> scratch_i;
+    static thread_local std::vector<float> scratch_f;
+    if ((int64_t)scratch_i.size() < max_n * 5) scratch_i.resize(max_n * 5);
+    if ((int64_t)scratch_f.size() < max_n) scratch_f.resize(max_n);
+    int32_t* origin = scratch_i.data();
+    int32_t* ph = origin + max_n;
+    int32_t* err = ph + max_n;
+    int32_t* a0 = err + max_n;
+    int32_t* a1 = a0 + max_n;
+    int64_t n = sx_ring_drain(f->acq, max_n, row, count, origin, ph, prio,
+                              scratch_f.data(), err, corr, a0, a1);
+    for (int64_t i = 0; i < n; ++i) prio[i] = (prio[i] >> 1) & 1;
+    return n;
+}
+
+// tick side: push verdicts for drained acquires
+int32_t sx_front_respond(sx_front* f, int64_t n, const int32_t* corr,
+                         const int32_t* status, const int32_t* wait_ms) {
+    int32_t dropped = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (sx_ring_push(f->resp, corr[i], status[i], wait_ms[i], 0, 0, 0.0f,
+                         0, 0, 0, 0) != 0)
+            ++dropped;
+    }
+    return dropped;
+}
+
+}  // extern "C"

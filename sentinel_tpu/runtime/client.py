@@ -268,6 +268,7 @@ class SentinelClient:
         self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
         self._rules_dirty = False
 
+        self._front_door = None
         self._lock = threading.Lock()  # guards the acquire queue
         self._engine_lock = threading.Lock()  # guards state/tick execution
         self._acquires: List[AcquireRequest] = []
@@ -1022,18 +1023,35 @@ class SentinelClient:
                         )
                     )
                     n_comp += len(spill)
-            if not acq and not n_comp and now_ms is None:
+            front = None
+            door = self._front_door
+            if door is not None:
+                room = self.cfg.batch_size - len(acq)
+                if room > 0:
+                    cols = door.drain(room)
+                    if len(cols[0]):
+                        front = cols
+            if not acq and not n_comp and front is None and now_ms is None:
                 return
-            self._run_tick(acq, comp if n_comp else None, now_ms)
+            self._run_tick(acq, comp if n_comp else None, now_ms, front=front)
             with self._lock:
                 more = (
                     bool(self._acquires)
                     or bool(self._comp_ring)
                     or bool(self._comp_overflow)
                 )
+            if not more and door is not None:
+                more = door.pending() > 0
             if not more:
                 return
             now_ms = None  # subsequent drain loops use fresh time
+
+    def attach_front_door(self, door) -> None:
+        """Serve a NativeFrontDoor's traffic from this client's tick loop:
+        its pending acquires join every engine batch as array lanes and
+        their verdicts return through the door's response ring —
+        per-request work never touches Python (cluster/front_door.py)."""
+        self._front_door = door
 
     def pending_acquires(self) -> int:
         """Depth of the un-ticked acquire queue (load-shedding probe)."""
@@ -1060,10 +1078,12 @@ class SentinelClient:
         acq: List[AcquireRequest],
         comp,  # Optional[Tuple[np.ndarray, ...]] — drained ring columns
         now_ms: Optional[int],
+        front=None,  # Optional (row, count, prio, corr) int32 arrays
     ) -> None:
         cfg = self.cfg
         M = cfg.param_dims
         trash = cfg.trash_row
+        n_front = 0 if front is None else len(front[0])
 
         # adaptive batch shape: a light tick (queue <= 256) runs at a small
         # padded shape, anything bigger at the full configured batch — a
@@ -1074,19 +1094,26 @@ class SentinelClient:
         def _shape_for(n: int, cap: int) -> int:
             return min(256, cap) if n <= 256 else cap
 
-        B = _shape_for(len(acq), cfg.batch_size)
+        B = _shape_for(len(acq) + n_front, cfg.batch_size)
         B2 = _shape_for(0 if comp is None else len(comp[0]), cfg.complete_batch_size)
 
         a = E.empty_acquire(cfg, b=min(256, cfg.batch_size))
-        if acq:
+        if acq or n_front:
             n = len(acq)
-            arr = lambda f, fill, dt: np.asarray(
-                [getattr(r, f) for r in acq] + [fill] * (B - n), dtype=dt
-            )
+            def arr(f, fill, dt, front_col=None):
+                out = np.full(B, fill, dtype=dt)
+                for i, r in enumerate(acq):
+                    out[i] = getattr(r, f)
+                if front_col is not None and n_front:
+                    out[n : n + n_front] = front_col
+                return out
+            f_row = front[0] if n_front else None
+            f_cnt = front[1] if n_front else None
+            f_prio = front[2] if n_front else None
             a = E.AcquireBatch(
-                res=jnp.asarray(arr("res", trash, np.int32)),
-                count=jnp.asarray(arr("count", 0, np.int32)),
-                prio=jnp.asarray(arr("prio", 0, np.int32)),
+                res=jnp.asarray(arr("res", trash, np.int32, f_row)),
+                count=jnp.asarray(arr("count", 0, np.int32, f_cnt)),
+                prio=jnp.asarray(arr("prio", 0, np.int32, f_prio)),
                 origin_id=jnp.asarray(arr("origin_id", -1, np.int32)),
                 origin_node=jnp.asarray(arr("origin_node", trash, np.int32)),
                 ctx_node=jnp.asarray(arr("ctx_node", trash, np.int32)),
@@ -1094,10 +1121,7 @@ class SentinelClient:
                 inbound=jnp.asarray(arr("inbound", 0, np.int32)),
                 param_hash=jnp.asarray(
                     np.asarray(
-                        [
-                            (tuple(r.param_hash) + (0,) * M)[:M]
-                            for r in acq
-                        ]
+                        [(tuple(r.param_hash) + (0,) * M)[:M] for r in acq]
                         + [(0,) * M] * (B - n),
                         dtype=np.int32,
                     )
@@ -1148,6 +1172,13 @@ class SentinelClient:
         for i, r in enumerate(acq):
             if r.future is not None:
                 r.future.set_result((int(verdict[i]), int(wait[i])))
+        if n_front:
+            n0 = len(acq)
+            self._front_door.respond(
+                front[3],
+                verdict[n0 : n0 + n_front].astype(np.int32),
+                wait[n0 : n0 + n_front].astype(np.int32),
+            )
 
 
 def _mask_min_rt(v: float) -> float:
