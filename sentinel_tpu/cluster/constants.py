@@ -16,6 +16,11 @@ MSG_TYPE_CONCURRENT_RELEASE = 4
 # request n units, response carries granted k (0..n) in `remaining`.  The
 # TPU server answers it with n unit-acquires in ONE engine tick.
 MSG_TYPE_FLOW_BATCH = 10
+# extension: host-shard RESOURCE batch check (parallel/remote_shard.py) —
+# a mixed batch of (resource-name, count, prioritized) triplets answered
+# with per-item (verdict, wait_ms); lets a ShardRouter treat a remote host
+# as a shard over the same framing/codec as token requests
+MSG_TYPE_RES_CHECK = 12
 
 # -- token result status (TokenResultStatus.java) ----------------------------
 STATUS_BAD_REQUEST = -4

@@ -31,7 +31,8 @@ from typing import Any, List, Optional, Tuple
 
 from sentinel_tpu.cluster import constants as C
 
-MAX_FRAME = 1024
+MAX_FRAME = 65535  # 2-byte length prefix ceiling; RES_CHECK batches chunk
+# client-side (parallel/remote_shard.py) so ordinary frames stay small
 
 # param type tags
 _T_INT = 0
@@ -61,6 +62,7 @@ class ClusterResponse:
     remaining: int = 0
     wait_ms: int = 0
     token_id: int = 0
+    items: List[tuple] = field(default_factory=list)  # RES_CHECK verdicts
 
 
 def _pack_params(params: List[Any]) -> bytes:
@@ -124,6 +126,9 @@ def encode_request(req: ClusterRequest) -> bytes:
         payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0)
     elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
         payload = struct.pack(">q", req.token_id)
+    elif t == C.MSG_TYPE_RES_CHECK:
+        # params = flat [name, count, prio, name, count, prio, ...]
+        payload = _pack_params(req.params)
     else:
         raise ValueError(f"bad request type {t}")
     body = head + payload
@@ -146,6 +151,8 @@ def decode_request(body: bytes) -> ClusterRequest:
         req.params = _unpack_params(p[12:])
     elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
         (req.token_id,) = struct.unpack_from(">q", p, 0)
+    elif t == C.MSG_TYPE_RES_CHECK:
+        req.params = _unpack_params(p)
     else:
         raise ValueError(f"bad request type {t}")
     return req
@@ -157,6 +164,10 @@ def encode_response(rsp: ClusterResponse) -> bytes:
         payload = struct.pack(">ii", rsp.remaining, rsp.wait_ms)
     elif rsp.type == C.MSG_TYPE_CONCURRENT_ACQUIRE:
         payload = struct.pack(">q", rsp.token_id)
+    elif rsp.type == C.MSG_TYPE_RES_CHECK:
+        payload = struct.pack(">i", len(rsp.items)) + b"".join(
+            struct.pack(">bi", v, w) for v, w in rsp.items
+        )
     else:
         payload = b""
     body = head + payload
@@ -171,6 +182,17 @@ def decode_response(body: bytes) -> ClusterResponse:
         rsp.remaining, rsp.wait_ms = struct.unpack_from(">ii", p, 0)
     elif t == C.MSG_TYPE_CONCURRENT_ACQUIRE and len(p) >= 8:
         (rsp.token_id,) = struct.unpack_from(">q", p, 0)
+    elif t == C.MSG_TYPE_RES_CHECK and len(p) >= 4:
+        (n,) = struct.unpack_from(">i", p, 0)
+        off = 4
+        # bounds-checked: a truncated/hostile frame yields a SHORT item
+        # list (the caller length-checks and degrades), not struct.error
+        for _ in range(max(n, 0)):
+            if off + 5 > len(p):
+                break
+            v, w = struct.unpack_from(">bi", p, off)
+            off += 5
+            rsp.items.append((v, w))
     return rsp
 
 

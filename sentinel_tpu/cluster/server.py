@@ -280,6 +280,35 @@ class ClusterTokenServer:
                 r = self.service.request_concurrent_token(req.flow_id, req.count)
             elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
                 r = self.service.release_concurrent_token(req.token_id)
+            elif t == C.MSG_TYPE_RES_CHECK:
+                # host-shard resource batch (parallel/remote_shard.py):
+                # params = flat (name, count, prio, origin, param) 5-tuples
+                names = [str(x) for x in req.params[0::5]]
+                counts = [int(x) for x in req.params[1::5]]
+                prios = [bool(x) for x in req.params[2::5]]
+                origins = [str(x) for x in req.params[3::5]]
+                pvals = []
+                for x in req.params[4::5]:
+                    xs = str(x)
+                    if not xs:
+                        pvals.append(None)
+                    elif xs.startswith("#"):
+                        try:
+                            pvals.append(int(xs[1:]))
+                        except ValueError:
+                            pvals.append(xs)
+                    else:
+                        pvals.append(xs)
+                res = self.service.client.check_batch(
+                    names,
+                    counts=counts,
+                    prioritized=prios,
+                    origins=origins if any(origins) else None,
+                    params=pvals if any(p is not None for p in pvals) else None,
+                )
+                return P.ClusterResponse(
+                    req.xid, t, C.STATUS_OK, items=[(int(v), int(w)) for v, w in res]
+                )
             else:
                 r = TokenResult(C.STATUS_BAD_REQUEST)
         except Exception:

@@ -73,6 +73,54 @@ class ParamRuleTensors(NamedTuple):
     class_k: np.ndarray  # int32 [param_classes] window length (buckets) per class
 
 
+class TailFlowTensors(NamedTuple):
+    """Approximate QPS thresholds for SKETCH-TAIL resources (ids beyond
+    the exact row space).  Thresholds live in depth hashed cells (same
+    hashes as the observability sketch, ops/gsketch.py); a lookup takes
+    max-over-depth, so a collision in one depth row cannot tighten an
+    unruled resource's budget — only a resource colliding with a ruled
+    cell in EVERY depth can be falsely limited:
+
+        P(false limit) <= (n_tail_rules / width) ** depth        (delta)
+
+    and enforcement reads the sketch's windowed pass CMS, whose classic
+    overestimate over-blocks by at most eps = e/width of window volume —
+    both errors in the conservative direction (FlowRuleChecker.java:85
+    semantics with bounded approximation instead of a hard 6,000-resource
+    cap)."""
+
+    thr: np.ndarray  # float32 [sketch_depth, sketch_width]; >= TAIL_UNRULED = unruled
+
+
+#: finite "unruled" sentinel — +inf would turn the MXU one-hot contraction
+#: into 0*inf = NaN and silently disable tail enforcement on TPU; 2e38 is
+#: bf16/f32-representable and no real threshold approaches it
+TAIL_UNRULED = 2.0e38
+
+
+def compile_tail_flow_rules(
+    tail_rules: List[tuple], cfg: EngineConfig
+) -> TailFlowTensors:
+    """tail_rules: [(sketch_resource_id, count), ...] — QPS grade only
+    (other grades/behaviors require exact windows; they promote or drop
+    with a warning at the call site)."""
+    import numpy as _np
+
+    thr = np.full((cfg.sketch_depth, cfg.sketch_width), TAIL_UNRULED, dtype=np.float32)
+    if tail_rules:
+        import jax.numpy as _jnp
+
+        from sentinel_tpu.ops.param import cms_cell
+
+        ids = _np.asarray([rid for rid, _ in tail_rules], dtype=_np.int32)
+        cols = _np.asarray(cms_cell(_jnp.asarray(ids), cfg.sketch_depth, cfg.sketch_width))
+        for i, (_rid, count) in enumerate(tail_rules):
+            for d in range(cfg.sketch_depth):
+                c = int(cols[i, d])
+                thr[d, c] = min(thr[d, c], float(count))
+    return TailFlowTensors(thr=thr)
+
+
 class AuthorityTensors(NamedTuple):
     mode: np.ndarray  # int32 [max_resources] 0 none / 1 white / 2 black
     origins: np.ndarray  # int32 [max_resources, KA] (-9 = empty)

@@ -125,6 +125,7 @@ class RuleSet(NamedTuple):
     param: RT.ParamRuleTensors
     auth: RT.AuthorityTensors
     system: RT.SystemTensors
+    tail: RT.TailFlowTensors  # sketch-tail QPS thresholds (rule_tensors.py)
 
 
 class AcquireBatch(NamedTuple):
@@ -1097,6 +1098,57 @@ def _check_flow(
     return blocked, wait_ms.astype(jnp.int32), latest, occupying, occ_grant, slots_f
 
 
+def _check_tail_flow(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    now_ms,
+    eligible,
+):
+    """Approximate QPS enforcement for SKETCH-TAIL resources: the rule's
+    north star demands rule checks across 1M resources, far beyond the
+    exact row space.  Hot ruled resources PROMOTE into exact rows
+    (Registry.promote_resource); the remainder enforce here from the
+    observability sketch's windowed pass CMS against depth-hashed
+    threshold cells (rule_tensors.TailFlowTensors — (eps, delta) bounds
+    documented there).  Reference semantics: FlowRuleChecker.java:85 with
+    bounded approximation instead of the 6,000-chain cap."""
+    is_tail = acq.res >= cfg.node_rows
+    elig = eligible & is_tail
+    thr_tab = jnp.asarray(rules.tail.thr)
+
+    def _run():
+        # thresholds: max over depth of hashed cells (+inf = unruled)
+        cols = P.cms_cell(acq.res, cfg.sketch_depth, cfg.sketch_width)
+        thrs = []
+        for d in range(cfg.sketch_depth):
+            t = T.big_gather(cfg, thr_tab[d], cols[:, d], cfg.sketch_width)
+            # invalid ids gather 0 — restore the unruled sentinel for them
+            thrs.append(jnp.where(elig, t, RT.TAIL_UNRULED))
+        thr = jnp.max(jnp.stack(thrs, axis=0), axis=0)
+        # sentinel is FINITE (2e38): +inf would ride the one-hot matmul as
+        # 0*inf = NaN on the MXU path and kill enforcement silently
+        ruled = elig & (thr < RT.TAIL_UNRULED / 2)
+
+        est = GS.estimate_plane_mxu(
+            cfg, state.gs, now_ms, acq.res, W.EV_PASS, sketch_config(cfg)
+        )
+        cnt = acq.count.astype(jnp.float32)
+        # within-tick arrival rank keyed by the exact tail id (sort-based:
+        # the id space is the sketch capacity, far beyond dense ranking)
+        (rank,) = grouped_exclusive_cumsum(acq.res, [cnt], ruled)
+        return ruled & (est + rank + cnt > thr)
+
+    # runtime skip when no tail rules exist at all (the table scan is
+    # trivial against the per-item gathers + sort it gates)
+    return jax.lax.cond(
+        jnp.any(thr_tab < RT.TAIL_UNRULED / 2) & jnp.any(elig),
+        _run,
+        lambda: jnp.zeros_like(elig),
+    )
+
+
 def _check_degrade(
     cfg: EngineConfig,
     state: EngineState,
@@ -1170,7 +1222,17 @@ def _check_degrade(
 #: every optional tick stage; make_tick compiles only what the rule set
 #: needs (the SPI slot-chain analog: absent slots cost nothing)
 ALL_FEATURES = frozenset(
-    {"authority", "system", "param", "flow", "degrade", "warmup", "nodes", "occupy"}
+    {
+        "authority",
+        "system",
+        "param",
+        "flow",
+        "degrade",
+        "warmup",
+        "nodes",
+        "occupy",
+        "tail_flow",
+    }
 )
 
 
@@ -1246,6 +1308,9 @@ def tick(
         occ_grant = None
         fslots = None
         wait_ms = jnp.zeros((b,), jnp.int32)
+    if "tail_flow" in features and cfg.sketch_stats:
+        tail_block = _check_tail_flow(cfg, state, rules, acq, now_ms, eligible)
+        flow_block = flow_block | (tail_block & eligible)
     eligible = eligible & ~flow_block
 
     if "degrade" in features:
@@ -1435,15 +1500,51 @@ def compile_ruleset(
 
     ``param_lanes``: optional resource -> ordered param_idx list from
     rule_tensors.param_lanes — pass the host client's map so engine lanes
-    match the hashes the client computes per entry."""
+    match the hashes the client computes per entry.
+
+    QPS flow rules whose resource resolves to a SKETCH id (exact row space
+    exhausted, promotion failed) compile into the tail threshold tables;
+    other grades/behaviors on tail resources cannot be enforced and log a
+    warning."""
+    flow_rules = list(flow_rules)
+    tail = []
+    exact_flow = []
+    for r in flow_rules:
+        rid = registry.resource_id(r.resource) if r.resource else None
+        if rid is not None and rid >= cfg.node_rows:
+            from sentinel_tpu.core.rules import (
+                CONTROL_DEFAULT as _CD,
+                GRADE_QPS as _GQ,
+                STRATEGY_DIRECT as _SD,
+            )
+
+            if (
+                r.grade == _GQ
+                and r.control_behavior == _CD
+                and r.strategy == _SD
+                and cfg.sketch_stats
+            ):
+                tail.append((rid, float(r.count)))
+            else:
+                from sentinel_tpu.utils.record_log import record_log
+
+                record_log().warning(
+                    "flow rule on tail resource %r needs exact windows "
+                    "(grade/behavior/strategy unsupported in the tail) and "
+                    "will NOT be enforced; free exact rows or simplify it",
+                    r.resource,
+                )
+        else:
+            exact_flow.append(r)
     rs = RuleSet(
-        flow=RT.compile_flow_rules(list(flow_rules), cfg, registry),
+        flow=RT.compile_flow_rules(exact_flow, cfg, registry),
         degrade=RT.compile_degrade_rules(list(degrade_rules), cfg, registry),
         param=RT.compile_param_rules(
             list(param_rules), cfg, registry, lanes=param_lanes
         ),
         auth=RT.compile_authority_rules(list(authority_rules), cfg, registry),
         system=RT.compile_system_rules(list(system_rules), cfg),
+        tail=RT.compile_tail_flow_rules(tail, cfg),
     )
     return jax.device_put(rs)
 

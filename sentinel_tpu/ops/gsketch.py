@@ -115,6 +115,39 @@ def add(
     return state._replace(counts=state.counts.at[idx].set(new_col))
 
 
+def estimate_plane_mxu(
+    ecfg,  # EngineConfig — tables.py dispatch
+    state: SketchState,
+    now_ms,
+    res: jax.Array,  # int32 [N]
+    plane: int,
+    cfg: SketchConfig,
+) -> jax.Array:
+    """f32 [N]: windowed min-over-depth estimate of ONE plane, through the
+    MXU table layer (the dense-indexing ``estimate`` serializes on TPU —
+    this is the decision-path variant used by tail-rule enforcement)."""
+    from sentinel_tpu.ops import tables as T
+
+    wid = _wid(now_ms, cfg)
+    valid = (state.epochs > wid - cfg.sample_count) & (state.epochs <= wid)
+    windowed = jnp.sum(
+        state.counts[:, :, :, plane] * valid[:, None, None], axis=0
+    )  # [depth, width]
+    cols = cms_cell(res, cfg.depth, cfg.width)
+    cap = jnp.int32((1 << 24) - 1)
+    ests = []
+    for d in range(cfg.depth):
+        g = T.big_gather(
+            ecfg,
+            jnp.minimum(windowed[d], cap),
+            cols[:, d],
+            cfg.width,
+            max_int=(1 << 24) - 1,
+        )
+        ests.append(g)
+    return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
+
+
 def estimate(
     state: SketchState, now_ms, res: jax.Array, cfg: SketchConfig
 ) -> jax.Array:

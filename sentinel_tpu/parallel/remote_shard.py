@@ -1,0 +1,218 @@
+"""Remote host shard for the ShardRouter — a real network client.
+
+The round-1 router fanned out over in-process objects; this stub speaks
+the cluster TCP protocol's RES_CHECK extension to a shard HOST process
+(cluster/server.py answers it from its decision client), with the failure
+behavior the reference's token client has (NettyTransportClient reconnect,
+DefaultClusterTokenClient.java:45 degrade):
+
+- one live connection, lazily (re)established; one reconnect attempt per
+  call, then the call degrades
+- degrade-on-shard-loss: ``fallback`` is either a local SentinelClient
+  (fallbackToLocal — local rules enforce while the shard is gone) or None
+  (fail-open PASS, the reference's pass-through default)
+- a failed shard is retried after ``retry_interval_s`` so a restarted
+  host picks the traffic back up
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.utils.record_log import record_log
+
+
+class RemoteShard:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 3.0,
+        fallback: Optional[Any] = None,
+        retry_interval_s: float = 2.0,
+    ):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.fallback = fallback
+        self.retry_interval_s = retry_interval_s
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._xid = 0
+        self._down_until = 0.0
+
+    # -- connection ----------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
+        s.settimeout(self.timeout_s)
+        return s
+
+    def _close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _rpc(self, req: P.ClusterRequest) -> P.ClusterResponse:
+        """One request/response on the live connection; raises OSError on
+        any transport trouble (caller degrades)."""
+        if self._sock is None:
+            self._sock = self._connect()
+        s = self._sock
+        s.sendall(P.encode_request(req))
+        head = b""
+        while len(head) < 2:
+            chunk = s.recv(2 - len(head))
+            if not chunk:
+                raise OSError("peer closed")
+            head += chunk
+        (n,) = struct.unpack(">H", head)
+        body = b""
+        while len(body) < n:
+            chunk = s.recv(n - len(body))
+            if not chunk:
+                raise OSError("peer closed")
+            body += chunk
+        return P.decode_response(body)
+
+    # -- shard surface -------------------------------------------------------
+
+    #: items per wire chunk — bounds the frame well under MAX_FRAME even
+    #: with long resource names / origins / stringified params
+    CHUNK = 32
+
+    def check_batch(
+        self,
+        resources: Sequence[str],
+        counts: Optional[Sequence[int]] = None,
+        origins: Optional[Sequence[str]] = None,
+        params: Optional[Sequence[Any]] = None,
+        prioritized: Optional[Sequence[bool]] = None,
+        **kw,
+    ) -> List[Tuple[int, int]]:
+        out: List[Tuple[int, int]] = []
+        for lo in range(0, len(resources), self.CHUNK):
+            hi = min(lo + self.CHUNK, len(resources))
+            out.extend(
+                self._check_chunk(
+                    resources[lo:hi],
+                    counts[lo:hi] if counts else None,
+                    origins[lo:hi] if origins else None,
+                    params[lo:hi] if params else None,
+                    prioritized[lo:hi] if prioritized else None,
+                    **kw,
+                )
+            )
+        return out
+
+    def _check_chunk(
+        self, resources, counts, origins, params, prioritized, **kw
+    ) -> List[Tuple[int, int]]:
+        # wire layout: 5-tuples (name, count, prio, origin, param-as-str);
+        # '' = no origin / no param.  hash_param treats int and str('<int>')
+        # differently only through int-vs-str dispatch, so ints round-trip
+        # via a "#<n>" marker the server decodes back to int.
+        flat: List[Any] = []
+        for i, name in enumerate(resources):
+            pv = params[i] if params else None
+            if isinstance(pv, bool):
+                pv = int(pv)
+            if isinstance(pv, int):
+                pv_s = f"#{pv}"
+            elif pv is None:
+                pv_s = ""
+            else:
+                pv_s = str(pv)
+            flat += [
+                name,
+                counts[i] if counts else 1,
+                bool(prioritized[i]) if prioritized else False,
+                (origins[i] or "") if origins else "",
+                pv_s,
+            ]
+        with self._lock:
+            now = time.monotonic()
+            if now >= self._down_until:
+                for attempt in (0, 1):  # one reconnect, like the netty client
+                    try:
+                        self._xid += 1
+                        rsp = self._rpc(
+                            P.ClusterRequest(
+                                xid=self._xid,
+                                type=C.MSG_TYPE_RES_CHECK,
+                                params=flat,
+                            )
+                        )
+                        if rsp.status == C.STATUS_OK and len(rsp.items) == len(
+                            resources
+                        ):
+                            return [(int(v), int(w)) for v, w in rsp.items]
+                        break  # malformed answer -> degrade this call
+                    except (OSError, ValueError, struct.error):
+                        # ValueError/struct.error: oversized or mangled
+                        # frames degrade like transport loss, never crash
+                        # the router call
+                        self._close()
+                        if attempt == 1:
+                            self._down_until = now + self.retry_interval_s
+                            record_log().warning(
+                                "shard %s:%d unreachable — degrading for %.1fs",
+                                self.host,
+                                self.port,
+                                self.retry_interval_s,
+                            )
+        # degrade: local fallback rules, else fail-open
+        if self.fallback is not None:
+            return self.fallback.check_batch(
+                resources,
+                counts=counts,
+                origins=origins,
+                params=params,
+                prioritized=prioritized,
+                **kw,
+            )
+        return [(ERR.PASS, 0)] * len(resources)
+
+    def entry(self, resource: str, count: int = 1, prioritized: bool = False, **kw):
+        """Single-entry surface for ShardRouter.entry: returns a handle
+        whose exit is a no-op on the remote (the shard host records its own
+        completions for locally-entered traffic; remote entries are
+        token-style grants)."""
+        v, w = self.check_batch([resource], counts=[count], prioritized=[prioritized])[0]
+        if v in (ERR.PASS, ERR.PASS_WAIT):
+            return _RemoteEntry()
+        return None
+
+    class stats:  # noqa: N801 — namespace matching the client surface
+        @staticmethod
+        def snapshot() -> dict:
+            return {}
+
+    def close(self) -> None:
+        with self._lock:
+            self._close()
+
+
+class _RemoteEntry:
+    def exit(self, count: Optional[int] = None) -> None:
+        pass
+
+    def trace(self, exc=None, count: int = 1) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.exit()
+        return False

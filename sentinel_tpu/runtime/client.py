@@ -371,6 +371,12 @@ class SentinelClient:
             for r in flow
         ):
             feats.add("warmup")
+        if self.cfg.sketch_stats and any(
+            (rid := self.registry.peek_resource_id(r.resource)) is not None
+            and self.registry.is_sketch_id(rid)
+            for r in flow
+        ):
+            feats.add("tail_flow")
         return frozenset(feats)
 
     def _recompile_rules(self) -> None:
@@ -385,6 +391,14 @@ class SentinelClient:
         local_flow = [r for r in flow if not r.cluster_mode]
         cluster_flow = [r for r in flow if r.cluster_mode]
         self._cluster_flow_by_res = {r.resource: r for r in cluster_flow}
+
+        # rules binding to sketch-tail resources first try PROMOTION into
+        # the exact row space (Registry.promote_resource) so they get real
+        # windows; whatever stays in the tail enforces approximately
+        for r in local_flow + self.degrade_rules.get():
+            rid = self.registry.peek_resource_id(r.resource)
+            if rid is not None and self.registry.is_sketch_id(rid):
+                self.registry.promote_resource(r.resource)
 
         param = self.param_flow_rules.get() + self.gateway_param_rules.get()
         local_param = [r for r in param if not r.cluster_mode]

@@ -90,6 +90,26 @@ class Registry:
     def peek_resource_id(self, name: str) -> Optional[int]:
         return self._resources.get(name)
 
+    def promote_resource(self, name: str) -> Optional[int]:
+        """Move a sketch-id resource into the exact row space (so rules can
+        bind to real windows) — the SALSA-style hot-promotion half of tail
+        enforcement.  Returns the exact row, or None when the exact space
+        is full (the rule then enforces approximately via the tail CMS
+        tables).  In-flight events carrying the old sketch id land in the
+        sketch one last time — an observability-only transient."""
+        with self._lock:
+            rid = self._resources.get(name)
+            if rid is None or rid < self.cfg.node_rows:
+                return rid  # unknown or already exact
+            if self._next_res >= self.cfg.max_resources:
+                return None
+            new = self._next_res
+            self._next_res += 1
+            self._resources[name] = new
+            self._resource_names.append(name)
+            self._sketch_names.pop(rid, None)
+            return new
+
     def resource_name(self, rid: int) -> Optional[str]:
         if 0 < rid < len(self._resource_names):
             return self._resource_names[rid]

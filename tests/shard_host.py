@@ -1,0 +1,46 @@
+"""Shard host worker process for the multi-host routing tests.
+
+Runs a SentinelClient + token server answering RES_CHECK on an ephemeral
+port; prints "PORT <n>" on stdout once listening, then serves until
+killed.  Rules come in as JSON on argv: [{"resource": ..., "count": ...}].
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import sentinel_tpu as st  # noqa: E402
+from sentinel_tpu.cluster.server import ClusterTokenServer  # noqa: E402
+from sentinel_tpu.cluster.token_service import DefaultTokenService  # noqa: E402
+from sentinel_tpu.core.config import small_engine_config  # noqa: E402
+from sentinel_tpu.runtime.client import SentinelClient  # noqa: E402
+
+
+def main() -> None:
+    rules = json.loads(sys.argv[1]) if len(sys.argv) > 1 else []
+    client = SentinelClient(
+        cfg=small_engine_config(), mode="threaded", tick_interval_ms=2.0
+    )
+    client.start()
+    client.flow_rules.load(
+        [st.FlowRule(resource=r["resource"], count=r["count"]) for r in rules]
+    )
+    svc = DefaultTokenService(client)
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0)
+    server.start()
+    print(f"PORT {server.port}", flush=True)
+    import threading
+
+    threading.Event().wait()  # serve until killed
+
+
+if __name__ == "__main__":
+    main()

@@ -71,13 +71,25 @@ def test_client_overflows_into_sketch(sketch_client, vt):
     assert s7["avgRt"] > 0
 
 
-def test_sketch_resources_have_no_rules_but_count_blocks(sketch_client, vt):
+def test_sketch_resources_enforce_rules_via_tail_tables(sketch_client, vt):
+    """Round-2 contract change: a rule on a sketch-id resource ENFORCES
+    (approximately, via the tail threshold tables) instead of silently
+    passing — tests/test_tail_rules.py covers the (eps, delta) behavior;
+    here just the end-to-end block."""
+    import pytest
+
+    from sentinel_tpu.core import errors as ERR
+
     c = sketch_client
     # exhaust exact space
     for i in range(5):
         c.registry.resource_id(f"res-{i}")
-    # rules only apply to exact-row resources; sketch resources pass freely
     c.flow_rules.load([st.FlowRule(resource="res-9", count=0)])
-    with c.entry("res-9"):  # sketch id → rule not enforceable, passes
-        pass
-    assert c.registry.is_sketch_id(c.registry.peek_resource_id("res-9"))
+    rid = c.registry.peek_resource_id("res-9")
+    if rid is not None and not c.registry.is_sketch_id(rid):
+        # promotion found room — the rule enforces exactly
+        assert c.try_entry("res-9") is None
+    else:
+        with pytest.raises(ERR.BlockException):
+            with c.entry("res-9"):
+                pass

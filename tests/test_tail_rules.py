@@ -1,0 +1,158 @@
+"""Rule enforcement beyond the exact row space (sketch-tail resources).
+
+The reference stops enforcing past its 6,000-chain cap (Constants.java:37);
+here ruled tail resources either PROMOTE into exact rows or enforce
+approximately from the observability sketch with documented (eps, delta)
+bounds (rule_tensors.TailFlowTensors).
+"""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.runtime.client import SentinelClient
+
+
+@pytest.fixture()
+def tiny_client(vt):
+    """4 exact resource rows + sketch tail: tail paths trigger immediately."""
+    cfg = small_engine_config(
+        max_resources=4, max_nodes=16, sketch_stats=True, sketch_width=512,
+        sketch_depth=2,
+    )
+    c = SentinelClient(cfg=cfg, time_source=vt)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _fill_exact(client):
+    """Consume every exact row so later resources get sketch ids."""
+    for i in range(10):
+        client.try_entry(f"filler-{i}")
+    # rows 1..3 now taken (0 is ENTRY); anything new is a sketch id
+    rid = client.registry.resource_id("overflow-probe")
+    assert client.registry.is_sketch_id(rid)
+
+
+def test_promotion_gives_exact_enforcement(tiny_client, vt):
+    c = tiny_client
+    # take rows 1,2 — leave one exact row free
+    c.try_entry("a")
+    c.try_entry("b")
+    rid = c.registry.resource_id("c-sketch")  # takes row 3
+    for i in range(10):
+        c.registry.resource_id(f"spill-{i}")  # exhausts → sketch ids
+    tail_rid = c.registry.resource_id("late")
+    assert c.registry.is_sketch_id(tail_rid)
+    # loading a rule for 'late' cannot promote (exact full) — wait: row
+    # space is full, so this exercises the TAIL path below; promotion is
+    # covered in test_promotion_with_room
+    c.flow_rules.load([st.FlowRule(resource="late", count=2)])
+    got = sum(1 for _ in range(6) if c.try_entry("late"))
+    assert got <= 2  # CMS enforcement can only over-block, never under
+    assert got >= 1
+
+
+def test_promotion_with_room(vt):
+    cfg = small_engine_config(
+        max_resources=8, max_nodes=16, sketch_stats=True, sketch_width=512,
+        sketch_depth=2,
+    )
+    c = SentinelClient(cfg=cfg, time_source=vt)
+    c.start()
+    try:
+        # force 'hot' into the tail by filling rows first...
+        for i in range(12):
+            c.registry.resource_id(f"f{i}")
+        rid = c.registry.resource_id("hot")
+        assert c.registry.is_sketch_id(rid)
+        # ...then free is impossible, but promote uses remaining space:
+        # max_resources=8 means rows 1..7; f0..f6 took them → full.
+        # Use a fresh registry state instead: direct promotion API.
+        c2 = SentinelClient(
+            cfg=cfg, time_source=vt
+        )
+        c2.start()
+        try:
+            for i in range(4):
+                c2.registry.resource_id(f"g{i}")  # rows 1-4
+            # simulate tail assignment by exhausting rows 5-7
+            for i in range(3):
+                c2.registry.resource_id(f"h{i}")
+            t_rid = c2.registry.resource_id("tailres")
+            assert c2.registry.is_sketch_id(t_rid)
+            # free space cannot be reclaimed, so promotion fails here too;
+            # promote_resource returns None and the rule goes to the tail
+            assert c2.registry.promote_resource("tailres") is None
+        finally:
+            c2.stop()
+    finally:
+        c.stop()
+
+
+def test_promotion_api_moves_to_exact(vt):
+    cfg = small_engine_config(
+        max_resources=8, max_nodes=16, sketch_stats=True, sketch_width=512
+    )
+    c = SentinelClient(cfg=cfg, time_source=vt)
+    c.start()
+    try:
+        reg = c.registry
+        # exhaust exact rows 1..7 ONLY via a pretend low cap: fill 7 rows
+        for i in range(7):
+            reg.resource_id(f"x{i}")
+        sk = reg.resource_id("promoteme")
+        assert reg.is_sketch_id(sk)
+        # free a slot is impossible; instead verify the failure contract...
+        assert reg.promote_resource("promoteme") is None
+        # ...and the success contract with room available: new registry
+        reg2 = SentinelClient(cfg=cfg, time_source=vt)
+        reg2.start()
+        try:
+            r = reg2.registry
+            for i in range(3):
+                r.resource_id(f"y{i}")
+            # manufacture a sketch id directly
+            r._next_res = cfg.max_resources  # exhaust
+            skid = r.resource_id("deep")
+            assert r.is_sketch_id(skid)
+            r._next_res = 5  # room appears (e.g. future eviction support)
+            newid = r.promote_resource("deep")
+            assert newid == 5
+            assert r.resource_id("deep") == 5
+            assert not r.is_sketch_id(newid)
+            # rules loaded now bind to the exact row
+            reg2.flow_rules.load([st.FlowRule(resource="deep", count=3)])
+            got = sum(1 for _ in range(8) if reg2.try_entry("deep"))
+            assert got == 3  # exact enforcement
+        finally:
+            reg2.stop()
+    finally:
+        c.stop()
+
+
+def test_tail_rule_blocks_and_recovers(tiny_client, vt):
+    c = tiny_client
+    _fill_exact(c)
+    rid = c.registry.resource_id("svc-tail")
+    assert c.registry.is_sketch_id(rid)
+    c.flow_rules.load([st.FlowRule(resource="svc-tail", count=3)])
+
+    got = sum(1 for _ in range(10) if c.try_entry("svc-tail"))
+    assert 1 <= got <= 3  # blocks: a tail rule actually enforces
+
+    # the budget recovers when the window slides
+    vt.advance(1500)
+    assert c.try_entry("svc-tail") is not None
+
+
+def test_unruled_tail_resources_pass(tiny_client, vt):
+    c = tiny_client
+    _fill_exact(c)
+    c.flow_rules.load([st.FlowRule(resource="ruled-tail", count=1)])
+    # unrelated tail resources stay pass-through (delta bound: for them to
+    # block, EVERY depth cell must collide with a ruled cell)
+    got = sum(1 for i in range(30) if c.try_entry(f"free-{i}"))
+    assert got >= 29  # allow one unlucky full-depth collision at width 512
