@@ -17,9 +17,10 @@ MSG_TYPE_CONCURRENT_RELEASE = 4
 # TPU server answers it with n unit-acquires in ONE engine tick.
 MSG_TYPE_FLOW_BATCH = 10
 # extension: host-shard RESOURCE batch check (parallel/remote_shard.py) —
-# a mixed batch of (resource-name, count, prioritized) triplets answered
-# with per-item (verdict, wait_ms); lets a ShardRouter treat a remote host
-# as a shard over the same framing/codec as token requests
+# a mixed batch of 5-tuples (name, count, prioritized, origin, typed-param:
+# "i:<n>"/"s:<text>"/"") answered with per-item (verdict, wait_ms); lets a
+# ShardRouter treat a remote host as a shard over the same framing/codec
+# as token requests
 MSG_TYPE_RES_CHECK = 12
 
 # -- token result status (TokenResultStatus.java) ----------------------------

@@ -127,7 +127,7 @@ def encode_request(req: ClusterRequest) -> bytes:
     elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
         payload = struct.pack(">q", req.token_id)
     elif t == C.MSG_TYPE_RES_CHECK:
-        # params = flat [name, count, prio, name, count, prio, ...]
+        # params = flat 5-tuples (name, count, prio, origin, typed-param)
         payload = _pack_params(req.params)
     else:
         raise ValueError(f"bad request type {t}")

@@ -292,12 +292,14 @@ class ClusterTokenServer:
                     xs = str(x)
                     if not xs:
                         pvals.append(None)
-                    elif xs.startswith("#"):
+                    elif xs.startswith("i:"):
                         try:
-                            pvals.append(int(xs[1:]))
+                            pvals.append(int(xs[2:]))
                         except ValueError:
-                            pvals.append(xs)
-                    else:
+                            pvals.append(xs[2:])
+                    elif xs.startswith("s:"):
+                        pvals.append(xs[2:])
+                    else:  # legacy/bare value
                         pvals.append(xs)
                 res = self.service.client.check_batch(
                     names,

@@ -59,6 +59,10 @@ class ClusterStateManager:
         namespace: str = C.DEFAULT_NAMESPACE,
     ) -> None:
         with self._lock:
+            # keep the token service so a later set_to_server (dashboard
+            # re-assignment) can revive this machine as a server
+            if self._embedded is not None:
+                self._last_service = self._embedded
             self._stop_server_locked()
             if host is not None:
                 self.client_config.apply_assign(host, port or C.DEFAULT_PORT)
@@ -86,7 +90,11 @@ class ClusterStateManager:
             if self._token_client is not None:
                 self._token_client.close()
                 self._token_client = None
+            # idempotent: a machine already in server mode (dashboard
+            # re-assign) must not double-bind its port
+            self._stop_server_locked()
             self._embedded = token_service
+            self._last_service = token_service
             if serve_network:
                 self._server = ClusterTokenServer(token_service, port=port)
                 self._server.start()

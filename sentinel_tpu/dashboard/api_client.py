@@ -44,7 +44,9 @@ class SentinelApiClient:
 
     def _post(self, ip: str, port: int, command: str, **params) -> str:
         url = f"http://{ip}:{port}/{command}"
-        body = urllib.parse.urlencode(params).encode("ascii")
+        body = urllib.parse.urlencode(
+            {k: v for k, v in params.items() if v is not None}
+        ).encode("ascii")
         req = urllib.request.Request(
             url, data=body, method="POST", headers=self._headers()
         )
@@ -92,5 +94,16 @@ class SentinelApiClient:
     def get_cluster_mode(self, ip: str, port: int) -> dict:
         return json.loads(self._get(ip, port, "getClusterMode"))
 
-    def set_cluster_mode(self, ip: str, port: int, mode: int) -> bool:
-        return self._post(ip, port, "setClusterMode", mode=mode) == "success"
+    def set_cluster_mode(
+        self, ip: str, port: int, mode: int, host: str = None, token_port: int = None
+    ) -> bool:
+        return (
+            self._post(
+                ip, port, "setClusterMode", mode=mode, host=host,
+                tokenPort=token_port,
+            )
+            == "success"
+        )
+
+    def get_cluster_server_info(self, ip: str, port: int) -> dict:
+        return json.loads(self._get(ip, port, "clusterServerInfo"))

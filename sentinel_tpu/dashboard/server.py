@@ -203,6 +203,7 @@ class DashboardServer:
             ("POST", "/rules"): self._set_rules,
             ("GET", "/cluster/mode"): self._get_cluster_mode,
             ("POST", "/cluster/mode"): self._set_cluster_mode,
+            ("POST", "/cluster/assign"): self._cluster_assign,
             ("GET", "/tree"): self._tree,
         }
 
@@ -304,6 +305,61 @@ class DashboardServer:
             return 400, {"error": "no target machines"}
         pushed = sum(1 for ip, port in targets if self.api.set_rules(ip, port, type_, rules))
         return 200, {"code": 0, "pushed": pushed, "targets": len(targets)}
+
+    def _cluster_assign(self, params, body):
+        """One-shot token-server/client assignment across machines
+        (ClusterAssignServiceImpl.java analog): body JSON names the server
+        machine and the client machines; the dashboard flips the server
+        first, reads its bound token port, then points every client at it.
+
+            {"server": {"ip": ..., "port": ...},      # command-plane addr
+             "clients": [{"ip": ..., "port": ...}, ...],
+             "tokenPort": optional fixed port}
+
+        Every machine must be heartbeat-registered (same SSRF guard as the
+        proxy routes).  Partial failures report per-machine results so the
+        operator can retry the stragglers."""
+        try:
+            spec = json.loads(body or "{}")
+        except ValueError:
+            return 400, {"error": "invalid JSON body"}
+        srv = spec.get("server") or {}
+        try:
+            sip, sport = self._machine_of(srv)
+        except ValueError as e:
+            return 400, {"error": f"server: {e}"}
+        results = {"server": None, "clients": []}
+        from sentinel_tpu.cluster import state as CS
+
+        tok_port = spec.get("tokenPort")
+        ok = self.api.set_cluster_mode(
+            sip, sport, CS.CLUSTER_SERVER, token_port=tok_port
+        )
+        if not ok:
+            return 502, {"error": f"server flip failed on {sip}:{sport}"}
+        try:
+            info = self.api.get_cluster_server_info(sip, sport)
+            token_port = int(info.get("tokenPort", -1))
+        except Exception:
+            token_port = -1
+        if token_port <= 0:
+            return 502, {"error": "server reports no token port"}
+        results["server"] = {"ip": sip, "tokenPort": token_port}
+        for cm in spec.get("clients") or []:
+            try:
+                cip, cport = self._machine_of(cm)
+                ok = self.api.set_cluster_mode(
+                    cip, cport, CS.CLUSTER_CLIENT, host=sip, token_port=token_port
+                )
+                results["clients"].append(
+                    {"ip": cip, "port": cport, "ok": bool(ok)}
+                )
+            except Exception as e:
+                results["clients"].append(
+                    {"ip": cm.get("ip"), "port": cm.get("port"), "ok": False,
+                     "error": str(e)}
+                )
+        return 200, results
 
     def _get_cluster_mode(self, params, body):
         ip, port = self._machine_of(params)

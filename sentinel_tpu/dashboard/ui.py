@@ -43,6 +43,15 @@ PAGE = r"""<!doctype html>
 <h2>flow rules <span class="muted">(first healthy machine)</span></h2>
 <table id="rules"><tr><th>resource</th><th>count</th><th>grade</th><th>behavior</th><th>limitApp</th></tr></table>
 
+<h2>cluster assignment</h2>
+<div class="muted">pick one machine as token server; every other healthy
+machine of the app becomes its client (POST /cluster/assign)</div>
+<div>
+  <label>server <select id="srv"></select></label>
+  <button id="assign">assign</button>
+  <span id="assignout" class="muted"></span>
+</div>
+
 <script>
 const $ = id => document.getElementById(id);
 // every server-sourced string goes through esc(): machine fields arrive via
@@ -127,12 +136,43 @@ async function refreshRules() {
   }
 }
 
+async function refreshAssign() {
+  const app = $("app").value;
+  const sel = $("srv"), cur = sel.value;
+  sel.innerHTML = "";
+  (apps[app] || []).filter(m => m.healthy).forEach(m =>
+    sel.add(new Option(`${m.ip}:${m.port}`, `${m.ip}:${m.port}`)));
+  if (cur) sel.value = cur;
+}
+
+$("assign").onclick = async () => {
+  const app = $("app").value, pick = $("srv").value;
+  if (!pick) return;
+  const [sip, sport] = pick.split(":");
+  const clients = (apps[app] || []).filter(
+    m => m.healthy && `${m.ip}:${m.port}` !== pick
+  ).map(m => ({ip: m.ip, port: m.port}));
+  try {
+    const r = await fetch("/cluster/assign", {
+      method: "POST",
+      headers: {...hdrs(), "Content-Type": "application/json"},
+      body: JSON.stringify({server: {ip: sip, port: +sport}, clients}),
+    });
+    const d = await r.json();
+    $("assignout").textContent = r.ok
+      ? `server ${esc(d.server.ip)} token port ${esc(d.server.tokenPort)}, ` +
+        `${d.clients.filter(c => c.ok).length}/${d.clients.length} clients flipped`
+      : `failed: ${esc(d.error || r.status)}`;
+  } catch (e) { $("assignout").textContent = String(e); }
+};
+
 async function tick() {
   try {
     await refreshApps();
     await refreshResources();
     await refreshChart();
     await refreshRules();
+    await refreshAssign();
     $("err").textContent = "";
   } catch (e) { $("err").textContent = String(e); }
   // self-rescheduling chain: a slow machine round-trip must not pile up

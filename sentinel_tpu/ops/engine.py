@@ -1549,6 +1549,96 @@ def compile_ruleset(
     return jax.device_put(rs)
 
 
+def migrate_state(
+    state: EngineState,
+    old_cfg: EngineConfig,
+    new_cfg: EngineConfig,
+    now_ms: int,
+) -> EngineState:
+    """Carry engine state across a WINDOW-SHAPE change (the live analog of
+    IntervalProperty/SampleCountProperty, node/IntervalProperty.java —
+    which the reference handles by resetting node metrics; here the
+    current windowed totals MIGRATE so admission budgets don't reopen).
+
+    Only window shapes may differ (second/minute sample counts + lengths);
+    capacity knobs must match — the caller (SentinelClient.
+    update_window_shape) guarantees it.  Sliding detail below bucket
+    granularity is coarsened: the old window's TOTALS land in the new
+    shape's current bucket, so the new window initially sees the whole old
+    window (budgets stay conservative) and decays after one new interval.
+
+    gs/rtq observability re-initializes when their bucket grid changes —
+    a transient visible only to dashboards, never to rule checks."""
+    import dataclasses
+
+    same_caps = dataclasses.replace(
+        old_cfg,
+        second_sample_count=new_cfg.second_sample_count,
+        second_window_ms=new_cfg.second_window_ms,
+        minute_sample_count=new_cfg.minute_sample_count,
+        minute_window_ms=new_cfg.minute_window_ms,
+    )
+    if same_caps != new_cfg:
+        raise ValueError("migrate_state only supports window-shape changes")
+
+    now = jnp.int32(now_ms)
+    out = init_state(new_cfg)
+
+    def carry(old_win, o_cfg: W.WindowConfig, n_cfg: W.WindowConfig, new_win):
+        counts = W.window_counts(old_win, now, o_cfg)  # [rows, NE]
+        rt_tot, rt_min = W.window_rt(old_win, now, o_cfg)
+        wid = (now // n_cfg.window_ms).astype(jnp.int32)
+        idx = wid % n_cfg.sample_count
+        return W.WindowState(
+            counts=new_win.counts.at[:, idx, :].set(counts.astype(jnp.int32)),
+            rt_sum=new_win.rt_sum.at[:, idx].set(rt_tot),
+            rt_min=new_win.rt_min.at[:, idx].set(rt_min),
+            epochs=new_win.epochs.at[idx].set(wid),
+        )
+
+    o_sec = W.WindowConfig(old_cfg.second_sample_count, old_cfg.second_window_ms)
+    n_sec = W.WindowConfig(new_cfg.second_sample_count, new_cfg.second_window_ms)
+    win_sec = carry(state.win_sec, o_sec, n_sec, out.win_sec)
+    win_min = out.win_min
+    if new_cfg.enable_minute_window and old_cfg.enable_minute_window:
+        o_min = W.WindowConfig(old_cfg.minute_sample_count, old_cfg.minute_window_ms)
+        n_min = W.WindowConfig(new_cfg.minute_sample_count, new_cfg.minute_window_ms)
+        win_min = carry(state.win_min, o_min, n_min, out.win_min)
+
+    # shape-stable fields carry over verbatim; gs/rtq keep their state when
+    # the grid is unchanged, else restart fresh
+    gs = state.gs if out.gs.counts.shape == state.gs.counts.shape else out.gs
+    rtq = state.rtq if out.rtq.counts.shape == state.rtq.counts.shape else out.rtq
+    return out._replace(
+        win_sec=win_sec,
+        win_min=win_min,
+        concurrency=state.concurrency,
+        latest_passed_ms=state.latest_passed_ms,
+        warmup_tokens=state.warmup_tokens,
+        warmup_last_s=state.warmup_last_s,
+        warm_acc=state.warm_acc,
+        # occupy epochs are denominated in second-window ids: a changed
+        # bucket length invalidates them, so pending borrowed-ahead grants
+        # drop (their holders already got PASS_WAIT; only the deferred
+        # PASS statistic is lost — bounded by one bucket's borrow pool)
+        occ_tokens=state.occ_tokens
+        if old_cfg.second_window_ms == new_cfg.second_window_ms
+        else out.occ_tokens,
+        occ_epoch=state.occ_epoch
+        if old_cfg.second_window_ms == new_cfg.second_window_ms
+        else out.occ_epoch,
+        cb_state=state.cb_state,
+        cb_retry_ms=state.cb_retry_ms,
+        cb_counts=state.cb_counts,
+        cb_epochs=state.cb_epochs,
+        pcms=state.pcms,
+        pcms_epochs=state.pcms_epochs,
+        pconc=state.pconc,
+        gs=gs,
+        rtq=rtq,
+    )
+
+
 _TICK_CACHE: dict = {}
 
 

@@ -63,13 +63,13 @@ class RemoteShard:
                 pass
             self._sock = None
 
-    def _rpc(self, req: P.ClusterRequest) -> P.ClusterResponse:
-        """One request/response on the live connection; raises OSError on
-        any transport trouble (caller degrades)."""
+    def _rpc_wire(self, wire: bytes) -> P.ClusterResponse:
+        """One pre-encoded request/response on the live connection; raises
+        OSError on any transport trouble (caller degrades)."""
         if self._sock is None:
             self._sock = self._connect()
         s = self._sock
-        s.sendall(P.encode_request(req))
+        s.sendall(wire)
         head = b""
         while len(head) < 2:
             chunk = s.recv(2 - len(head))
@@ -118,21 +118,21 @@ class RemoteShard:
     def _check_chunk(
         self, resources, counts, origins, params, prioritized, **kw
     ) -> List[Tuple[int, int]]:
-        # wire layout: 5-tuples (name, count, prio, origin, param-as-str);
-        # '' = no origin / no param.  hash_param treats int and str('<int>')
-        # differently only through int-vs-str dispatch, so ints round-trip
-        # via a "#<n>" marker the server decodes back to int.
+        # wire layout: 5-tuples (name, count, prio, origin, param) with the
+        # param TYPED via prefix — "i:<n>" int, "s:<text>" string, "" none —
+        # so hash_param's int-vs-str dispatch matches local enforcement for
+        # every value (a bare marker would collide with real strings)
         flat: List[Any] = []
         for i, name in enumerate(resources):
             pv = params[i] if params else None
             if isinstance(pv, bool):
                 pv = int(pv)
             if isinstance(pv, int):
-                pv_s = f"#{pv}"
+                pv_s = f"i:{pv}"
             elif pv is None:
                 pv_s = ""
             else:
-                pv_s = str(pv)
+                pv_s = f"s:{pv}"
             flat += [
                 name,
                 counts[i] if counts else 1,
@@ -140,31 +140,42 @@ class RemoteShard:
                 (origins[i] or "") if origins else "",
                 pv_s,
             ]
+        # encode BEFORE touching the socket: an oversized frame is a
+        # CLIENT-side problem and must not close a healthy connection or
+        # trip the cool-down (same convention as ClusterTokenClient's
+        # bad-request sentinel) — it degrades just this call
+        try:
+            self._xid += 1
+            wire = P.encode_request(
+                P.ClusterRequest(
+                    xid=self._xid, type=C.MSG_TYPE_RES_CHECK, params=flat
+                )
+            )
+        except ValueError:
+            record_log().warning(
+                "RES_CHECK chunk exceeds frame cap — degrading this call"
+            )
+            wire = None
         with self._lock:
-            now = time.monotonic()
-            if now >= self._down_until:
+            if wire is not None and time.monotonic() >= self._down_until:
                 for attempt in (0, 1):  # one reconnect, like the netty client
                     try:
-                        self._xid += 1
-                        rsp = self._rpc(
-                            P.ClusterRequest(
-                                xid=self._xid,
-                                type=C.MSG_TYPE_RES_CHECK,
-                                params=flat,
-                            )
-                        )
+                        rsp = self._rpc_wire(wire)
                         if rsp.status == C.STATUS_OK and len(rsp.items) == len(
                             resources
                         ):
                             return [(int(v), int(w)) for v, w in rsp.items]
                         break  # malformed answer -> degrade this call
-                    except (OSError, ValueError, struct.error):
-                        # ValueError/struct.error: oversized or mangled
-                        # frames degrade like transport loss, never crash
-                        # the router call
+                    except OSError:
                         self._close()
                         if attempt == 1:
-                            self._down_until = now + self.retry_interval_s
+                            # cool-down anchored at FAILURE time: connect
+                            # timeouts can burn seconds inside the attempts,
+                            # and an entry-time anchor would already be in
+                            # the past, silently disabling the cool-down
+                            self._down_until = (
+                                time.monotonic() + self.retry_interval_s
+                            )
                             record_log().warning(
                                 "shard %s:%d unreachable — degrading for %.1fs",
                                 self.host,

@@ -1060,6 +1060,89 @@ class SentinelClient:
                 return
             now_ms = None  # subsequent drain loops use fresh time
 
+    def update_window_shape(
+        self,
+        sample_count: Optional[int] = None,
+        window_ms: Optional[int] = None,
+        minute_sample_count: Optional[int] = None,
+        minute_window_ms: Optional[int] = None,
+    ) -> None:
+        """LIVE window reshaping — the IntervalProperty/SampleCountProperty
+        analog (node/IntervalProperty.java): swap the engine onto a new
+        window grid under the tick lock, MIGRATING current windowed totals
+        so admission budgets don't reopen mid-flight (the reference resets
+        node metrics instead).  The new tick compiles before the swap
+        completes, so serving never waits on XLA."""
+        import dataclasses
+
+        changes = {}
+        if sample_count is not None:
+            changes["second_sample_count"] = int(sample_count)
+        if window_ms is not None:
+            changes["second_window_ms"] = int(window_ms)
+        if minute_sample_count is not None:
+            changes["minute_sample_count"] = int(minute_sample_count)
+        if minute_window_ms is not None:
+            changes["minute_window_ms"] = int(minute_window_ms)
+        if not changes:
+            return
+        new_cfg = dataclasses.replace(self.cfg, **changes)
+        if new_cfg == self.cfg:
+            return
+        new_tick = E.make_tick(new_cfg, donate=True, features=self._features)
+        # pre-compile BOTH batch shapes against a throwaway state while the
+        # old engine keeps serving: XLA compiles take seconds, and a window
+        # whose budget migrated would legitimately EXPIRE during that gap —
+        # compiling first makes the actual swap a few ms of migration math
+        z = jnp.float32(0.0)
+        dummy = E.init_state(new_cfg)
+        for bs in {min(256, new_cfg.batch_size), new_cfg.batch_size}:
+            dummy, _ = new_tick(
+                dummy,
+                self._rules_dev,
+                E.empty_acquire(new_cfg, b=bs),
+                E.empty_complete(
+                    new_cfg, b=min(bs, new_cfg.complete_batch_size)
+                ),
+                jnp.int32(self.time.now_ms()),
+                z,
+                z,
+            )
+        jax.block_until_ready(dummy.concurrency)
+        with self._engine_lock:
+            old_cfg = self.cfg
+            self._state = E.migrate_state(
+                self._state, old_cfg, new_cfg, self.time.now_ms()
+            )
+            self.cfg = new_cfg
+            self.registry.cfg = new_cfg
+            self._tick = new_tick
+        # ruleset tensors are capacity-shaped, not window-shaped — the
+        # recompile only keeps future rule edits keyed to the active cfg
+        self._recompile_rules()
+
+    def register_window_property(self, prop) -> None:
+        """Subscribe window shape to a SentinelProperty pushing dicts like
+        {"sampleCount": 4, "intervalMs": 1000} — datasource-driven live
+        reshaping (SampleCountProperty.register2Property analog)."""
+        from sentinel_tpu.datasource.property import SimplePropertyListener
+
+        def apply(v):
+            if not v:
+                return
+            # reference semantics: intervalMs is the TOTAL window and
+            # sampleCount re-slices it — missing fields default to the
+            # CURRENT values so a partial push never changes the other
+            # dimension (a sampleCount-only push must not grow the window)
+            cur_total = self.cfg.second_sample_count * self.cfg.second_window_ms
+            sc = int(v.get("sampleCount") or self.cfg.second_sample_count)
+            iv = int(v.get("intervalMs") or cur_total)
+            if sc <= 0 or iv <= 0 or iv % sc:
+                return
+            self.update_window_shape(sample_count=sc, window_ms=iv // sc)
+
+        prop.add_listener(SimplePropertyListener(apply))
+
     def attach_front_door(self, door) -> None:
         """Serve a NativeFrontDoor's traffic from this client's tick loop:
         its pending acquires join every engine batch as array lanes and

@@ -33,10 +33,16 @@ class Registry:
     def __init__(self, cfg: EngineConfig):
         self.cfg = cfg
         self._lock = threading.RLock()
-        # resource rows occupy [1, max_resources); row 0 is the ENTRY node
+        # resource rows occupy [1, max_resources); row 0 is the ENTRY node.
+        # The TOP of the row space is a PROMOTION RESERVE: ordinary
+        # first-use interning stops short of it, so a rule arriving for a
+        # tail resource can still claim an exact row (SALSA-style hot
+        # promotion) after organic traffic has "filled" the space.
         self._resources: Dict[str, int] = {}
         self._resource_names: List[Optional[str]] = [None] * 1
         self._next_res = 1
+        reserve = min(max(cfg.max_resources // 16, 1), max(cfg.max_resources // 2, 1))
+        self._organic_limit = max(cfg.max_resources - reserve, 2)
         # extra stat rows (origin nodes, context default-nodes) live in
         # [max_resources, max_nodes)
         self._extra_rows: Dict[Tuple[str, str], int] = {}
@@ -66,10 +72,10 @@ class Registry:
             rid = self._resources.get(name)
             if rid is not None:
                 return rid
-            if self._next_res >= self.cfg.max_resources:
-                # exact rows exhausted → sketch id (observability-only,
-                # no rules), or pass-through when the sketch is off
-                # (CtSph.java:200-205 degradation)
+            if self._next_res >= self._organic_limit:
+                # organic rows exhausted (the remainder is the promotion
+                # reserve) → sketch id, or pass-through when the sketch is
+                # off (CtSph.java:200-205 degradation)
                 if (
                     self.cfg.sketch_stats
                     and self._next_sketch - self.cfg.node_rows
@@ -102,11 +108,13 @@ class Registry:
             if rid is None or rid < self.cfg.node_rows:
                 return rid  # unknown or already exact
             if self._next_res >= self.cfg.max_resources:
-                return None
+                return None  # even the reserve is spent
             new = self._next_res
             self._next_res += 1
             self._resources[name] = new
-            self._resource_names.append(name)
+            while len(self._resource_names) <= new:
+                self._resource_names.append(None)
+            self._resource_names[new] = name
             self._sketch_names.pop(rid, None)
             return new
 

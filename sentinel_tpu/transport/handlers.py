@@ -236,15 +236,41 @@ class DefaultHandlerGroup:
 
         mode = int(req.param("mode", "-99"))
         if mode == CS.CLUSTER_CLIENT:
-            self.cluster.set_to_client()
+            # optional assignment: which token server this client consults
+            # (the dashboard's assign flow pushes it with the flip —
+            # ClusterClientAssignConfig analog)
+            host = req.param("host", "") or None
+            port = req.param("tokenPort", "")
+            self.cluster.set_to_client(
+                host=host, port=int(port) if port else None
+            )
         elif mode == CS.CLUSTER_SERVER:
-            svc = self.cluster._embedded
+            svc = self.cluster._embedded or getattr(
+                self.cluster, "_last_service", None
+            )
             if svc is None:
                 return CommandResponse.of_failure("no token service configured for server mode")
-            self.cluster.set_to_server(svc)
+            port = req.param("tokenPort", "")
+            self.cluster.set_to_server(svc, port=int(port) if port else None)
         else:
             return CommandResponse.of_failure(f"invalid mode: {mode}")
         return CommandResponse.of_success("success")
+
+    @command_mapping("clusterServerInfo", "embedded token server state")
+    def cluster_server_info(self, req: CommandRequest) -> CommandResponse:
+        """Port + liveness of this instance's token server — the assign
+        flow reads it to point client machines at the right address
+        (ClusterServerStateVO analog)."""
+        if self.cluster is None:
+            return CommandResponse.of_failure("cluster not configured")
+        srv = self.cluster.server
+        return CommandResponse.of_success(
+            {
+                "mode": self.cluster.mode,
+                "tokenPort": srv.port if srv is not None else -1,
+                "running": srv is not None,
+            }
+        )
 
 
 def build_default_handlers(

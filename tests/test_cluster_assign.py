@@ -1,0 +1,105 @@
+"""Dashboard-driven cluster assignment round trip
+(ClusterAssignServiceImpl.java analog): assign one machine as token
+server + the rest as clients in ONE operation, then verify the clients'
+token traffic actually flows to the new server.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import constants as CC
+from sentinel_tpu.cluster import state as CS
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.dashboard import DashboardServer, MachineInfo
+from sentinel_tpu.runtime.client import SentinelClient
+from sentinel_tpu.transport import SimpleHttpCommandCenter, build_default_handlers
+
+
+def _machine(name):
+    """One 'machine': threaded client + token service + cluster state +
+    command center on an ephemeral port."""
+    client = SentinelClient(cfg=small_engine_config(), mode="threaded", tick_interval_ms=2.0)
+    client.start()
+    svc = DefaultTokenService(client)
+    svc.flow_rules.load(
+        "default",
+        [
+            st.FlowRule(
+                resource="res-101", count=3.0, cluster_mode=True, cluster_flow_id=101
+            )
+        ],
+    )
+    cluster = CS.ClusterStateManager()
+    cluster._embedded = svc
+    cc = SimpleHttpCommandCenter(
+        build_default_handlers(client, cluster=cluster), host="127.0.0.1", port=0
+    )
+    cc.start()
+    return client, svc, cluster, cc
+
+
+@pytest.fixture()
+def assign_world():
+    a = _machine("a")
+    b = _machine("b")
+    dash = DashboardServer(host="127.0.0.1", port=0, fetch_metrics=False)
+    for cc in (a[3], b[3]):
+        dash.discovery.register(MachineInfo(app="app", ip="127.0.0.1", port=cc.port))
+    dash.start()
+    yield a, b, dash
+    dash.stop()
+    for client, _svc, cluster, cc in (a, b):
+        cc.stop()
+        cluster.stop()
+        client.stop()
+
+
+def test_assign_round_trip(assign_world):
+    (ca, sa, cla, cca), (cb, sb, clb, ccb), dash = assign_world
+
+    body = json.dumps(
+        {
+            "server": {"ip": "127.0.0.1", "port": cca.port},
+            "clients": [{"ip": "127.0.0.1", "port": ccb.port}],
+        }
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{dash.port}/cluster/assign",
+        data=body,
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=20) as rsp:
+        out = json.loads(rsp.read())
+
+    # machines flipped
+    assert cla.mode == CS.CLUSTER_SERVER
+    assert clb.mode == CS.CLUSTER_CLIENT
+    assert out["server"]["tokenPort"] > 0
+    assert out["clients"][0]["ok"] is True
+
+    # the client machine's token traffic reaches the new server: count=3
+    tok = clb._token_client
+    statuses = [tok.request_token(101).status for _ in range(5)]
+    assert statuses.count(CC.STATUS_OK) == 3
+    assert statuses.count(CC.STATUS_BLOCKED) == 2
+
+
+def test_assign_rejects_unknown_machines(assign_world):
+    (_a, _sa, _cla, _cca), _b, dash = assign_world
+    body = json.dumps(
+        {"server": {"ip": "10.9.9.9", "port": 1}, "clients": []}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{dash.port}/cluster/assign",
+        data=body,
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400

@@ -1,0 +1,61 @@
+"""Live window reshaping under traffic (IntervalProperty analog).
+
+The reference pushes IntervalProperty/SampleCountProperty updates that
+reshape LeapArrays at runtime (node/IntervalProperty.java — resetting
+metrics); here the reshape migrates current windowed totals so budgets
+hold across the swap.
+"""
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource.property import DynamicSentinelProperty
+
+
+def test_reshape_preserves_budget_under_traffic(client, vt):
+    client.flow_rules.load([st.FlowRule(resource="api", count=5)])
+    assert client.cfg.second_sample_count == 2
+    got = sum(1 for _ in range(3) if client.try_entry("api"))
+    assert got == 3
+
+    # reshape 2x500ms -> 4x250ms mid-window
+    client.update_window_shape(sample_count=4, window_ms=250)
+    assert client.cfg.second_sample_count == 4
+    assert client.cfg.second_window_ms == 250
+
+    # the 3 admitted entries migrated: only 2 more fit the budget
+    got2 = sum(1 for _ in range(5) if client.try_entry("api"))
+    assert got2 == 2
+
+    # stats survived the reshape too
+    snap = client.stats.resource("api")
+    assert snap["passQps"] == 5.0
+    assert snap["blockQps"] == 3.0
+
+    # after the (new) interval passes, the budget reopens
+    vt.advance(1100)
+    assert client.try_entry("api") is not None
+
+
+def test_reshape_via_property_push(client, vt):
+    client.flow_rules.load([st.FlowRule(resource="p", count=4)])
+    prop = DynamicSentinelProperty()
+    client.register_window_property(prop)
+    assert sum(1 for _ in range(2) if client.try_entry("p")) == 2
+
+    prop.update_value({"sampleCount": 5, "intervalMs": 1000})
+    assert client.cfg.second_sample_count == 5
+    assert client.cfg.second_window_ms == 200
+
+    # budget continuity: 2 consumed before the push, 2 remain
+    assert sum(1 for _ in range(4) if client.try_entry("p")) == 2
+
+
+def test_reshape_rejects_capacity_changes(client, vt):
+    import dataclasses
+
+    import pytest
+
+    from sentinel_tpu.ops import engine as E
+
+    bad = dataclasses.replace(client.cfg, max_flow_rules=client.cfg.max_flow_rules * 2)
+    with pytest.raises(ValueError):
+        E.migrate_state(client._state, client.cfg, bad, client.time.now_ms())
