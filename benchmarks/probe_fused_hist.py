@@ -1,0 +1,155 @@
+"""Probe: fused Pallas histogram (counts+RT digit planes in ONE kernel)
+vs the current XLA one-hot-matmul path, at the stat-landing shape
+(3B fanned rows, node_rows table). Run on the real TPU."""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from sentinel_tpu.core.config import EngineConfig
+    from sentinel_tpu.ops import tables as T
+
+    B = 131072
+    N3 = 3 * B
+    n_rows = 16640  # node_rows at bench shape
+    cfg = EngineConfig(
+        max_resources=16384, max_nodes=16384, batch_size=B,
+        use_mxu_tables=True,
+    )
+    rng = np.random.default_rng(0)
+    rows_np = rng.integers(0, n_rows + 200, N3).astype(np.int32)
+    ids = jnp.asarray(rows_np)
+    cnts_np = rng.integers(0, 2, (N3, 3), dtype=np.int32)
+    cnts = jnp.asarray(cnts_np)
+    rt_np = rng.integers(0, 40000, N3, dtype=np.int32)
+    rt = jnp.asarray(rt_np)
+
+    def timed(name, fn, K=24):
+        j = jax.jit(fn)
+        out0 = jax.block_until_ready(j(jnp.int32(0)))
+        ts = []
+        for s in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(j(jnp.int32(s + 1)))
+            ts.append(time.perf_counter() - t0)
+        print(f"{name:52s} {min(ts)/K*1000:8.3f} ms")
+        return out0
+
+    def scan_wrap(body, K=24):
+        def fn(seed):
+            def step(c, i):
+                o = body(i + c)
+                return jnp.sum(o.astype(jnp.float32)).astype(jnp.int32) % 3, None
+            c, _ = jax.lax.scan(step, jnp.int32(seed), jnp.arange(K))
+            return c
+        return fn
+
+    # --- XLA current path: 4 planes, counts at max_int=65535 --------------
+    vals4 = jnp.concatenate([cnts, rt[:, None]], axis=1)
+
+    def xla_cur(i):
+        return T.histogram(cfg, ids ^ (i % 2), vals4, n_rows)
+    timed("XLA histogram 3cnt(2dig)+rt(2dig)", scan_wrap(xla_cur))
+
+    def xla_1dig(i):
+        h1 = T.histogram(cfg, ids ^ (i % 2), cnts, n_rows, max_int=255)
+        h2 = T.histogram(cfg, ids ^ (i % 2), rt, n_rows, max_int=65535)
+        return h1[:, 0] + h2
+    timed("XLA histogram 3cnt(1dig) + rt sep", scan_wrap(xla_1dig))
+
+    # --- fused pallas: 5 digit planes, one kernel --------------------------
+    n_lo = 128
+    n_hi = (n_rows + n_lo - 1) // n_lo  # 130
+
+    def make_fused(TB):
+        nT = (N3 + TB - 1) // TB
+
+        def kernel(ids_ref, cnt_ref, rt_ref, out_ref):
+            t = pl.program_id(0)
+
+            @pl.when(t == 0)
+            def _():
+                out_ref[...] = jnp.zeros_like(out_ref)
+
+            k = ids_ref[0, 0, :]
+            ok = (k >= 0) & (k < n_rows)
+            safe = jnp.where(ok, k, 0)
+            hi = safe // n_lo
+            lo = safe - hi * n_lo
+            oki = ok.astype(jnp.int32)[:, None]
+            iota_h = jax.lax.broadcasted_iota(jnp.int32, (TB, n_hi), 1)
+            iota_l = jax.lax.broadcasted_iota(jnp.int32, (TB, n_lo), 1)
+            Hi = ((hi[:, None] == iota_h) & (oki > 0)).astype(jnp.bfloat16)
+            Lo = (lo[:, None] == iota_l).astype(jnp.bfloat16)
+            HiT = Hi.T
+            # 3 count planes (1 digit each); [:, None] while 32-bit (Mosaic
+            # can't insert a minor dim on bf16)
+            for p in range(3):
+                dig = cnt_ref[0, :, p][:, None].astype(jnp.bfloat16)
+                out_ref[p, :, :] += jax.lax.dot(
+                    HiT, Lo * dig, preferred_element_type=jnp.float32
+                )
+            # rt: 2 digit planes
+            r = rt_ref[0, 0, :]
+            for d in range(2):
+                dig = (((r >> (8 * d)) & 0xFF))[:, None].astype(jnp.bfloat16)
+                out_ref[3 + d, :, :] += jax.lax.dot(
+                    HiT, Lo * dig, preferred_element_type=jnp.float32
+                )
+
+        pad = (-N3) % TB
+        ids_p = jnp.concatenate([ids, jnp.full((pad,), -1, jnp.int32)]) if pad else ids
+        cnt_p = jnp.concatenate([cnts, jnp.zeros((pad, 3), jnp.int32)]) if pad else cnts
+        rt_p = jnp.concatenate([rt, jnp.zeros((pad,), jnp.int32)]) if pad else rt
+        ids3 = ids_p.reshape(nT, 1, TB)
+        cnt3 = cnt_p.reshape(nT, TB, 3)
+        rt3 = rt_p.reshape(nT, 1, TB)
+
+        def run(i):
+            out = pl.pallas_call(
+                kernel,
+                grid=(nT,),
+                in_specs=[
+                    pl.BlockSpec((1, 1, TB), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, TB, 3), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, 1, TB), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((5, n_hi, n_lo), lambda t: (0, 0, 0), memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct((5, n_hi, n_lo), jnp.float32),
+            )(ids3 ^ (i % 2), cnt3, rt3)
+            return out
+
+        return run
+
+    for TB in (2048, 4096, 8192):
+        timed(f"pallas fused 5-plane TB={TB}", scan_wrap(make_fused(TB)))
+
+    # correctness vs numpy
+    out = jax.jit(make_fused(4096))(jnp.int32(0))
+    out = np.asarray(out).reshape(5, n_hi * n_lo)[:, :n_rows]
+    ref = np.zeros((5, n_rows), np.int64)
+    ok = (rows_np >= 0) & (rows_np < n_rows)
+    for p in range(3):
+        np.add.at(ref[p], rows_np[ok], cnts_np[ok, p])
+    np.add.at(ref[3], rows_np[ok], rt_np[ok] & 0xFF)
+    np.add.at(ref[4], rows_np[ok], (rt_np[ok] >> 8) & 0xFF)
+    assert np.array_equal(out.astype(np.int64), ref), "fused hist mismatch"
+    print("fused hist exact ✓")
+
+
+if __name__ == "__main__":
+    main()

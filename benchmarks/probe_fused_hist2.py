@@ -1,0 +1,153 @@
+"""Probe 2: fused histogram kernel variants — direct-HiT build (no
+transpose), dot_general contracting the item axis, plan-shape sweep."""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_C00 = (((0,), (0,)), ((), ()))  # [TB,A] x [TB,B] -> [A,B]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = 131072
+    N3 = 3 * B
+    n_rows = 16640
+    rng = np.random.default_rng(0)
+    rows_np = rng.integers(0, n_rows + 200, N3).astype(np.int32)
+    ids = jnp.asarray(rows_np)
+    cnts_np = rng.integers(0, 2, (N3, 3), dtype=np.int32)
+    cnts = jnp.asarray(cnts_np)
+    rt_np = rng.integers(0, 40000, N3, dtype=np.int32)
+    rt = jnp.asarray(rt_np)
+
+    def timed(name, fn, K=24):
+        j = jax.jit(fn)
+        try:
+            out0 = jax.block_until_ready(j(jnp.int32(0)))
+        except Exception as e:
+            print(f"{name:58s} FAILED: {str(e)[:90]}")
+            return None
+        ts = []
+        for s in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(j(jnp.int32(s + 1)))
+            ts.append(time.perf_counter() - t0)
+        print(f"{name:58s} {min(ts)/K*1000:8.3f} ms")
+        return out0
+
+    def scan_wrap(body, K=24):
+        def fn(seed):
+            def step(c, i):
+                o = body(i + c)
+                return jnp.sum(o.astype(jnp.float32)).astype(jnp.int32) % 3, None
+            c, _ = jax.lax.scan(step, jnp.int32(seed), jnp.arange(K))
+            return c
+        return fn
+
+    def make(TB, n_lo, mode):
+        n_hi = (n_rows + n_lo - 1) // n_lo
+        nT = (N3 + TB - 1) // TB
+
+        def kernel(ids_ref, cnt_ref, rt_ref, out_ref):
+            t = pl.program_id(0)
+
+            @pl.when(t == 0)
+            def _():
+                out_ref[...] = jnp.zeros_like(out_ref)
+
+            k = ids_ref[0, 0, :]
+            ok = (k >= 0) & (k < n_rows)
+            safe = jnp.where(ok, k, 0)
+            hi = safe // n_lo
+            lo = safe - hi * n_lo
+            oki = ok.astype(jnp.int32)
+            iota_l = jax.lax.broadcasted_iota(jnp.int32, (TB, n_lo), 1)
+            Lo = (lo[:, None] == iota_l).astype(jnp.bfloat16)
+            digs = []
+            for p in range(3):
+                digs.append(cnt_ref[0, :, p][:, None].astype(jnp.bfloat16))
+            r = rt_ref[0, 0, :]
+            for d in range(2):
+                digs.append((((r >> (8 * d)) & 0xFF))[:, None].astype(jnp.bfloat16))
+
+            if mode == "hit":
+                # build transposed one-hot directly: [n_hi, TB]
+                iota_h = jax.lax.broadcasted_iota(jnp.int32, (n_hi, TB), 0)
+                HiT = ((hi[None, :] == iota_h) & (oki[None, :] > 0)).astype(jnp.bfloat16)
+                for p in range(5):
+                    out_ref[p, :, :] += jax.lax.dot(
+                        HiT, Lo * digs[p], preferred_element_type=jnp.float32
+                    )
+            elif mode == "c00":
+                iota_h = jax.lax.broadcasted_iota(jnp.int32, (TB, n_hi), 1)
+                Hi = ((hi[:, None] == iota_h) & (oki[:, None] > 0)).astype(jnp.bfloat16)
+                for p in range(5):
+                    out_ref[p, :, :] += jax.lax.dot_general(
+                        Hi, Lo * digs[p], _C00,
+                        preferred_element_type=jnp.float32,
+                    )
+            elif mode == "hiv":
+                # fold the VALUE into the Hi side: HiV = one-hot * dig, plain Lo
+                iota_h = jax.lax.broadcasted_iota(jnp.int32, (n_hi, TB), 0)
+                HiT = ((hi[None, :] == iota_h) & (oki[None, :] > 0)).astype(jnp.bfloat16)
+                for p in range(5):
+                    out_ref[p, :, :] += jax.lax.dot(
+                        HiT * digs[p].reshape(1, TB), Lo,
+                        preferred_element_type=jnp.float32,
+                    )
+
+        pad = (-N3) % TB
+        ids_p = jnp.concatenate([ids, jnp.full((pad,), -1, jnp.int32)]) if pad else ids
+        cnt_p = jnp.concatenate([cnts, jnp.zeros((pad, 3), jnp.int32)]) if pad else cnts
+        rt_p = jnp.concatenate([rt, jnp.zeros((pad,), jnp.int32)]) if pad else rt
+        ids3 = ids_p.reshape(nT, 1, TB)
+        cnt3 = cnt_p.reshape(nT, TB, 3)
+        rt3 = rt_p.reshape(nT, 1, TB)
+
+        def run(i):
+            return pl.pallas_call(
+                kernel,
+                grid=(nT,),
+                in_specs=[
+                    pl.BlockSpec((1, 1, TB), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, TB, 3), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, 1, TB), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((5, n_hi, n_lo), lambda t: (0, 0, 0), memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct((5, n_hi, n_lo), jnp.float32),
+            )(ids3 ^ (i % 2), cnt3, rt3)
+
+        return run
+
+    for mode in ("hit", "c00", "hiv"):
+        for TB, n_lo in ((2048, 128), (2048, 256), (4096, 128), (1024, 128), (2048, 512)):
+            timed(f"pallas {mode} TB={TB} n_lo={n_lo}", scan_wrap(make(TB, n_lo, mode)))
+
+    # correctness of best-so-far variants
+    for mode in ("hit", "c00", "hiv"):
+        out = jax.jit(make(2048, 128, mode))(jnp.int32(0))
+        n_hi = (n_rows + 127) // 128
+        out = np.asarray(out).reshape(5, n_hi * 128)[:, :n_rows]
+        ref = np.zeros((5, n_rows), np.int64)
+        okm = (rows_np >= 0) & (rows_np < n_rows)
+        for p in range(3):
+            np.add.at(ref[p], rows_np[okm], cnts_np[okm, p])
+        np.add.at(ref[3], rows_np[okm], rt_np[okm] & 0xFF)
+        np.add.at(ref[4], rows_np[okm], (rt_np[okm] >> 8) & 0xFF)
+        print(mode, "exact:", np.array_equal(out.astype(np.int64), ref))
+
+
+if __name__ == "__main__":
+    main()

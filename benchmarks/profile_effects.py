@@ -1,0 +1,130 @@
+"""Effects-phase device costs at the honest bench shape (minute window ON).
+
+Complements profile_stages.py (decision-phase costs): measures every op in
+the tick's effects tail — stat histograms, window lands, sketch adds, RT
+quantiles, param/warm-up scatters — to size the fused-megakernel prize.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.timing import device_time_ms, scan_op
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.config import EngineConfig
+    from sentinel_tpu.core.rules import FlowRule, DegradeRule, ParamFlowRule
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.ops import gsketch as GS
+    from sentinel_tpu.ops import param as P
+    from sentinel_tpu.ops import rtq as RQ
+    from sentinel_tpu.ops import tables as T
+    from sentinel_tpu.ops import window as W
+    from sentinel_tpu.runtime.registry import Registry
+
+    B = 131072
+    n_ruled = 10000
+    cfg = EngineConfig(
+        max_resources=16384,
+        max_nodes=16384,
+        max_flow_rules=16384,
+        max_degrade_rules=16384,
+        max_param_rules=256,
+        flow_rules_per_resource=1,
+        degrade_rules_per_resource=1,
+        param_rules_per_resource=1,
+        batch_size=B,
+        complete_batch_size=B,
+        enable_minute_window=True,
+        use_mxu_tables=True,
+        sketch_stats=True,
+    )
+    reg = Registry(cfg)
+    flow_rules, degrade_rules, param_rules = [], [], []
+    for i in range(n_ruled):
+        name = f"res-{i+1}"
+        reg.resource_id(name)
+        flow_rules.append(FlowRule(resource=name, count=1000.0))
+        degrade_rules.append(DegradeRule(resource=name, grade=0, count=50.0, time_window=10))
+        if i < 128:
+            param_rules.append(ParamFlowRule(resource=name, param_idx=0, count=100.0))
+    ruleset = E.compile_ruleset(
+        cfg, reg, flow_rules=flow_rules, degrade_rules=degrade_rules,
+        param_rules=param_rules,
+    )
+    state = E.init_state(cfg)
+    rng = np.random.default_rng(0)
+    raw = (rng.zipf(1.3, B) - 1) % ((1 << 20) - 1) + 1
+    ids_np = np.where(raw <= n_ruled, raw, cfg.node_rows + raw).astype(np.int32)
+    ids = jnp.asarray(ids_np)
+    cnt = jnp.ones((B,), jnp.int32)
+    rt = jnp.abs(jnp.asarray(rng.normal(3.0, 1.0, B), dtype=np.float32))
+    now = jnp.int32(12345)
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
+
+    def bench(name, body, **kw):
+        dt = device_time_ms(scan_op(body), **kw)
+        print(f"{name:46s} {dt:9.3f} ms")
+
+    # full tick for reference
+    def full(i):
+        acq = E.empty_acquire(cfg)._replace(res=ids, count=cnt)
+        comp = E.empty_complete(cfg)._replace(res=ids, rt=rt, success=cnt)
+        s, o = E.tick(state, ruleset, acq, comp, now + i, jnp.float32(0), jnp.float32(0), cfg=cfg)
+        return o.verdict
+    bench("FULL tick (ALL features)", full, k1=8, k2=40)
+
+    deltas3 = jnp.stack([cnt, jnp.zeros_like(cnt), jnp.zeros_like(cnt)], axis=1)
+
+    # acquire-side stat landing
+    bench("acq: histogram counts(3)+rt",
+          lambda i: T.histogram(cfg, ids + (i % 2), jnp.concatenate([deltas3, (cnt * 8)[:, None]], axis=1), cfg.node_rows))
+    bench("acq: histogram counts(3) only",
+          lambda i: T.histogram(cfg, ids + (i % 2), deltas3, cfg.node_rows))
+    hist5 = jnp.zeros((cfg.node_rows, W.NUM_EVENTS), jnp.int32).at[:, 0].set(
+        T.histogram(cfg, ids, cnt, cfg.node_rows))
+    rt_hist = jnp.zeros((cfg.node_rows,), jnp.float32)
+    bench("add_dense sec", lambda i: W.add_dense(state.win_sec, now + i, hist5, rt_hist, sec_cfg).counts)
+    bench("add_dense min", lambda i: W.add_dense(state.win_min, now + i, hist5, rt_hist, min_cfg).counts)
+    gvals2 = jnp.stack([cnt, jnp.zeros_like(cnt)], axis=1)
+    bench("GS.add (2 planes)",
+          lambda i: GS.add(state.gs, now + i, ids, gvals2, (W.EV_PASS, W.EV_BLOCK), ids >= 0, E.sketch_config(cfg)).counts)
+    gvals3 = jnp.stack([cnt, jnp.zeros_like(cnt), (cnt * 8)], axis=1)
+    bench("GS.add (3 planes, comp)",
+          lambda i: GS.add(state.gs, now + i, ids, gvals3, (W.EV_SUCCESS, W.EV_EXCEPTION, GS.RT_PLANE), ids >= 0, E.sketch_config(cfg)).counts)
+    bench("RQ.add", lambda i: RQ.add(state.rtq, now + i, rt, ids > 0, E.rtq_config(cfg)).counts)
+    bench("warm_acc small_scatter_add",
+          lambda i: T.small_scatter_add(cfg, jnp.zeros((cfg.max_flow_rules + 1,), jnp.float32),
+                                        jnp.minimum(ids, cfg.max_flow_rules) + (i % 2) * 0, cnt.astype(jnp.float32)))
+    prows = P.pair_rows(jnp.minimum(ids, cfg.max_param_rules), jnp.asarray(rng.integers(1, 1 << 20, B, dtype=np.int32)), cfg.param_depth, cfg.param_width)
+    bench("P.add", lambda i: P.add(state.pcms, jnp.int32(0), prows + i * 0, cnt, cfg))
+    bench("P.refresh", lambda i: P.refresh(state.pcms, state.pcms_epochs, now + i, cfg)[0])
+
+    # completion-side
+    deltas2 = jnp.stack([cnt, jnp.zeros_like(cnt)], axis=1)
+    bench("comp: histogram counts(2)+rt",
+          lambda i: T.histogram(cfg, ids + (i % 2), jnp.concatenate([deltas2, (cnt * 8)[:, None]], axis=1), cfg.node_rows))
+    # degrade completion scatters
+    bench("cb small_scatter_add (3 planes)",
+          lambda i: T.small_scatter_add(cfg, jnp.zeros((cfg.max_degrade_rules + 1, 3), jnp.int32),
+                                        jnp.minimum(ids, cfg.max_degrade_rules), deltas3, max_int=1))
+
+    # decision-side gathers at this shape for completeness
+    bench("big_gather res_rules",
+          lambda i: T.big_gather(cfg, ruleset.flow.res_rules, jnp.minimum(ids, cfg.max_resources) + (i % 2), cfg.max_resources + 1, max_int=cfg.max_flow_rules))
+    bench("GS.estimate_plane_mxu",
+          lambda i: GS.estimate_plane_mxu(cfg, state.gs, now + i, ids, W.EV_PASS, E.sketch_config(cfg)))
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,20 @@ class EngineConfig:
     # path; False uses plain XLA gather/scatter — the CPU/test path
     use_mxu_tables: bool = False
     mxu_n_lo: int = 512
+    # fuse the tick's effects-phase scatters (stat windows + circuit
+    # breakers + sketch + per-rule scatters) into one Pallas megakernel per
+    # phase (ops/fused.py).  Requires use_mxu_tables; bit-identical to the
+    # unfused MXU path within the max_batch_count envelope.  On non-TPU
+    # backends the kernels run in interpret mode (tests); enable for real
+    # ticks only on TPU.
+    fused_effects: bool = False
+    # largest per-item token count the fused kernels carry exactly (one
+    # base-256 digit plane per byte; every MXU dot streams the whole item
+    # axis, so each extra digit costs a full pass).  The reference's
+    # acquireCount is 1 in practice (SphU.entry(name) default); clients
+    # clamp larger counts at entry.  The unfused paths remain exact to
+    # 65535 regardless.
+    max_batch_count: int = 255
     # global stats sketch: resources beyond the exact row space get sketch
     # ids and windowed CMS observability instead of pass-through (ops/
     # gsketch.py) — tick cost independent of resource count
@@ -167,6 +181,17 @@ class EngineConfig:
             )
 
     # dtype policy: counters int32, rt sums float32
+    @property
+    def count_digits(self) -> int:
+        """Base-256 digit planes for count-valued scatters in the fused
+        kernels (ops/fused.py)."""
+        return max(1, (int(self.max_batch_count).bit_length() + 7) // 8)
+
+    @property
+    def rt_digits(self) -> int:
+        """Digit planes for the quantized (1/8 ms) RT scatter plane."""
+        return max(1, (int(self.statistic_max_rt * 8).bit_length() + 7) // 8)
+
     @property
     def entry_node_row(self) -> int:
         """Reserved stat row for the global inbound ENTRY_NODE

@@ -56,6 +56,7 @@ from sentinel_tpu.core.rules import (
     STRATEGY_RELATE,
 )
 from sentinel_tpu.ops import degrade as D
+from sentinel_tpu.ops import fused as FU
 from sentinel_tpu.ops import gsketch as GS
 from sentinel_tpu.ops import rtq as RQ
 from sentinel_tpu.ops import param as P
@@ -368,6 +369,147 @@ def _stat_update(
 # ---------------------------------------------------------------------------
 
 
+def _completion_entry_stats(cfg: EngineConfig, comp: CompleteBatch, valid):
+    """(inb, entry_deltas, entry_rt, entry_rt_min) — the global ENTRY-node
+    reductions shared by the fused and unfused completion paths."""
+    inb = valid & (comp.inbound > 0)
+    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
+    entry_deltas = entry_deltas.at[W.EV_SUCCESS].set(
+        jnp.sum(jnp.where(inb, comp.success, 0))
+    )
+    entry_deltas = entry_deltas.at[W.EV_EXCEPTION].set(
+        jnp.sum(jnp.where(inb, comp.error, 0))
+    )
+    entry_rt = jnp.sum(jnp.where(inb, comp.rt, 0.0))
+    # rt <= 0 means "no RT data", matching the add_batch per-row min filter
+    # (window.py rt_for_min) — a sub-ms completion must not collapse the
+    # BBR capacity estimate to zero
+    entry_rt_min = jnp.min(
+        jnp.where(inb & (comp.rt > 0), comp.rt, jnp.float32(W.RT_MIN_INIT))
+    )
+    return inb, entry_deltas, entry_rt, entry_rt_min
+
+
+def _param_release_ctx(cfg: EngineConfig, rules: RuleSet, comp: CompleteBatch, valid):
+    """(rel, prows_c, rel_cnt): which completion lanes release THREAD-grade
+    param concurrency, their hashed (rule,value) rows, and the release
+    counts (ParamFlowSlot.exit: decreaseThreadCount) — shared by both
+    completion paths."""
+    KPp = cfg.param_rules_per_resource
+    res_lp = jnp.minimum(comp.res, cfg.max_resources)
+    pslots = T.big_gather(
+        cfg,
+        rules.param.res_params,
+        res_lp,
+        cfg.max_resources + 1,
+        max_int=cfg.max_param_rules,
+    )
+    pslots_f = pslots.reshape(-1)
+    pgc = T.small_gather_fields(
+        cfg,
+        T.pack_fields([rules.param.enabled, rules.param.grade, rules.param.lane]),
+        pslots_f,
+    )
+    lane_c = pgc[:, 2].astype(jnp.int32)
+    lane_oh_c = jnp.clip(lane_c, 0, cfg.param_dims - 1)[
+        :, None
+    ] == jax.lax.broadcasted_iota(jnp.int32, (1, cfg.param_dims), 1)
+    ph_c = jnp.sum(jnp.where(lane_oh_c, _fan(comp.param_hash, KPp), 0), axis=1)
+    ph_c = jnp.where(lane_c >= 0, ph_c, 0)
+    rel = (
+        (pgc[:, 0] > 0)
+        & (pgc[:, 1].astype(jnp.int32) == GRADE_THREAD)
+        & (ph_c != 0)
+        & _fan(valid, KPp)
+    )
+    prows_c = P.pair_rows(pslots_f, ph_c, cfg.param_depth, cfg.param_width)
+    return rel, prows_c, _fan(comp.success, KPp)
+
+
+def _degrade_completion_masks(
+    cfg: EngineConfig, state: EngineState, rules: RuleSet, comp: CompleteBatch,
+    valid, now_ms,
+):
+    """Refresh CB columns and derive the per-lane event masks the exit path
+    scatters (DegradeSlot.exit:60-75) — shared by both completion paths.
+    Returns (slots_f, cb_counts, cb_epochs, active, is_err, is_slow, g_idx,
+    half_open)."""
+    KD = cfg.degrade_rules_per_resource
+    res_l = jnp.minimum(comp.res, cfg.max_resources)  # row max_resources = pad
+    slots = T.big_gather(
+        cfg,
+        rules.degrade.res_cbs,
+        res_l,
+        cfg.max_resources + 1,
+        max_int=cfg.max_degrade_rules,
+    )
+    slots_f = slots.reshape(-1)
+    cb_counts, cb_epochs, cur_idx = D.refresh_columns(
+        state.cb_counts, state.cb_epochs, rules.degrade.window_ms, now_ms
+    )
+    # one packed matmul for all per-slot fields (enabled/grade/count/cur_idx)
+    dg = T.small_gather_fields(
+        cfg,
+        T.pack_fields(
+            [
+                rules.degrade.enabled,
+                rules.degrade.grade,
+                rules.degrade.count,
+                cur_idx,
+                state.cb_state,
+            ]
+        ),
+        slots_f,
+    )
+    enabled = dg[:, 0] > 0
+    g_grade = dg[:, 1].astype(jnp.int32)
+    g_count = dg[:, 2]
+    g_idx = dg[:, 3].astype(jnp.int32)
+    active = enabled & _fan(valid, KD)
+    is_err = (_fan(comp.error, KD) > 0) & active
+    is_slow = (g_grade == D.GRADE_SLOW_RATIO) & (_fan(comp.rt, KD) > g_count) & active
+    half_open = dg[:, 4].astype(jnp.int32) == D.CB_HALF_OPEN
+    return slots_f, cb_counts, cb_epochs, active, is_err, is_slow, g_idx, half_open
+
+
+def _cb_transitions(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    cb_counts,
+    cb_epochs,
+    seen,
+    failed,
+    now_ms,
+):
+    """Half-open probe resolution + CLOSED-breaker trip evaluation
+    (AbstractCircuitBreaker.java:68-136) from the probe histograms —
+    shared tail of both completion paths."""
+    was_half = state.cb_state == D.CB_HALF_OPEN
+    to_open = was_half & (seen > 0) & (failed > 0)
+    to_close = was_half & (seen > 0) & (failed == 0)
+    cb_state = jnp.where(to_open, D.CB_OPEN, state.cb_state)
+    cb_state = jnp.where(to_close, D.CB_CLOSED, cb_state)
+    cb_retry = jnp.where(
+        to_open, now_ms + rules.degrade.retry_timeout_ms, state.cb_retry_ms
+    )
+    # closing resets the rule's stat window (fromHalfOpenToClose → resetStat)
+    cb_counts = jnp.where(to_close[:, None, None], 0, cb_counts)
+
+    sums = D.window_sums(cb_counts, cb_epochs, rules.degrade.window_ms, now_ms)
+    trip = D.trip_condition(
+        sums,
+        rules.degrade.grade,
+        rules.degrade.count,
+        rules.degrade.slow_ratio,
+        rules.degrade.min_request,
+    )
+    newly_open = (cb_state == D.CB_CLOSED) & trip & rules.degrade.enabled
+    cb_state = jnp.where(newly_open, D.CB_OPEN, cb_state)
+    cb_retry = jnp.where(newly_open, now_ms + rules.degrade.retry_timeout_ms, cb_retry)
+    return cb_counts, cb_state, cb_retry
+
+
 def _process_completions(
     cfg: EngineConfig,
     state: EngineState,
@@ -386,16 +528,8 @@ def _process_completions(
         [jnp.where(valid, comp.success, 0), jnp.where(valid, comp.error, 0)], axis=1
     )  # planes (SUCCESS, EXCEPTION) only — the exit path writes nothing else
     rt1 = jnp.where(valid, comp.rt, 0.0)
-    inb = valid & (comp.inbound > 0)
-    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
-    entry_deltas = entry_deltas.at[W.EV_SUCCESS].set(jnp.sum(jnp.where(inb, comp.success, 0)))
-    entry_deltas = entry_deltas.at[W.EV_EXCEPTION].set(jnp.sum(jnp.where(inb, comp.error, 0)))
-    entry_rt = jnp.sum(jnp.where(inb, comp.rt, 0.0))
-    # rt <= 0 means "no RT data", matching the add_batch per-row min filter
-    # (window.py rt_for_min) — a sub-ms completion must not collapse the
-    # BBR capacity estimate to zero
-    entry_rt_min = jnp.min(
-        jnp.where(inb & (comp.rt > 0), comp.rt, jnp.float32(W.RT_MIN_INIT))
+    inb, entry_deltas, entry_rt, entry_rt_min = _completion_entry_stats(
+        cfg, comp, valid
     )
 
     def _land(fanned: bool):
@@ -463,44 +597,15 @@ def _process_completions(
 
     # THREAD-grade param release (ParamFlowSlot.exit: decreaseThreadCount)
     if "param" in features:
-        KPp = cfg.param_rules_per_resource
-        res_lp = jnp.minimum(comp.res, cfg.max_resources)
-        pslots = T.big_gather(
-            cfg,
-            rules.param.res_params,
-            res_lp,
-            cfg.max_resources + 1,
-            max_int=cfg.max_param_rules,
-        )
-        pslots_f = pslots.reshape(-1)
-        pgc = T.small_gather_fields(
-            cfg,
-            T.pack_fields(
-                [rules.param.enabled, rules.param.grade, rules.param.lane]
-            ),
-            pslots_f,
-        )
-        lane_c = pgc[:, 2].astype(jnp.int32)
-        lane_oh_c = jnp.clip(lane_c, 0, cfg.param_dims - 1)[
-            :, None
-        ] == jax.lax.broadcasted_iota(jnp.int32, (1, cfg.param_dims), 1)
-        ph_c = jnp.sum(jnp.where(lane_oh_c, _fan(comp.param_hash, KPp), 0), axis=1)
-        ph_c = jnp.where(lane_c >= 0, ph_c, 0)
-        rel = (
-            (pgc[:, 0] > 0)
-            & (pgc[:, 1].astype(jnp.int32) == GRADE_THREAD)
-            & (ph_c != 0)
-            & _fan(valid, KPp)
-        )
+        rel, prows_c, rel_cnt = _param_release_ctx(cfg, rules, comp, valid)
 
         def _release():
-            prows_c = P.pair_rows(pslots_f, ph_c, cfg.param_depth, cfg.param_width)
             return P.conc_add(
                 cfg,
                 state.pconc,
                 jnp.where(rel[:, None], prows_c, -1),
-                jnp.zeros_like(_fan(comp.success, KPp)),
-                _fan(comp.success, KPp),
+                jnp.zeros_like(rel_cnt),
+                rel_cnt,
             )
 
         pconc = jax.lax.cond(jnp.any(rel), _release, lambda: state.pconc)
@@ -510,37 +615,9 @@ def _process_completions(
         return state._replace(concurrency=concurrency)
 
     # --- circuit-breaker windows -----------------------------------------
-    KD = cfg.degrade_rules_per_resource
-    res_l = jnp.minimum(comp.res, cfg.max_resources)  # row max_resources = pad
-    slots = T.big_gather(cfg, rules.degrade.res_cbs, res_l, cfg.max_resources + 1, max_int=cfg.max_degrade_rules)
-    slots_f = slots.reshape(-1)
-    item = jnp.repeat(jnp.arange(b), KD)
-
-    cb_counts, cb_epochs, cur_idx = D.refresh_columns(
-        state.cb_counts, state.cb_epochs, rules.degrade.window_ms, now_ms
+    slots_f, cb_counts, cb_epochs, active, is_err, is_slow, g_idx, half_open = (
+        _degrade_completion_masks(cfg, state, rules, comp, valid, now_ms)
     )
-    # one packed matmul for all per-slot fields (enabled/grade/count/cur_idx)
-    dg = T.small_gather_fields(
-        cfg,
-        T.pack_fields(
-            [
-                rules.degrade.enabled,
-                rules.degrade.grade,
-                rules.degrade.count,
-                cur_idx,
-                state.cb_state,
-            ]
-        ),
-        slots_f,
-    )
-    enabled = dg[:, 0] > 0
-    g_grade = dg[:, 1].astype(jnp.int32)
-    g_count = dg[:, 2]
-    g_idx = dg[:, 3].astype(jnp.int32)
-    active = enabled & _fan(valid, KD)
-
-    is_err = (_fan(comp.error, KD) > 0) & active
-    is_slow = (g_grade == D.GRADE_SLOW_RATIO) & (_fan(comp.rt, KD) > g_count) & active
     upd = jnp.stack(
         [
             jnp.where(active, 1, 0),
@@ -557,11 +634,9 @@ def _process_completions(
         cfg, cb_counts.reshape(Dn1 * nbd, 3), flat, upd, max_int=1
     ).reshape(Dn1, nbd, 3)
 
-    # --- half-open probe resolution (AbstractCircuitBreaker.java:68-136) --
-    half_open = dg[:, 4].astype(jnp.int32) == D.CB_HALF_OPEN
+    # --- half-open probe flags (one fused 2-plane 0/1 histogram) ----------
     probe_done = active & half_open
     probe_fail = probe_done & (is_err | is_slow)
-    # one fused 2-plane 0/1 histogram for both probe flags
     sf = T.small_scatter_add(
         cfg,
         jnp.zeros((Dn1, 2), jnp.int32),
@@ -571,30 +646,9 @@ def _process_completions(
         ),
         max_int=1,
     )
-    seen, failed = sf[:, 0], sf[:, 1]
-    was_half = state.cb_state == D.CB_HALF_OPEN
-    to_open = was_half & (seen > 0) & (failed > 0)
-    to_close = was_half & (seen > 0) & (failed == 0)
-    cb_state = jnp.where(to_open, D.CB_OPEN, state.cb_state)
-    cb_state = jnp.where(to_close, D.CB_CLOSED, cb_state)
-    cb_retry = jnp.where(
-        to_open, now_ms + rules.degrade.retry_timeout_ms, state.cb_retry_ms
+    cb_counts, cb_state, cb_retry = _cb_transitions(
+        cfg, state, rules, cb_counts, cb_epochs, sf[:, 0], sf[:, 1], now_ms
     )
-    # closing resets the rule's stat window (fromHalfOpenToClose → resetStat)
-    cb_counts = jnp.where(to_close[:, None, None], 0, cb_counts)
-
-    # --- trip evaluation for CLOSED breakers ------------------------------
-    sums = D.window_sums(cb_counts, cb_epochs, rules.degrade.window_ms, now_ms)
-    trip = D.trip_condition(
-        sums,
-        rules.degrade.grade,
-        rules.degrade.count,
-        rules.degrade.slow_ratio,
-        rules.degrade.min_request,
-    )
-    newly_open = (cb_state == D.CB_CLOSED) & trip & rules.degrade.enabled
-    cb_state = jnp.where(newly_open, D.CB_OPEN, cb_state)
-    cb_retry = jnp.where(newly_open, now_ms + rules.degrade.retry_timeout_ms, cb_retry)
 
     return state._replace(
         concurrency=concurrency,
@@ -603,6 +657,451 @@ def _process_completions(
         cb_state=cb_state,
         cb_retry_ms=cb_retry,
     )
+
+
+def _use_fused(cfg: EngineConfig) -> bool:
+    """Fused effects require the MXU table path and honor the
+    SENTINEL_NO_PALLAS kill switch (ops/fused.available)."""
+    return cfg.fused_effects and cfg.use_mxu_tables and FU.available()
+
+
+def _clean_rows(cfg: EngineConfig, x):
+    """Trash-row lanes → out-of-range sentinel so scatters drop them (see
+    _stat_rows; sentinel must be large — negative indices wrap)."""
+    return jnp.where(x == cfg.trash_row, jnp.int32(2**30), x)
+
+
+def _process_completions_fused(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    comp: CompleteBatch,
+    now_ms,
+    features: frozenset,
+) -> EngineState:
+    """_process_completions with every scatter fused into ONE Pallas
+    megakernel (ops/fused.py): stat fan-out histogram, circuit-breaker
+    columns, half-open probe flags, CMS sketch, THREAD-param release.
+    Bit-identical effects to the unfused MXU path — same digit bounds,
+    same drop semantics; the lax.cond fan gating disappears because the
+    fused kernel prices the ctx/origin row-vectors at two extra dot
+    passes instead of a second histogram."""
+    b = comp.res.shape[0]
+    valid = comp.res != cfg.trash_row
+    with_nodes = "nodes" in features
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
+    erow = cfg.entry_node_row
+
+    succ_w = jnp.where(valid, comp.success, 0)
+    err_w = jnp.where(valid, comp.error, 0)
+    rt1 = jnp.where(valid, comp.rt, 0.0)
+    rt_q = jnp.round(
+        jnp.minimum(rt1, float(cfg.statistic_max_rt)) * 8.0
+    ).astype(jnp.int32)
+    inb, entry_deltas, entry_rt, entry_rt_min = _completion_entry_stats(
+        cfg, comp, valid
+    )
+
+    vals3 = jnp.stack([succ_w, err_w, rt_q])  # shared by stat + sketch jobs
+    cd = cfg.count_digits
+    digits3 = (cd, cd, cfg.rt_digits)
+
+    # Job shaping rule (measured, benchmarks/probe_fused_hist*.py): every
+    # MXU dot streams the whole item axis and costs ceil(n/16384) passes,
+    # so tables are kept <= 16384 rows per job — real stat rows live below
+    # max_nodes (the +8 node_rows tail is trash/padding only), per-depth
+    # sketch/param planes are separate jobs, and rule-table pad slots drop
+    # via row -1 instead of landing on a pad row.
+    jobs = []
+    if with_nodes:
+        stat_rows = jnp.stack(
+            [
+                _clean_rows(cfg, comp.res),
+                _clean_rows(cfg, comp.ctx_node),
+                _clean_rows(cfg, comp.origin_node),
+            ]
+        )
+    else:
+        stat_rows = _clean_rows(cfg, comp.res)[None, :]
+    jobs.append(FU.Job("stat", cfg.max_nodes, stat_rows, vals3, digits3))
+
+    if cfg.sketch_stats:
+        cols = P.cms_cell(comp.res, cfg.sketch_depth, cfg.sketch_width)  # [B, depth]
+        for d in range(cfg.sketch_depth):
+            jobs.append(
+                FU.Job(
+                    f"sketch{d}",
+                    cfg.sketch_width,
+                    jnp.where(valid, cols[:, d], -1)[None, :],
+                    vals3,
+                    digits3,
+                )
+            )
+
+    # --- THREAD-grade param release lanes (gathers stay XLA; only the
+    # concurrency scatter rides the kernel) ---------------------------------
+    with_param = "param" in features
+    if with_param:
+        KPp = cfg.param_rules_per_resource
+        rel, prows_c, rel_cnt_f = _param_release_ctx(cfg, rules, comp, valid)
+        # per-depth jobs on the [Q] plane (Q <= one MXU tile); KPp lanes
+        # ride as row-vectors with per-row release counts
+        pr = jnp.where(rel[:, None], prows_c, -1).reshape(b, KPp, cfg.param_depth)
+        rel_cnt = rel_cnt_f.reshape(b, KPp).T[:, None, :]  # [KPp, 1, B]
+        for d in range(cfg.param_depth):
+            jobs.append(
+                FU.Job(f"prel{d}", cfg.param_width, pr[:, :, d].T, rel_cnt, (cd,))
+            )
+
+    # --- circuit-breaker columns + probe flags -----------------------------
+    with_degrade = "degrade" in features
+    if with_degrade:
+        KD = cfg.degrade_rules_per_resource
+        slots_f, cb_counts, cb_epochs, active, is_err, is_slow, g_idx, half_open = (
+            _degrade_completion_masks(cfg, state, rules, comp, valid, now_ms)
+        )
+        nbd = cfg.cb_sample_count
+        Dn = cfg.max_degrade_rules
+        Dn1 = Dn + 1
+        # pad slots (slot == Dn) drop via row -1 — their values are zero
+        # anyway (enabled gathers 0), and dropping keeps the table at
+        # Dn*nbd rows instead of Dn1*nbd (tile-count parity)
+        flat = jnp.where(slots_f < Dn, slots_f * nbd + g_idx, -1)
+        cb_vals = jnp.stack(
+            [
+                jnp.where(active, 1, 0),
+                jnp.where(is_err, 1, 0),
+                jnp.where(is_slow, 1, 0),
+            ]
+        )  # [3, B*KD]
+        jobs.append(
+            FU.Job(
+                "cb",
+                Dn * nbd,
+                flat.reshape(b, KD).T,
+                cb_vals.reshape(3, b, KD).transpose(2, 0, 1),
+                (1, 1, 1),
+            )
+        )
+        probe_done = active & half_open
+        probe_fail = probe_done & (is_err | is_slow)
+        pr_vals = jnp.stack(
+            [probe_done.astype(jnp.int32), probe_fail.astype(jnp.int32)]
+        )
+        jobs.append(
+            FU.Job(
+                "probe",
+                Dn,
+                jnp.where(slots_f < Dn, slots_f, -1).reshape(b, KD).T,
+                pr_vals.reshape(2, b, KD).transpose(2, 0, 1),
+                (1, 1),
+            )
+        )
+
+    outs = FU.scatter_many(jobs)
+    oi = 0
+    stat_out = outs[oi]
+    oi += 1
+    sk_out = None
+    if cfg.sketch_stats:
+        sk_out = jnp.stack(outs[oi : oi + cfg.sketch_depth])  # [depth, width, 3]
+        oi += cfg.sketch_depth
+    prel_out = None
+    if with_param:
+        prel_out = jnp.stack(
+            [outs[oi + d][:, 0] for d in range(cfg.param_depth)]
+        )  # [depth, Q]
+        oi += cfg.param_depth
+    if with_degrade:
+        cb_out = outs[oi]
+        probe_out = outs[oi + 1]
+
+    # --- land the stat histogram (same tail as _stat_update dense path) ---
+    pad_tail = cfg.node_rows - cfg.max_nodes
+    hist = jnp.zeros((cfg.node_rows, W.NUM_EVENTS), jnp.int32)
+    hist = hist.at[: cfg.max_nodes, W.EV_SUCCESS].set(
+        jnp.round(stat_out[:, 0]).astype(jnp.int32)
+    )
+    hist = hist.at[: cfg.max_nodes, W.EV_EXCEPTION].set(
+        jnp.round(stat_out[:, 1]).astype(jnp.int32)
+    )
+    hist = hist.at[erow].add(entry_deltas)
+    rt_hist = jnp.concatenate(
+        [stat_out[:, 2] / 8.0, jnp.zeros((pad_tail,), jnp.float32)]
+    )
+    rt_hist = rt_hist.at[erow].add(entry_rt)
+    win_sec = W.add_dense(state.win_sec, now_ms, hist, rt_hist, sec_cfg)
+    win_sec = W.min_into_row(win_sec, now_ms, erow, entry_rt_min, sec_cfg)
+    win_min = state.win_min
+    if cfg.enable_minute_window:
+        win_min = W.add_dense(state.win_min, now_ms, hist, rt_hist, min_cfg)
+    state = state._replace(win_sec=win_sec, win_min=win_min)
+
+    state = state._replace(
+        rtq=RQ.add(state.rtq, now_ms, comp.rt, inb & (comp.rt > 0), rtq_config(cfg))
+    )
+    if sk_out is not None:
+        upd = jnp.round(sk_out).astype(jnp.int32)  # [depth, width, 3]
+        state = state._replace(
+            gs=GS.add_dense(
+                state.gs,
+                now_ms,
+                upd,
+                (W.EV_SUCCESS, W.EV_EXCEPTION, GS.RT_PLANE),
+                sketch_config(cfg),
+            )
+        )
+
+    concurrency = jnp.maximum(state.concurrency - hist[:, W.EV_SUCCESS], 0)
+
+    if prel_out is not None:
+        dec = jnp.round(prel_out).astype(jnp.int32)  # [depth, Q]
+        state = state._replace(pconc=jnp.maximum(state.pconc - dec, 0))
+
+    if not with_degrade:
+        return state._replace(concurrency=concurrency)
+
+    cb_upd = jnp.round(cb_out).astype(jnp.int32).reshape(Dn, nbd, 3)
+    cb_counts = cb_counts.at[:Dn].add(cb_upd)
+    sf = jnp.concatenate(
+        [jnp.round(probe_out).astype(jnp.int32), jnp.zeros((1, 2), jnp.int32)]
+    )  # pad row back to Dn1
+    cb_counts, cb_state, cb_retry = _cb_transitions(
+        cfg, state, rules, cb_counts, cb_epochs, sf[:, 0], sf[:, 1], now_ms
+    )
+
+    return state._replace(
+        concurrency=concurrency,
+        cb_counts=cb_counts,
+        cb_epochs=cb_epochs,
+        cb_state=cb_state,
+        cb_retry_ms=cb_retry,
+    )
+
+
+def _acquire_effects_fused(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    now_ms,
+    features: frozenset,
+    passed,
+    occupying,
+    valid,
+    fslots,  # [B*K] flow slots from _check_flow (None without "flow")
+    occ_grant,  # (grant_lane, oslots, ocnt) or None
+    rl_info,  # (rl_ok, cost) from _check_flow or None
+    param_ctx,  # (pcms, pcms_epochs, pcms_idx, prows, q_add, thread_add) or None
+) -> EngineState:
+    """Acquire-side effects in ONE Pallas megakernel: stat fan histogram,
+    CMS sketch, warm-up drain accounting, occupy-ahead booking, the
+    RateLimiter latestPassedTime sums, and the param-flow pass/concurrency
+    scatters.  Same job-shaping rules as _process_completions_fused; the
+    flow-slot scatters (warm/occupy/latest) share one row-vector, and the
+    param scatters mask VALUES instead of rows (pair_rows cells are always
+    in range) so pcms and pconc ride the same one-hot build."""
+    b = acq.res.shape[0]
+    with_nodes = "nodes" in features
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
+    erow = cfg.entry_node_row
+    cd = cfg.count_digits
+
+    pass_c = jnp.where(passed & ~occupying, acq.count, 0)
+    block_c = jnp.where(valid & ~passed, acq.count, 0)
+    occ_c = jnp.where(occupying, acq.count, 0)
+
+    inb = valid & (acq.inbound > 0)
+    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
+    entry_deltas = entry_deltas.at[W.EV_PASS].set(
+        jnp.sum(jnp.where(inb & passed & ~occupying, acq.count, 0))
+    )
+    entry_deltas = entry_deltas.at[W.EV_OCCUPIED].set(
+        jnp.sum(jnp.where(inb & occupying, acq.count, 0))
+    )
+    entry_deltas = entry_deltas.at[W.EV_BLOCK].set(
+        jnp.sum(jnp.where(inb & ~passed, acq.count, 0))
+    )
+
+    jobs = []
+    if with_nodes:
+        stat_rows = jnp.stack(
+            [
+                _clean_rows(cfg, acq.res),
+                _clean_rows(cfg, acq.ctx_node),
+                _clean_rows(cfg, acq.origin_node),
+            ]
+        )
+    else:
+        stat_rows = _clean_rows(cfg, acq.res)[None, :]
+    jobs.append(
+        FU.Job(
+            "stat", cfg.max_nodes, stat_rows, jnp.stack([pass_c, block_c, occ_c]),
+            (cd, cd, cd),
+        )
+    )
+
+    if cfg.sketch_stats:
+        cols = P.cms_cell(acq.res, cfg.sketch_depth, cfg.sketch_width)
+        sk_vals = jnp.stack(
+            [jnp.where(passed, acq.count, 0), block_c]
+        )
+        for d in range(cfg.sketch_depth):
+            jobs.append(
+                FU.Job(
+                    f"sketch{d}",
+                    cfg.sketch_width,
+                    jnp.where(valid, cols[:, d], -1)[None, :],
+                    sk_vals,
+                    (cd, cd),
+                )
+            )
+
+    # --- flow-slot scatters: warm drain + occupy booking + latest sums ----
+    slot_planes = []  # (kind, digits)
+    n_flow_jobs = 0
+    if fslots is not None:
+        K = cfg.flow_rules_per_resource
+        F = cfg.max_flow_rules
+        rows_f = jnp.where(fslots < F, fslots, -1).reshape(b, K).T  # [K, B]
+        planes = []
+        digits = []
+        cnt_f = _fan(acq.count, K)
+        if "warmup" in features:
+            adm = _fan(passed, K)
+            planes.append(jnp.where(adm, cnt_f, 0))
+            digits.append(cd)
+            slot_planes.append("warm")
+        if occ_grant is not None:
+            grant_lane, oslots, ocnt = occ_grant
+            commit = grant_lane & _fan(occupying, K)
+            planes.append(jnp.where(commit, jnp.round(ocnt).astype(jnp.int32), 0))
+            digits.append(cd)
+            slot_planes.append("occ")
+        if rl_info is not None:
+            rl_ok, cost = rl_info
+            # costs are whole ms (RateLimiter rounds); values beyond the
+            # 3-digit bound (~4.6 h of pacing per item) are unreal
+            planes.append(jnp.where(rl_ok, jnp.round(cost).astype(jnp.int32), 0))
+            digits.append(3)
+            planes.append(jnp.where(rl_ok, 1, 0))
+            digits.append(cd)
+            slot_planes.append("latest")
+        if planes:
+            vals_f = jnp.stack(planes).reshape(len(planes), b, K).transpose(2, 0, 1)
+            jobs.append(FU.Job("fslots", F, rows_f, vals_f, tuple(digits)))
+            n_flow_jobs = 1
+
+    # --- param pass + THREAD concurrency (values masked, rows shared) -----
+    if param_ctx is not None:
+        pcms, pcms_epochs, pcms_idx, prows, q_add, thread_add = param_ctx
+        KP = cfg.param_rules_per_resource
+        adm = _fan(passed, KP)
+        cnt_p = _fan(acq.count, KP)
+        p_vals = jnp.stack(
+            [
+                jnp.where(q_add & adm, cnt_p, 0),
+                jnp.where(thread_add & adm, cnt_p, 0),
+            ]
+        )  # [2, B*KP]
+        p_vals_r = p_vals.reshape(2, b, KP).transpose(2, 0, 1)  # [KP, 2, B]
+        for d in range(cfg.param_depth):
+            jobs.append(
+                FU.Job(
+                    f"param{d}",
+                    cfg.param_width,
+                    prows[:, d].reshape(b, KP).T,
+                    p_vals_r,
+                    (cd, cd),
+                )
+            )
+
+    outs = FU.scatter_many(jobs)
+    oi = 0
+    stat_out = outs[oi]
+    oi += 1
+    sk_out = None
+    if cfg.sketch_stats:
+        sk_out = jnp.stack(outs[oi : oi + cfg.sketch_depth])
+        oi += cfg.sketch_depth
+    f_out = None
+    if n_flow_jobs:
+        f_out = outs[oi]
+        oi += 1
+    p_out = None
+    if param_ctx is not None:
+        p_out = jnp.stack(outs[oi : oi + cfg.param_depth])  # [depth, Q, 2]
+        oi += cfg.param_depth
+
+    # --- land stat + concurrency ------------------------------------------
+    pad_tail = cfg.node_rows - cfg.max_nodes
+    hist = jnp.zeros((cfg.node_rows, W.NUM_EVENTS), jnp.int32)
+    hist = hist.at[: cfg.max_nodes, W.EV_PASS].set(
+        jnp.round(stat_out[:, 0]).astype(jnp.int32)
+    )
+    hist = hist.at[: cfg.max_nodes, W.EV_BLOCK].set(
+        jnp.round(stat_out[:, 1]).astype(jnp.int32)
+    )
+    hist = hist.at[: cfg.max_nodes, W.EV_OCCUPIED].set(
+        jnp.round(stat_out[:, 2]).astype(jnp.int32)
+    )
+    hist = hist.at[erow].add(entry_deltas)
+    win_sec = W.add_dense(state.win_sec, now_ms, hist, None, sec_cfg)
+    win_min = state.win_min
+    if cfg.enable_minute_window:
+        win_min = W.add_dense(state.win_min, now_ms, hist, None, min_cfg)
+    concurrency = state.concurrency + hist[:, W.EV_PASS] + hist[:, W.EV_OCCUPIED]
+    state = state._replace(
+        win_sec=win_sec, win_min=win_min, concurrency=concurrency
+    )
+
+    if sk_out is not None:
+        state = state._replace(
+            gs=GS.add_dense(
+                state.gs,
+                now_ms,
+                jnp.round(sk_out).astype(jnp.int32),
+                (W.EV_PASS, W.EV_BLOCK),
+                sketch_config(cfg),
+            )
+        )
+
+    if f_out is not None:
+        pi = 0
+        pad1 = jnp.zeros((1,), jnp.float32)
+        if "warm" in slot_planes:
+            acc_add = jnp.concatenate([f_out[:, pi], pad1])
+            state = state._replace(warm_acc=state.warm_acc + acc_add)
+            pi += 1
+        if "occ" in slot_planes:
+            add = jnp.concatenate([f_out[:, pi], pad1])
+            cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+            pool_vec = jnp.where(
+                state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0
+            )
+            state = state._replace(
+                occ_tokens=pool_vec + add,
+                occ_epoch=jnp.where(add > 0, cur_wid + 1, state.occ_epoch),
+            )
+            pi += 1
+        if "latest" in slot_planes:
+            T_s = jnp.concatenate([f_out[:, pi], pad1])
+            n_s = jnp.concatenate([f_out[:, pi + 1], pad1])
+            state = state._replace(
+                latest_passed_ms=_apply_latest(
+                    state.latest_passed_ms, T_s, n_s, now_ms
+                )
+            )
+
+    if param_ctx is not None:
+        upd = jnp.round(p_out).astype(jnp.int32)  # [depth, Q, 2]
+        pcms = pcms.at[:, :, pcms_idx].add(upd[:, :, 0])
+        pconc = jnp.maximum(state.pconc + upd[:, :, 1], 0)
+        state = state._replace(pcms=pcms, pcms_epochs=pcms_epochs, pconc=pconc)
+
+    return state
 
 
 def _check_authority(cfg: EngineConfig, rules: RuleSet, acq: AcquireBatch):
@@ -857,7 +1356,9 @@ def _check_flow(
     shapers (FlowRuleChecker.java:42-176, Default/RateLimiter/WarmUp
     controllers) plus prioritized occupy-ahead (DefaultController
     :49-68 tryOccupyNext).  Returns (blocked[B], wait_ms[B],
-    latest_passed_update, occupying[B], occ_tokens, occ_epoch)."""
+    latest_passed_update-or-None, occupying[B], occ_grant, slots_f,
+    (rl_ok, cost)); latest is None on the fused path, where the
+    (cost, count) sums ride the acquire-effects kernel instead."""
     K = cfg.flow_rules_per_resource
     b = acq.res.shape[0]
     f = rules.flow
@@ -1081,21 +1582,41 @@ def _check_flow(
     # one, so C_reset ≈ T/n * 1 — we use the per-slot mean admitted cost,
     # which is exact whenever a slot's within-tick costs are uniform (same
     # rule + count, the overwhelmingly common case) and off by at most one
-    # cost spread otherwise.  One packed scatter-add replaces the max.
-    sums = T.small_scatter_add(
-        cfg,
-        jnp.zeros((cfg.max_flow_rules + 1, 2), jnp.float32),
-        jnp.where(rl_ok, slots_f, jnp.int32(-1)),
-        jnp.stack([jnp.where(rl_ok, cost, 0.0), jnp.where(rl_ok, 1.0, 0.0)], axis=1),
+    # cost spread otherwise.  One packed scatter-add replaces the max —
+    # or, on the fused path, the (cost, 1) sums ride the acquire-effects
+    # megakernel and the closed form is applied there (_apply_latest).
+    if _use_fused(cfg):
+        latest = None
+    else:
+        sums = T.small_scatter_add(
+            cfg,
+            jnp.zeros((cfg.max_flow_rules + 1, 2), jnp.float32),
+            jnp.where(rl_ok, slots_f, jnp.int32(-1)),
+            jnp.stack(
+                [jnp.where(rl_ok, cost, 0.0), jnp.where(rl_ok, 1.0, 0.0)], axis=1
+            ),
+        )
+        latest = _apply_latest(state.latest_passed_ms, sums[:, 0], sums[:, 1], now_ms)
+
+    return (
+        blocked,
+        wait_ms.astype(jnp.int32),
+        latest,
+        occupying,
+        occ_grant,
+        slots_f,
+        (rl_ok, cost),
     )
-    T_s, n_s = sums[:, 0], sums[:, 1]
+
+
+def _apply_latest(latest_passed_ms, T_s, n_s, now_ms):
+    """Closed-form latestPassedTime advance from per-slot (cost, count)
+    sums — see the comment block in _check_flow."""
     mean_cost = T_s / jnp.maximum(n_s, 1.0)
     cand = jnp.maximum(
-        state.latest_passed_ms + T_s, now_ms.astype(jnp.float32) + T_s - mean_cost
+        latest_passed_ms + T_s, now_ms.astype(jnp.float32) + T_s - mean_cost
     )
-    latest = jnp.where(n_s > 0, cand, state.latest_passed_ms)
-
-    return blocked, wait_ms.astype(jnp.int32), latest, occupying, occ_grant, slots_f
+    return jnp.where(n_s > 0, cand, latest_passed_ms)
 
 
 def _check_tail_flow(
@@ -1253,7 +1774,10 @@ def tick(
     zero_block = jnp.zeros((b,), bool)
 
     # 1. exits first: they release concurrency and update breakers
-    state = _process_completions(cfg, state, rules, comp, now_ms, features)
+    if _use_fused(cfg):
+        state = _process_completions_fused(cfg, state, rules, comp, now_ms, features)
+    else:
+        state = _process_completions(cfg, state, rules, comp, now_ms, features)
 
     # 2. warm-up token sync (per second, vectorized over rules)
     if "warmup" in features:
@@ -1296,17 +1820,27 @@ def tick(
     eligible = eligible & ~param_block
 
     if "flow" in features:
-        flow_block, wait_ms, latest_passed, occupying, occ_grant, fslots = _check_flow(
+        (
+            flow_block,
+            wait_ms,
+            latest_passed,
+            occupying,
+            occ_grant,
+            fslots,
+            rl_info,
+        ) = _check_flow(
             cfg, state, rules, acq, now_ms, eligible, occupy="occupy" in features
         )
         flow_block = flow_block & eligible
         occupying = occupying & eligible
-        state = state._replace(latest_passed_ms=latest_passed)
+        if latest_passed is not None:
+            state = state._replace(latest_passed_ms=latest_passed)
     else:
         flow_block = zero_block
         occupying = zero_block
         occ_grant = None
         fslots = None
+        rl_info = None
         wait_ms = jnp.zeros((b,), jnp.int32)
     if "tail_flow" in features and cfg.sketch_stats:
         tail_block = _check_tail_flow(cfg, state, rules, acq, now_ms, eligible)
@@ -1328,7 +1862,8 @@ def tick(
     # occupy grants only COMMIT for items that finally pass — a grant
     # revoked by a later slot (e.g. an open circuit breaker) books nothing
     occupying = occupying & passed
-    if occ_grant is not None:
+    fused = _use_fused(cfg)
+    if occ_grant is not None and not fused:
         grant_lane, oslots, ocnt = occ_grant
         b_k = grant_lane.shape[0] // b
         item_g = jnp.repeat(jnp.arange(b), b_k)
@@ -1360,6 +1895,27 @@ def tick(
     # Occupying entries count OCCUPIED now; their PASS lands when the
     # borrowed bucket becomes current (_fold_occupied), so the next
     # window's budget is reduced by exactly the borrowed amount.
+    if fused:
+        param_ctx = None
+        if "param" in features:
+            param_ctx = (pcms, pcms_epochs, pcms_idx, prows, p_qps_add, p_thread_add)
+        state = _acquire_effects_fused(
+            cfg,
+            state,
+            rules,
+            acq,
+            now_ms,
+            features,
+            passed,
+            occupying,
+            valid,
+            fslots,
+            occ_grant,
+            rl_info,
+            param_ctx,
+        )
+        return state, TickOutput(verdict=verdict, wait_ms=wait_ms)
+
     with_nodes = "nodes" in features
     rows = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, with_nodes)
     # planes (PASS, BLOCK, OCCUPIED) only — the entry path writes no others
@@ -1444,10 +2000,11 @@ def tick(
     state = state._replace(concurrency=concurrency)
 
     # warm-up drain accounting: exact per-slot admitted counts this second
+    # (pad-slot lanes drop — row F is never read, and dropping keeps this
+    # bit-identical with the fused path's row masking)
     if "warmup" in features and fslots is not None:
         K = cfg.flow_rules_per_resource
-        item_f = jnp.repeat(jnp.arange(b), K)
-        adm = _fan(passed, K)
+        adm = _fan(passed, K) & (fslots < cfg.max_flow_rules)
         acc_add = T.small_scatter_add(
             cfg,
             jnp.zeros((cfg.max_flow_rules + 1,), jnp.float32),

@@ -1,0 +1,341 @@
+"""Fused Pallas effects-phase megakernels.
+
+Why this exists (measured on v5e, see benchmarks/probe_fused_hist.py and
+BENCH_r02): the XLA one-hot-matmul table path (ops/mxu_table.py) pays
+~0.3-0.9 ms PER OP at B=128K regardless of FLOPs — every scatter/gather
+materializes [B, n_lo] one-hot tensors in HBM and takes its own fusion,
+and the tick makes ~25 such calls (19 ms total).  The fused formulation
+runs ONE Pallas kernel per tick phase: each grid step loads a tile of
+items into VMEM, builds the one-hot factors there, and contracts them
+into EVERY destination table's accumulator (stat windows, circuit-breaker
+columns, CMS sketch, per-rule scatters) without ever writing a one-hot to
+HBM.  Measured: the 3B-item stat landing drops 5.0 ms -> ~1.3 ms; the
+full set of effect scatters collapses from ~11 ms of serial fusions to
+~2-3 ms of mostly-MXU work.
+
+Exactness matches ops/mxu_table.py bit for bit: integer payloads are
+decomposed into base-256 digit planes (bf16 represents 0..255 exactly, so
+a DEFAULT-precision one-pass bf16 dot with a 0/1 one-hot side is exact),
+accumulated in f32, and recombined with integer arithmetic outside the
+kernel.  The same value bounds apply (counts <= 65535 via 2 digits,
+rt_q <= 2^16, cells < 2^24 before f32 accumulation loses integers).
+
+Reference map: this is the batched replacement for the reference's
+per-request LongAdder writes in StatisticSlot.java:54-164 and the
+LeapArray bucket adds (slots/statistic/base/LeapArray.java:41) — one
+kernel landing a whole micro-batch of slot-chain side effects at once.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+#: default items per grid step; 4096 measured best (fewer grid steps than
+#: 2048 at equal VMEM pressure; 8192+ fails VMEM on multi-job kernels)
+TILE = 4096
+
+#: one-hot minor-axis width — 128 lanes exactly, so Lo is a single vreg
+#: column and the dot's N dim never pads
+N_LO = 128
+
+
+def interpret_mode() -> bool:
+    """True when running without a Mosaic backend (tests on CPU)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+@functools.cache
+def available() -> bool:
+    """Fused kernels compile on TPU (Mosaic); interpret elsewhere."""
+    if os.environ.get("SENTINEL_NO_PALLAS"):
+        return False
+    return True
+
+
+class Job(NamedTuple):
+    """One scatter destination processed by a fused kernel.
+
+    rows:   int32 [R, N] — R row-vectors per item (e.g. the res/ctx/origin
+            stat fan of StatisticSlot.java:54-123 is R=3); ids outside
+            [0, n) are dropped (the trash-row / drop-mode analog).
+    values: int32 [P, N] value planes shared by every row-vector, or
+            [R, P, N] for per-row-vector values.
+    digits: per-plane base-256 digit counts; plane p must satisfy
+            0 <= value < 256**digits[p] (matching mxu_table max_int).
+    n:      logical table rows.
+    """
+
+    name: str
+    n: int
+    rows: jax.Array
+    values: jax.Array
+    digits: tuple
+
+
+def _pad_axis(x: jax.Array, axis: int, to: int, fill) -> jax.Array:
+    pad = to - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def scatter_many(jobs: Sequence[Job], tb: int = TILE, interpret: Optional[bool] = None):
+    """Run every job's scatter in ONE Pallas kernel over a shared item axis.
+
+    All jobs must share the item-axis length N (pad shorter vectors with
+    row id -1 upstream).  Returns one f32 [n_j, P_j] histogram per job —
+    digit planes already recombined; integer-exact within the documented
+    bounds.  The caller lands these into window/sketch state with plain
+    elementwise adds (ops/window.add_dense etc.).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = interpret_mode()
+
+    N = jobs[0].rows.shape[-1]
+    for j in jobs:
+        assert j.rows.shape[-1] == N, f"job {j.name}: item axis mismatch"
+
+    nT = max((N + tb - 1) // tb, 1)
+    Np = nT * tb
+
+    # --- static plan per job ------------------------------------------------
+    plans = []  # (R, P, per_row_vals, n_hi, pd_total, digits)
+    ins = []
+    in_specs = []
+    out_shapes = []
+    out_specs = []
+    for j in jobs:
+        rows = j.rows
+        assert rows.ndim == 2, f"job {j.name}: rows must be [R, N]"
+        R = rows.shape[0]
+        per_row = j.values.ndim == 3
+        P = j.values.shape[-2]
+        assert len(j.digits) == P, f"job {j.name}: digits/planes mismatch"
+        n_hi = (j.n + N_LO - 1) // N_LO
+        pd = sum(j.digits)
+        plans.append((R, P, per_row, n_hi, pd, tuple(j.digits), j.n))
+
+        rows_p = _pad_axis(rows.astype(jnp.int32), 1, Np, -1)
+        # [nT, R, tb] — item tiles on the leading (grid) axis
+        ins.append(rows_p.reshape(R, nT, tb).transpose(1, 0, 2))
+        in_specs.append(
+            pl.BlockSpec((1, R, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
+        )
+        vals = j.values.astype(jnp.int32)
+        if per_row:
+            vals = _pad_axis(vals, 2, Np, 0)
+            ins.append(vals.reshape(R * P, nT, tb).transpose(1, 0, 2))
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, R * P, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM
+                )
+            )
+        else:
+            vals = _pad_axis(vals, 1, Np, 0)
+            ins.append(vals.reshape(P, nT, tb).transpose(1, 0, 2))
+            in_specs.append(
+                pl.BlockSpec((1, P, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
+            )
+        out_shapes.append(jax.ShapeDtypeStruct((pd, n_hi, N_LO), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((pd, n_hi, N_LO), lambda t: (0, 0, 0), memory_space=pltpu.VMEM)
+        )
+
+    def kernel(*refs):
+        nrefs = refs[: len(ins)]
+        orefs = refs[len(ins) :]
+        t = pl.program_id(0)
+
+        for o in orefs:
+
+            @pl.when(t == 0)
+            def _(o=o):
+                o[...] = jnp.zeros_like(o)
+
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (tb, N_LO), 1)
+        ri = 0
+        for ji, (R, P, per_row, n_hi, pd, digits, n) in enumerate(plans):
+            rows_ref = nrefs[ri]
+            vals_ref = nrefs[ri + 1]
+            ri += 2
+            iota_h = jax.lax.broadcasted_iota(jnp.int32, (n_hi, tb), 0)
+            for r in range(R):
+                k = rows_ref[0, r, :]
+                ok = (k >= 0) & (k < n)
+                safe = jnp.where(ok, k, 0)
+                hi = safe // N_LO
+                lo = safe - hi * N_LO
+                oki = ok.astype(jnp.int32)
+                HiT = ((hi[None, :] == iota_h) & (oki[None, :] > 0)).astype(
+                    jnp.bfloat16
+                )
+                Lo = (lo[:, None] == iota_l).astype(jnp.bfloat16)
+                pdoff = 0
+                for p in range(P):
+                    v = vals_ref[0, r * P + p if per_row else p, :]
+                    for d in range(digits[p]):
+                        dig = ((v >> (8 * d)) & 0xFF)[:, None].astype(jnp.bfloat16)
+                        orefs[ji][pdoff, :, :] += jax.lax.dot(
+                            HiT, Lo * dig, preferred_element_type=jnp.float32
+                        )
+                        pdoff += 1
+
+    grid = (nT,)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*ins)
+
+    # --- digit recombination (XLA elementwise; exact integer weights) ------
+    results = []
+    for out, (R, P, per_row, n_hi, pd, digits, n) in zip(outs, plans):
+        flat = out.reshape(pd, n_hi * N_LO)[:, :n]  # [pd, n]
+        cols = []
+        off = 0
+        for p in range(P):
+            acc = flat[off]
+            for d in range(1, digits[p]):
+                acc = acc + flat[off + d] * float(1 << (8 * d))
+            cols.append(acc)
+            off += digits[p]
+        results.append(jnp.stack(cols, axis=1))  # [n, P]
+    return results
+
+
+# ---------------------------------------------------------------------------
+# fused gather suite: chained per-item reads sharing one item axis
+# ---------------------------------------------------------------------------
+
+
+class GatherJob(NamedTuple):
+    """One gather source read by a fused gather kernel.
+
+    ids:    int32 [N] — row per item; out-of-range ids read 0.
+    table:  int32 [n, P] — NONNEGATIVE integer table; each plane p bounded
+            by 256**digits[p] (digit-plane exactness, like mxu_table
+            gather with max_int).
+    digits: per-plane digit counts.
+    """
+
+    name: str
+    ids: jax.Array
+    table: jax.Array
+    digits: tuple
+
+
+def gather_many(jobs: Sequence[GatherJob], tb: int = TILE, interpret: Optional[bool] = None):
+    """Per-item gathers from several tables in ONE kernel.
+
+    Returns one f32 [N, P] per job.  The table rides in VMEM as bf16 digit
+    planes ([digits_total, n_hi, N_LO], built XLA-side — cheap elementwise)
+    and each tile contracts Hi @ plane then selects with Lo — the gather
+    formulation of ops/mxu_table.py:137-184 without HBM one-hots.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = interpret_mode()
+
+    N = jobs[0].ids.shape[0]
+    for j in jobs:
+        assert j.ids.shape[0] == N, f"gather job {j.name}: item axis mismatch"
+    nT = max((N + tb - 1) // tb, 1)
+    Np = nT * tb
+
+    plans = []
+    ins = []
+    in_specs = []
+    out_shapes = []
+    out_specs = []
+    for j in jobs:
+        n, P = j.table.shape
+        assert len(j.digits) == P
+        n_hi = (n + N_LO - 1) // N_LO
+        pd = sum(j.digits)
+        plans.append((P, n_hi, pd, tuple(j.digits), n))
+
+        ids_p = _pad_axis(j.ids.astype(jnp.int32)[None, :], 1, Np, -1)
+        ins.append(ids_p.reshape(1, nT, tb).transpose(1, 0, 2))
+        in_specs.append(
+            pl.BlockSpec((1, 1, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
+        )
+        # digit planes of the table: [pd, n_hi, N_LO] bf16
+        t32 = j.table.astype(jnp.int32)
+        pad_rows = n_hi * N_LO - n
+        if pad_rows:
+            t32 = jnp.concatenate([t32, jnp.zeros((pad_rows, P), jnp.int32)])
+        planes = []
+        for p in range(P):
+            for d in range(j.digits[p]):
+                planes.append((t32[:, p] >> (8 * d)) & 0xFF)
+        tabd = jnp.stack(planes, 0).astype(jnp.bfloat16).reshape(pd, n_hi, N_LO)
+        ins.append(tabd)
+        in_specs.append(
+            pl.BlockSpec((pd, n_hi, N_LO), lambda t: (0, 0, 0), memory_space=pltpu.VMEM)
+        )
+        out_shapes.append(jax.ShapeDtypeStruct((nT, P, tb), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, P, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
+        )
+
+    def kernel(*refs):
+        nrefs = refs[: len(ins)]
+        orefs = refs[len(ins) :]
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (tb, N_LO), 1)
+        ri = 0
+        for ji, (P, n_hi, pd, digits, n) in enumerate(plans):
+            ids_ref = nrefs[ri]
+            tab_ref = nrefs[ri + 1]
+            ri += 2
+            k = ids_ref[0, 0, :]
+            ok = (k >= 0) & (k < n)
+            safe = jnp.where(ok, k, 0)
+            hi = safe // N_LO
+            lo = safe - hi * N_LO
+            oki = ok.astype(jnp.int32)
+            iota_h = jax.lax.broadcasted_iota(jnp.int32, (tb, n_hi), 1)
+            Hi = ((hi[:, None] == iota_h) & (oki[:, None] > 0)).astype(jnp.bfloat16)
+            Lo = (lo[:, None] == iota_l).astype(jnp.bfloat16)
+            off = 0
+            for p in range(P):
+                acc = None
+                for d in range(digits[p]):
+                    sel = jax.lax.dot(
+                        Hi, tab_ref[off], preferred_element_type=jnp.float32
+                    )  # [tb, N_LO]
+                    part = jnp.sum(sel * Lo.astype(jnp.float32), axis=1)
+                    acc = part * float(1 << (8 * d)) if acc is None else acc + part * float(1 << (8 * d))
+                    off += 1
+                orefs[ji][0, p, :] = acc
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nT,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*ins)
+
+    results = []
+    for out, (P, n_hi, pd, digits, n) in zip(outs, plans):
+        results.append(out.transpose(1, 0, 2).reshape(P, Np)[:, :N].T)  # [N, P]
+    return results

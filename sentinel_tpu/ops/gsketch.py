@@ -115,6 +115,21 @@ def add(
     return state._replace(counts=state.counts.at[idx].set(new_col))
 
 
+def add_dense(
+    state: SketchState,
+    now_ms,
+    upd: jax.Array,  # int32 [depth, width, len(plane_idx)] — precomputed histogram
+    plane_idx: Tuple[int, ...],
+    cfg: SketchConfig,
+) -> SketchState:
+    """Land a precomputed per-cell delta (from the fused effects kernel,
+    ops/fused.py) into the current bucket — the dense companion of add()."""
+    state = refresh(state, now_ms, cfg)
+    idx = _wid(now_ms, cfg) % cfg.sample_count
+    new_col = state.counts[idx].at[:, :, jnp.asarray(plane_idx)].add(upd)
+    return state._replace(counts=state.counts.at[idx].set(new_col))
+
+
 def estimate_plane_mxu(
     ecfg,  # EngineConfig — tables.py dispatch
     state: SketchState,

@@ -1209,7 +1209,16 @@ class SentinelClient:
             f_prio = front[2] if n_front else None
             a = E.AcquireBatch(
                 res=jnp.asarray(arr("res", trash, np.int32, f_row)),
-                count=jnp.asarray(arr("count", 0, np.int32, f_cnt)),
+                # the fused digit planes carry counts exactly up to
+                # max_batch_count (EngineConfig docs); clamping at the
+                # single batch-build choke point makes that envelope real
+                # for every source (API, async, front door, cluster).  The
+                # unfused paths are exact to 65535 and stay unclamped.
+                count=jnp.asarray(
+                    np.minimum(arr("count", 0, np.int32, f_cnt), cfg.max_batch_count)
+                    if cfg.fused_effects
+                    else arr("count", 0, np.int32, f_cnt)
+                ),
                 prio=jnp.asarray(arr("prio", 0, np.int32, f_prio)),
                 origin_id=jnp.asarray(arr("origin_id", -1, np.int32)),
                 origin_node=jnp.asarray(arr("origin_node", trash, np.int32)),
@@ -1247,8 +1256,21 @@ class SentinelClient:
                 ctx_node=pad(ctx_a, trash, np.int32),
                 inbound=pad((flags_a & FLAG_INBOUND), 0, np.int32),
                 rt=pad(rt_a, 0.0, np.float32),
-                success=pad(cnt_a, 0, np.int32),
-                error=pad(err_a, 0, np.int32),
+                # same max_batch_count envelope as the acquire side
+                success=pad(
+                    np.minimum(cnt_a, cfg.max_batch_count)
+                    if cfg.fused_effects
+                    else cnt_a,
+                    0,
+                    np.int32,
+                ),
+                error=pad(
+                    np.minimum(err_a, cfg.max_batch_count)
+                    if cfg.fused_effects
+                    else err_a,
+                    0,
+                    np.int32,
+                ),
                 param_hash=jnp.asarray(ph_np),
             )
 

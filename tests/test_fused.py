@@ -1,0 +1,191 @@
+"""Fused Pallas effects kernels (ops/fused.py): exactness vs oracles and
+engine-path equivalence.
+
+On CPU the kernels run in Pallas interpret mode — semantics only; the
+device-speed path is exercised by bench.py and the on-TPU equivalence
+test (test_tpu_equivalence.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sentinel_tpu.ops import fused as FU
+
+
+def test_scatter_many_exact_vs_numpy():
+    rng = np.random.default_rng(7)
+    N = 700
+    rows1 = rng.integers(-5, 320, (3, N)).astype(np.int32)
+    vals1 = np.stack(
+        [
+            rng.integers(0, 60000, N),
+            rng.integers(0, 2, N),
+            rng.integers(0, 40000, N),
+        ]
+    ).astype(np.int32)
+    rows2 = rng.integers(-2, 90, (2, N)).astype(np.int32)
+    vals2 = rng.integers(0, 200, (2, 2, N)).astype(np.int32)
+
+    o1, o2 = FU.scatter_many(
+        [
+            FU.Job("a", 300, jnp.asarray(rows1), jnp.asarray(vals1), (2, 1, 2)),
+            FU.Job("b", 77, jnp.asarray(rows2), jnp.asarray(vals2), (1, 1)),
+        ],
+        tb=256,
+        interpret=True,
+    )
+    ref1 = np.zeros((300, 3), np.int64)
+    for r in range(3):
+        ok = (rows1[r] >= 0) & (rows1[r] < 300)
+        for p in range(3):
+            np.add.at(ref1[:, p], rows1[r][ok], vals1[p][ok])
+    assert np.array_equal(np.asarray(o1).astype(np.int64), ref1)
+    ref2 = np.zeros((77, 2), np.int64)
+    for r in range(2):
+        ok = (rows2[r] >= 0) & (rows2[r] < 77)
+        for p in range(2):
+            np.add.at(ref2[:, p], rows2[r][ok], vals2[r, p][ok])
+    assert np.array_equal(np.asarray(o2).astype(np.int64), ref2)
+
+
+def test_gather_many_exact_vs_numpy():
+    rng = np.random.default_rng(8)
+    N = 500
+    ids = rng.integers(-3, 310, N).astype(np.int32)
+    tab = rng.integers(0, 1 << 24, (300, 2)).astype(np.int32)
+    (g,) = FU.gather_many(
+        [FU.GatherJob("g", jnp.asarray(ids), jnp.asarray(tab), (3, 3))],
+        tb=256,
+        interpret=True,
+    )
+    ok = (ids >= 0) & (ids < 300)
+    ref = np.zeros((N, 2), np.int64)
+    ref[ok] = tab[ids[ok]]
+    assert np.array_equal(np.asarray(g).astype(np.int64), ref)
+
+
+def _tick_once(cfg, seed=0):
+    """Run a few full-feature ticks exercising every fused plane: default +
+    rate-limiter + warm-up flow rules, prioritized occupy-ahead, ctx/origin
+    stat fan, QPS + THREAD param rules, slow-ratio breakers.  Returns
+    (state, outputs)."""
+    import jax
+
+    from sentinel_tpu.core.rules import (
+        CONTROL_RATE_LIMITER,
+        CONTROL_WARM_UP,
+        DegradeRule,
+        FlowRule,
+        ParamFlowRule,
+    )
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.runtime.registry import Registry
+
+    reg = Registry(cfg)
+    flow, deg, par = [], [], []
+    for i in range(12):
+        name = f"r{i}"
+        reg.resource_id(name)
+        behavior = (
+            CONTROL_RATE_LIMITER
+            if i % 3 == 1
+            else (CONTROL_WARM_UP if i % 3 == 2 else 0)
+        )
+        flow.append(
+            FlowRule(
+                resource=name,
+                count=5.0,
+                control_behavior=behavior,
+                max_queueing_time_ms=40 if behavior == CONTROL_RATE_LIMITER else 0,
+            )
+        )
+        deg.append(DegradeRule(resource=name, grade=0, count=2.0, time_window=5))
+        if i < 4:
+            par.append(
+                ParamFlowRule(resource=name, param_idx=0, count=3.0, grade=1 if i % 2 else 0)
+            )
+    rules = E.compile_ruleset(cfg, reg, flow_rules=flow, degrade_rules=deg, param_rules=par)
+    state = E.init_state(cfg)
+    rng = np.random.default_rng(seed)
+    B = cfg.batch_size
+    outs = []
+    origin_row = reg.origin_node_row("r0", "peer")
+    ctx_row = reg.ctx_node_row("r1", "ctx-a")
+    ctx_id = reg.context_id("ctx-a")
+    for t in range(4):
+        ids = rng.integers(1, 14, B).astype(np.int32)
+        witho = rng.random(B) < 0.3
+        withc = rng.random(B) < 0.25
+        acq = E.empty_acquire(cfg)._replace(
+            res=jnp.asarray(ids),
+            count=jnp.ones((B,), jnp.int32),
+            prio=jnp.asarray((rng.random(B) < 0.3).astype(np.int32)),
+            origin_node=jnp.asarray(
+                np.where(witho, origin_row, cfg.trash_row).astype(np.int32)
+            ),
+            ctx_node=jnp.asarray(
+                np.where(withc, ctx_row, cfg.trash_row).astype(np.int32)
+            ),
+            ctx_name=jnp.asarray(
+                np.where(withc, ctx_id, -1).astype(np.int32)
+            ),
+            inbound=jnp.asarray((rng.random(B) < 0.5).astype(np.int32)),
+            param_hash=jnp.asarray(
+                np.stack(
+                    [rng.integers(1, 5, B), np.zeros(B)], axis=1
+                ).astype(np.int32)
+            ),
+        )
+        comp = E.empty_complete(cfg)._replace(
+            res=jnp.asarray(ids),
+            rt=jnp.asarray(rng.uniform(0.5, 8.0, B).astype(np.float32)),
+            success=jnp.ones((B,), jnp.int32),
+            error=jnp.asarray((rng.random(B) < 0.3).astype(np.int32)),
+            inbound=jnp.asarray((rng.random(B) < 0.5).astype(np.int32)),
+            param_hash=jnp.asarray(
+                np.stack([rng.integers(1, 5, B), np.zeros(B)], axis=1).astype(np.int32)
+            ),
+        )
+        state, out = E.tick(
+            state,
+            rules,
+            acq,
+            comp,
+            jnp.int32(1000 + 333 * t),
+            jnp.float32(0.0),
+            jnp.float32(0.0),
+            cfg=cfg,
+        )
+        outs.append(np.asarray(out.verdict))
+    return jax.tree.map(np.asarray, state), outs
+
+
+@pytest.mark.parametrize("sketch", [False, True])
+def test_fused_tick_matches_mxu_path(sketch):
+    """Full ticks through the fused-effects path must be bit-identical to
+    the unfused MXU path (which test_engine_backends pins to the scatter
+    oracle)."""
+    from sentinel_tpu.core.config import small_engine_config
+
+    base = dict(
+        batch_size=96,
+        complete_batch_size=96,
+        use_mxu_tables=True,
+        sketch_stats=sketch,
+        enable_minute_window=True,
+    )
+    cfg_mxu = small_engine_config(**base)
+    cfg_fused = small_engine_config(**base, fused_effects=True)
+    st1, out1 = _tick_once(cfg_mxu)
+    st2, out2 = _tick_once(cfg_fused)
+    for a, b in zip(out1, out2):
+        np.testing.assert_array_equal(a, b)
+    import jax
+
+    l1, treedef = jax.tree.flatten(st1)
+    l2 = jax.tree.leaves(st2)
+    paths = [str(p) for p, _ in jax.tree_util.tree_flatten_with_path(st1)[0]]
+    for p, x, y in zip(paths, l1, l2):
+        np.testing.assert_array_equal(x, y, err_msg=p)
