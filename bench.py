@@ -76,12 +76,18 @@ def build(B: int, on_tpu: bool):
     from sentinel_tpu.ops import engine as E
     from sentinel_tpu.runtime.registry import Registry
 
+    # capacities sit just UNDER the 128x128 MXU tile boundary: every fused
+    # dot streams the item axis once per ceil(table/16384) tile, so 16376
+    # node rows (node_rows = +8 = 16384) and 16368-capacity rule tables
+    # (+pad row) cost HALF of 16384/16385-row ones (ops/fused.py cost model)
     cfg = EngineConfig(
-        max_resources=16384,
-        max_nodes=16384,
-        max_flow_rules=16384,
-        max_degrade_rules=16384,
+        max_resources=16368,
+        max_nodes=16376,
+        max_flow_rules=16368,
+        max_degrade_rules=16368,  # cb table = 2*16368 rows -> 2 tiles (vs 3)
         max_param_rules=256,
+        param_classes=2,  # one distinct rule duration in this config
+
         flow_rules_per_resource=1,
         degrade_rules_per_resource=1,
         param_rules_per_resource=1,
@@ -89,6 +95,7 @@ def build(B: int, on_tpu: bool):
         complete_batch_size=B,
         enable_minute_window=True,
         use_mxu_tables=on_tpu,
+        fused_effects=on_tpu,  # Pallas effects megakernels (ops/fused.py)
         sketch_stats=True,
     )
     reg = Registry(cfg)
@@ -263,9 +270,12 @@ def main() -> None:
     # a full interval.  Device tick time per B from the slope harness.
     lat_table = []
     if on_tpu:
-        for Bl in (4096, 16384, 65536):
+        for Bl in (4096, 8192, 16384, 65536):
             cfg_l, E_l, ruleset_l, acqs_l, comps_l = build(Bl, on_tpu)
-            d = device_tick_ms(cfg_l, E_l, ruleset_l, acqs_l, comps_l, k1=8, k2=40)
+            # small ticks need a long slope window: the tunnel's +-20 ms
+            # call variance must be small against (k2-k1) x tick_ms
+            k2 = 288 if Bl <= 16384 else 40
+            d = device_tick_ms(cfg_l, E_l, ruleset_l, acqs_l, comps_l, k1=8, k2=k2)
             interval = max(d, 1.0)  # ticking back-to-back at device rate
             lat_table.append(
                 {
@@ -277,6 +287,13 @@ def main() -> None:
                 }
             )
     best_p99 = min((r["req_p99_ms"] for r in lat_table), default=None)
+    # the BASELINE contract is BOTH at once: the best throughput among tick
+    # sizes whose modeled p99 stays under 2 ms (VERDICT r2 weak #2)
+    joint = max(
+        (r for r in lat_table if r["req_p99_ms"] < 2.0),
+        key=lambda r: r["throughput_Mdps"],
+        default=None,
+    )
 
     print(
         json.dumps(
@@ -298,6 +315,7 @@ def main() -> None:
                 "tunnel_sync_floor_ms": round(sync_floor_ms, 3),
                 "req_latency_vs_tick_size": lat_table,
                 "req_p99_ms_best": best_p99,
+                "joint_point_p99_under_2ms": joint,
                 "platform": platform,
             }
         )

@@ -54,7 +54,8 @@ def refresh_columns(
     wid = (now_ms // jnp.maximum(window_ms, 1)).astype(jnp.int32)
     idx = wid % nb
     onehot = jax.nn.one_hot(idx, nb, dtype=jnp.int32)
-    cur_epoch = jnp.take_along_axis(epochs, idx[:, None], axis=1)[:, 0]
+    # one-hot contraction, not take_along_axis (serialized row gather)
+    cur_epoch = jnp.sum(epochs * onehot, axis=1)
     stale = (cur_epoch != wid).astype(jnp.int32)
     keep = 1 - onehot * stale[:, None]
     counts = counts * keep[:, :, None]

@@ -659,6 +659,66 @@ def _process_completions(
     )
 
 
+def _acquire_entry_stats(cfg: EngineConfig, acq: AcquireBatch, valid, passed, occupying):
+    """(pass_c, block_c, occ_c, entry_deltas) — the acquire-side stat
+    planes and global ENTRY-node reductions shared by the fused and
+    unfused effect paths (StatisticSlot.java:54-123)."""
+    pass_c = jnp.where(passed & ~occupying, acq.count, 0)
+    block_c = jnp.where(valid & ~passed, acq.count, 0)
+    occ_c = jnp.where(occupying, acq.count, 0)
+    inb = valid & (acq.inbound > 0)
+    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
+    entry_deltas = entry_deltas.at[W.EV_PASS].set(
+        jnp.sum(jnp.where(inb & passed & ~occupying, acq.count, 0))
+    )
+    entry_deltas = entry_deltas.at[W.EV_OCCUPIED].set(
+        jnp.sum(jnp.where(inb & occupying, acq.count, 0))
+    )
+    entry_deltas = entry_deltas.at[W.EV_BLOCK].set(
+        jnp.sum(jnp.where(inb & ~passed, acq.count, 0))
+    )
+    return pass_c, block_c, occ_c, entry_deltas
+
+
+def _scatter_with_stat_fan(
+    cfg: EngineConfig, other_jobs, res, ctx_node, origin_node, valid,
+    stat_vals, stat_digits, with_nodes: bool,
+):
+    """Run scatter_many with the stat job's fan width picked at runtime:
+    no ctx/origin rows -> R=1, origin rows only -> R=2, else the full
+    [res, ctx, origin] fan (StatisticSlot.java:54-123).  Dropped-row
+    semantics make every variant bit-identical; the narrow ones just skip
+    the all-trash row-vectors' dot passes (~1/3 of the stat units each).
+    Output shapes are fan-independent, so the variants live in one
+    lax.switch."""
+    res_row = _clean_rows(cfg, res)
+    if not with_nodes:
+        return FU.scatter_many(
+            [FU.Job("stat", cfg.max_nodes, res_row[None, :], stat_vals, stat_digits)]
+            + other_jobs
+        )
+    ctx_row = _clean_rows(cfg, ctx_node)
+    org_row = _clean_rows(cfg, origin_node)
+
+    def _run(stat_rows):
+        return FU.scatter_many(
+            [FU.Job("stat", cfg.max_nodes, stat_rows, stat_vals, stat_digits)]
+            + other_jobs
+        )
+
+    any_ctx = jnp.any(valid & (ctx_node != cfg.trash_row))
+    any_org = jnp.any(valid & (origin_node != cfg.trash_row))
+    idx = jnp.where(any_ctx, 2, jnp.where(any_org, 1, 0))
+    return jax.lax.switch(
+        idx,
+        [
+            lambda: _run(res_row[None, :]),
+            lambda: _run(jnp.stack([res_row, org_row])),
+            lambda: _run(jnp.stack([res_row, ctx_row, org_row])),
+        ],
+    )
+
+
 def _use_fused(cfg: EngineConfig) -> bool:
     """Fused effects require the MXU table path and honor the
     SENTINEL_NO_PALLAS kill switch (ops/fused.available)."""
@@ -712,19 +772,10 @@ def _process_completions_fused(
     # so tables are kept <= 16384 rows per job — real stat rows live below
     # max_nodes (the +8 node_rows tail is trash/padding only), per-depth
     # sketch/param planes are separate jobs, and rule-table pad slots drop
-    # via row -1 instead of landing on a pad row.
+    # via row -1 instead of landing on a pad row.  The stat fan width is
+    # chosen at runtime (lax.switch below): batches without ctx/origin rows
+    # pay one row-vector instead of three.
     jobs = []
-    if with_nodes:
-        stat_rows = jnp.stack(
-            [
-                _clean_rows(cfg, comp.res),
-                _clean_rows(cfg, comp.ctx_node),
-                _clean_rows(cfg, comp.origin_node),
-            ]
-        )
-    else:
-        stat_rows = _clean_rows(cfg, comp.res)[None, :]
-    jobs.append(FU.Job("stat", cfg.max_nodes, stat_rows, vals3, digits3))
 
     if cfg.sketch_stats:
         cols = P.cms_cell(comp.res, cfg.sketch_depth, cfg.sketch_width)  # [B, depth]
@@ -799,7 +850,10 @@ def _process_completions_fused(
             )
         )
 
-    outs = FU.scatter_many(jobs)
+    outs = _scatter_with_stat_fan(
+        cfg, jobs, comp.res, comp.ctx_node, comp.origin_node, valid,
+        vals3, digits3, with_nodes,
+    )
     oi = 0
     stat_out = outs[oi]
     oi += 1
@@ -909,39 +963,13 @@ def _acquire_effects_fused(
     erow = cfg.entry_node_row
     cd = cfg.count_digits
 
-    pass_c = jnp.where(passed & ~occupying, acq.count, 0)
-    block_c = jnp.where(valid & ~passed, acq.count, 0)
-    occ_c = jnp.where(occupying, acq.count, 0)
-
-    inb = valid & (acq.inbound > 0)
-    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
-    entry_deltas = entry_deltas.at[W.EV_PASS].set(
-        jnp.sum(jnp.where(inb & passed & ~occupying, acq.count, 0))
-    )
-    entry_deltas = entry_deltas.at[W.EV_OCCUPIED].set(
-        jnp.sum(jnp.where(inb & occupying, acq.count, 0))
-    )
-    entry_deltas = entry_deltas.at[W.EV_BLOCK].set(
-        jnp.sum(jnp.where(inb & ~passed, acq.count, 0))
+    pass_c, block_c, occ_c, entry_deltas = _acquire_entry_stats(
+        cfg, acq, valid, passed, occupying
     )
 
     jobs = []
-    if with_nodes:
-        stat_rows = jnp.stack(
-            [
-                _clean_rows(cfg, acq.res),
-                _clean_rows(cfg, acq.ctx_node),
-                _clean_rows(cfg, acq.origin_node),
-            ]
-        )
-    else:
-        stat_rows = _clean_rows(cfg, acq.res)[None, :]
-    jobs.append(
-        FU.Job(
-            "stat", cfg.max_nodes, stat_rows, jnp.stack([pass_c, block_c, occ_c]),
-            (cd, cd, cd),
-        )
-    )
+    stat_vals = jnp.stack([pass_c, block_c, occ_c])
+    stat_digits = (cd, cd, cd)
 
     if cfg.sketch_stats:
         cols = P.cms_cell(acq.res, cfg.sketch_depth, cfg.sketch_width)
@@ -1018,7 +1046,10 @@ def _acquire_effects_fused(
                 )
             )
 
-    outs = FU.scatter_many(jobs)
+    outs = _scatter_with_stat_fan(
+        cfg, jobs, acq.res, acq.ctx_node, acq.origin_node, valid,
+        stat_vals, stat_digits, with_nodes,
+    )
     oi = 0
     stat_out = outs[oi]
     oi += 1
@@ -1223,7 +1254,10 @@ def _check_param(
     wtab = P.class_tables(
         pcms, pcms_epochs, jnp.asarray(rules.param.class_k), now_ms, cfg
     )
-    est = P.estimate(cfg, wtab, prows, cls)
+    if _use_fused(cfg):
+        est = P.estimate_fused(cfg, wtab, prows, cls)
+    else:
+        est = P.estimate(cfg, wtab, prows, cls)
     # the concurrency gathers only run when a THREAD-grade rule exists
     any_thread = jnp.any(
         jnp.asarray(rules.param.enabled)
@@ -1475,13 +1509,20 @@ def _check_flow(
         # dense per-row windowed pass totals once (elementwise over the
         # window tensor), then ONE one-hot gather for (pass, concurrency)
         wsum = W.window_event(state.win_sec, now_ms, sec_cfg, W.EV_PASS)
-        both = T.big_gather(
-            cfg,
-            jnp.stack([wsum, state.concurrency], axis=1),
-            node_safe,
-            cfg.node_rows,
-            max_int=(1 << 24),
-        )
+        tab = jnp.stack([wsum, state.concurrency], axis=1)
+        if _use_fused(cfg):
+            cap = jnp.int32((1 << 24) - 1)
+            (both,) = FU.gather_many(
+                [FU.GatherJob("wsum", node_safe, jnp.minimum(tab, cap), (3, 3))]
+            )
+        else:
+            both = T.big_gather(
+                cfg,
+                tab,
+                node_safe,
+                cfg.node_rows,
+                max_int=(1 << 24),
+            )
         wp = both[:, 0].astype(jnp.float32)
         conc = both[:, 1].astype(jnp.float32)
     else:
@@ -1722,14 +1763,20 @@ def _check_degrade(
     blocked = (entry_block & _fan(eligible, KD)).reshape(b, KD).any(axis=1)
 
     # elected probes flip their breaker OPEN → HALF_OPEN; a probe whose item
-    # is blocked by another CB on the same resource must not flip
+    # is blocked by another CB on the same resource must not flip.  The
+    # scatter only runs when a probe was actually elected — the all-closed
+    # steady state pays nothing (the unconditional form cost ~0.6 ms/tick)
     probe_ok = probe & ~_fan(blocked, KD)
     Dn1 = cfg.max_degrade_rules + 1
-    flip = T.small_scatter_or(
-        cfg,
-        jnp.zeros((Dn1,), jnp.int32),
-        jnp.minimum(slots_f, cfg.max_degrade_rules),
-        probe_ok,
+    flip = jax.lax.cond(
+        jnp.any(probe_ok),
+        lambda: T.small_scatter_or(
+            cfg,
+            jnp.zeros((Dn1,), jnp.int32),
+            jnp.minimum(slots_f, cfg.max_degrade_rules),
+            probe_ok,
+        ),
+        lambda: jnp.zeros((Dn1,), jnp.int32),
     )
     cb_state = jnp.where(
         (flip > 0) & (state.cb_state == D.CB_OPEN), D.CB_HALF_OPEN, state.cb_state
@@ -1919,25 +1966,10 @@ def tick(
     with_nodes = "nodes" in features
     rows = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, with_nodes)
     # planes (PASS, BLOCK, OCCUPIED) only — the entry path writes no others
-    deltas1 = jnp.stack(
-        [
-            jnp.where(passed & ~occupying, acq.count, 0),
-            jnp.where(valid & ~passed, acq.count, 0),
-            jnp.where(occupying, acq.count, 0),
-        ],
-        axis=1,
+    pass_c, block_c, occ_c, entry_deltas = _acquire_entry_stats(
+        cfg, acq, valid, passed, occupying
     )
-    inb = valid & (acq.inbound > 0)
-    entry_deltas = jnp.zeros((W.NUM_EVENTS,), jnp.int32)
-    entry_deltas = entry_deltas.at[W.EV_PASS].set(
-        jnp.sum(jnp.where(inb & passed & ~occupying, acq.count, 0))
-    )
-    entry_deltas = entry_deltas.at[W.EV_OCCUPIED].set(
-        jnp.sum(jnp.where(inb & occupying, acq.count, 0))
-    )
-    entry_deltas = entry_deltas.at[W.EV_BLOCK].set(
-        jnp.sum(jnp.where(inb & ~passed, acq.count, 0))
-    )
+    deltas1 = jnp.stack([pass_c, block_c, occ_c], axis=1)
 
     def _land_acq(fanned: bool):
         rws = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, fanned)

@@ -38,6 +38,9 @@ import jax.numpy as jnp
 #: default items per grid step; 4096 measured best (fewer grid steps than
 #: 2048 at equal VMEM pressure; 8192+ fails VMEM on multi-job kernels)
 TILE = 4096
+#: gather kernels hold [tb, N_LO] f32 select products per unrolled digit —
+#: a 4096 tile overflows the 16M scoped-vmem stack on multi-plane jobs
+TILE_GATHER = 2048
 
 #: one-hot minor-axis width — 128 lanes exactly, so Lo is a single vreg
 #: column and the dot's N dim never pads
@@ -240,7 +243,9 @@ class GatherJob(NamedTuple):
     digits: tuple
 
 
-def gather_many(jobs: Sequence[GatherJob], tb: int = TILE, interpret: Optional[bool] = None):
+def gather_many(
+    jobs: Sequence[GatherJob], tb: int = TILE_GATHER, interpret: Optional[bool] = None
+):
     """Per-item gathers from several tables in ONE kernel.
 
     Returns one f32 [N, P] per job.  The table rides in VMEM as bf16 digit

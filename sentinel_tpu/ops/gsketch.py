@@ -67,16 +67,15 @@ def _wid(now_ms, cfg: SketchConfig):
 
 
 def refresh(state: SketchState, now_ms, cfg: SketchConfig) -> SketchState:
+    # masked column update, not lax.cond — a cond's identity branch copies
+    # the whole counts tensor every tick (see ops/window.refresh)
     wid = _wid(now_ms, cfg)
     idx = wid % cfg.sample_count
-    stale = state.epochs[idx] != wid
-
-    def reset(s):
-        return SketchState(
-            counts=s.counts.at[idx].set(0), epochs=s.epochs.at[idx].set(wid)
-        )
-
-    return jax.lax.cond(stale, reset, lambda s: s, state)
+    keep = (state.epochs[idx] == wid).astype(state.counts.dtype)
+    return SketchState(
+        counts=state.counts.at[idx].multiply(keep),
+        epochs=state.epochs.at[idx].set(wid),
+    )
 
 
 def add(

@@ -79,18 +79,15 @@ def _wid(now_ms, cfg: EngineConfig):
 def refresh(
     pcms: jax.Array, epochs: jax.Array, now_ms, cfg: EngineConfig
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Zero the global current bucket if stale; returns (pcms, epochs, idx)."""
+    """Zero the global current bucket if stale; returns (pcms, epochs, idx).
+
+    Masked column update, not lax.cond — a cond's identity branch copies
+    the whole pcms tensor every tick (see ops/window.refresh)."""
     nb = cfg.param_sample_count
     wid = _wid(now_ms, cfg)
     idx = wid % nb
-    stale = epochs[idx] != wid
-
-    def reset(args):
-        p, e = args
-        return p.at[:, :, idx].set(0), e.at[idx].set(wid)
-
-    pcms, epochs = jax.lax.cond(stale, reset, lambda a: a, (pcms, epochs))
-    return pcms, epochs, idx
+    keep = (epochs[idx] == wid).astype(pcms.dtype)
+    return pcms.at[:, :, idx].multiply(keep), epochs.at[idx].set(wid), idx
 
 
 def class_tables(
@@ -146,6 +143,37 @@ def estimate(
             max_int=(1 << 24) - 1,
         )  # [N, C]
         ests.append(jnp.sum(g.astype(jnp.float32) * cls_oh, axis=1))
+    return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
+
+
+def estimate_fused(
+    cfg: EngineConfig,
+    wtab: jax.Array,  # [depth, Q, C] from class_tables
+    rows: jax.Array,  # [N, depth] from pair_rows
+    cls: jax.Array,  # int32 [N]
+) -> jax.Array:
+    """estimate() with the per-depth [Q, C] gathers fused into one Pallas
+    kernel (ops/fused.gather_many) — same saturation and min-over-depth
+    semantics, one one-hot build per depth instead of C digit-gathers."""
+    from sentinel_tpu.ops import fused as FU
+
+    C = wtab.shape[2]
+    cap = jnp.int32((1 << 24) - 1)
+    jobs = [
+        FU.GatherJob(
+            f"pest{d}",
+            rows[:, d],
+            jnp.minimum(wtab[d].astype(jnp.int32), cap),
+            (3,) * C,
+        )
+        for d in range(wtab.shape[0])
+    ]
+    outs = FU.gather_many(jobs)
+    cls_oh = (
+        jnp.clip(cls, 0, C - 1)[:, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+    ).astype(jnp.float32)
+    ests = [jnp.sum(g * cls_oh, axis=1) for g in outs]
     return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
 
 

@@ -89,20 +89,26 @@ def refresh(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> WindowS
 
     Batched analog of LeapArray.java:149-248 (CAS-create / reuse /
     tryLock-reset), applied to all rows of the column at once.
+
+    Masked single-column update instead of lax.cond: an XLA cond's
+    identity branch materializes a copy of every carried buffer (~20 MB
+    for the minute window — a measured ~0.1 ms/tick fixed cost each),
+    while the masked form touches one column in place under donation.
     """
     wid = _wid(now_ms, cfg)
     idx = wid % cfg.sample_count
-    stale = state.epochs[idx] != wid
-
-    def do_reset(s: WindowState) -> WindowState:
-        return WindowState(
-            counts=s.counts.at[:, idx, :].set(0),
-            rt_sum=s.rt_sum.at[:, idx].set(0.0),
-            rt_min=s.rt_min.at[:, idx].set(RT_MIN_INIT),
-            epochs=s.epochs.at[idx].set(wid),
-        )
-
-    return jax.lax.cond(stale, do_reset, lambda s: s, state)
+    fresh = state.epochs[idx] == wid
+    keep_i = fresh.astype(state.counts.dtype)
+    keep_f = fresh.astype(jnp.float32)
+    return WindowState(
+        counts=state.counts.at[:, idx, :].multiply(keep_i),
+        rt_sum=state.rt_sum.at[:, idx].multiply(keep_f),
+        rt_min=state.rt_min.at[:, idx].set(
+            jnp.where(fresh, state.rt_min[:, idx], RT_MIN_INIT)
+        ),
+        # reuse keeps epoch == wid, reset stamps it — identical either way
+        epochs=state.epochs.at[idx].set(wid),
+    )
 
 
 def add_batch(

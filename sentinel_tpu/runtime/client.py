@@ -1207,16 +1207,21 @@ class SentinelClient:
             f_row = front[0] if n_front else None
             f_cnt = front[1] if n_front else None
             f_prio = front[2] if n_front else None
+            from sentinel_tpu.ops.engine import _use_fused
+
+            clamp = _use_fused(cfg)
             a = E.AcquireBatch(
                 res=jnp.asarray(arr("res", trash, np.int32, f_row)),
                 # the fused digit planes carry counts exactly up to
                 # max_batch_count (EngineConfig docs); clamping at the
                 # single batch-build choke point makes that envelope real
                 # for every source (API, async, front door, cluster).  The
-                # unfused paths are exact to 65535 and stay unclamped.
+                # clamp tracks the ACTIVE path (engine._use_fused, incl.
+                # the SENTINEL_NO_PALLAS kill switch) — the unfused paths
+                # are exact to 65535 and stay unclamped.
                 count=jnp.asarray(
                     np.minimum(arr("count", 0, np.int32, f_cnt), cfg.max_batch_count)
-                    if cfg.fused_effects
+                    if clamp
                     else arr("count", 0, np.int32, f_cnt)
                 ),
                 prio=jnp.asarray(arr("prio", 0, np.int32, f_prio)),
@@ -1237,6 +1242,9 @@ class SentinelClient:
         c = E.empty_complete(cfg, b=min(256, cfg.complete_batch_size))
         if comp is not None:
             from sentinel_tpu.native.ring import FLAG_INBOUND
+            from sentinel_tpu.ops.engine import _use_fused
+
+            clamp = _use_fused(cfg)
 
             res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag, aux0_a, aux1_a = comp
             n = len(res_a)
@@ -1259,14 +1267,14 @@ class SentinelClient:
                 # same max_batch_count envelope as the acquire side
                 success=pad(
                     np.minimum(cnt_a, cfg.max_batch_count)
-                    if cfg.fused_effects
+                    if clamp
                     else cnt_a,
                     0,
                     np.int32,
                 ),
                 error=pad(
                     np.minimum(err_a, cfg.max_batch_count)
-                    if cfg.fused_effects
+                    if clamp
                     else err_a,
                     0,
                     np.int32,
