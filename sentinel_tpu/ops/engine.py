@@ -60,6 +60,7 @@ from sentinel_tpu.ops import fused as FU
 from sentinel_tpu.ops import gsketch as GS
 from sentinel_tpu.ops import rtq as RQ
 from sentinel_tpu.ops import param as P
+from sentinel_tpu.ops import rowmin as RM
 from sentinel_tpu.ops import tables as T
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.rank import (
@@ -296,7 +297,9 @@ def _stat_update(
 
     CPU path: scatter-add per window (exact, incl. per-row minRt).
     MXU path: one-hot-matmul histogram → dense column add (ops/tables.py);
-    per-row minRt is skipped (ENTRY-row min is kept via min_into_row).
+    per-row minRt rides the sort/segmented-min path (ops/rowmin.py) and is
+    exact over raw rts; the ENTRY-row min additionally lands via
+    min_into_row.
 
     ``plane_idx`` names the event planes ``deltas`` carries — the acquire
     side only writes PASS/OCCUPIED/BLOCK and the completion side only
@@ -324,15 +327,25 @@ def _stat_update(
         hist = hist.at[:, jnp.asarray(plane_idx)].set(hist_small)
         hist = hist.at[erow].add(entry_deltas)
         rt_hist = None
+        row_min = None
         if rt is not None:
             rt_hist = h[:, -1].astype(jnp.float32) / 8.0
             rt_hist = rt_hist.at[erow].add(entry_rt)
-        win_sec = W.add_dense(state.win_sec, now_ms, hist, rt_hist, sec_cfg)
+            # exact per-row windowed minRt over RAW rts (ops/rowmin.py) —
+            # closes the former MXU-path snapshot divergence
+            row_min = RM.per_row_min(
+                cfg, rows, rt, jnp.ones_like(rows, bool), cfg.node_rows
+            )
+        win_sec = W.add_dense(
+            state.win_sec, now_ms, hist, rt_hist, sec_cfg, row_min=row_min
+        )
         if entry_rt_min is not None:
             win_sec = W.min_into_row(win_sec, now_ms, erow, entry_rt_min, sec_cfg)
         win_min = state.win_min
         if cfg.enable_minute_window:
-            win_min = W.add_dense(state.win_min, now_ms, hist, rt_hist, min_cfg)
+            win_min = W.add_dense(
+                state.win_min, now_ms, hist, rt_hist, min_cfg, row_min=row_min
+            )
         return state._replace(win_sec=win_sec, win_min=win_min), hist
     # CPU/scatter path
     if len(plane_idx) != W.NUM_EVENTS:
@@ -767,6 +780,26 @@ def _process_completions_fused(
     cd = cfg.count_digits
     digits3 = (cd, cd, cfg.rt_digits)
 
+    # exact per-row windowed minRt (ops/rowmin.py): sorted min heads are
+    # unique per row, so they land as ONE extra sum-scatter job on the
+    # shared item axis (fan reshaped to R=3 row-vectors); trash/absent
+    # rows drop, making this fan-switch-invariant
+    RMIN = 3 if with_nodes else 1
+    min_rows_flat = _stat_rows(
+        cfg, comp.res, comp.ctx_node, comp.origin_node, with_nodes
+    )
+    min_rt_flat = jnp.tile(rt1, (RMIN,)) if with_nodes else rt1
+    mh_rows, mh_vals = RM.min_heads(
+        min_rows_flat, min_rt_flat, jnp.ones_like(min_rows_flat, bool), cfg.max_nodes
+    )
+    min_job = FU.Job(
+        "rowmin",
+        cfg.max_nodes,
+        mh_rows.reshape(RMIN, b),
+        mh_vals.T.reshape(3, RMIN, b).transpose(1, 0, 2),
+        (2, 2, 1),
+    )
+
     # Job shaping rule (measured, benchmarks/probe_fused_hist*.py): every
     # MXU dot streams the whole item axis and costs ceil(n/16384) passes,
     # so tables are kept <= 16384 rows per job — real stat rows live below
@@ -775,7 +808,7 @@ def _process_completions_fused(
     # via row -1 instead of landing on a pad row.  The stat fan width is
     # chosen at runtime (lax.switch below): batches without ctx/origin rows
     # pay one row-vector instead of three.
-    jobs = []
+    jobs = [min_job]
 
     if cfg.sketch_stats:
         cols = P.cms_cell(comp.res, cfg.sketch_depth, cfg.sketch_width)  # [B, depth]
@@ -857,6 +890,8 @@ def _process_completions_fused(
     oi = 0
     stat_out = outs[oi]
     oi += 1
+    min_out = outs[oi]  # [max_nodes, 3] — (bits_hi, bits_lo, present)
+    oi += 1
     sk_out = None
     if cfg.sketch_stats:
         sk_out = jnp.stack(outs[oi : oi + cfg.sketch_depth])  # [depth, width, 3]
@@ -885,11 +920,20 @@ def _process_completions_fused(
         [stat_out[:, 2] / 8.0, jnp.zeros((pad_tail,), jnp.float32)]
     )
     rt_hist = rt_hist.at[erow].add(entry_rt)
-    win_sec = W.add_dense(state.win_sec, now_ms, hist, rt_hist, sec_cfg)
+    mins_m, present_m = RM.combine(min_out)
+    row_min = (
+        jnp.concatenate([mins_m, jnp.full((pad_tail,), W.RT_MIN_INIT, jnp.float32)]),
+        jnp.concatenate([present_m, jnp.zeros((pad_tail,), bool)]),
+    )
+    win_sec = W.add_dense(
+        state.win_sec, now_ms, hist, rt_hist, sec_cfg, row_min=row_min
+    )
     win_sec = W.min_into_row(win_sec, now_ms, erow, entry_rt_min, sec_cfg)
     win_min = state.win_min
     if cfg.enable_minute_window:
-        win_min = W.add_dense(state.win_min, now_ms, hist, rt_hist, min_cfg)
+        win_min = W.add_dense(
+            state.win_min, now_ms, hist, rt_hist, min_cfg, row_min=row_min
+        )
     state = state._replace(win_sec=win_sec, win_min=win_min)
 
     state = state._replace(

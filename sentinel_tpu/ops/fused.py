@@ -35,11 +35,12 @@ from typing import NamedTuple, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-#: default items per grid step; 4096 measured best (fewer grid steps than
-#: 2048 at equal VMEM pressure; 8192+ fails VMEM on multi-job kernels)
-TILE = 4096
-#: gather kernels hold [tb, N_LO] f32 select products per unrolled digit —
-#: a 4096 tile overflows the 16M scoped-vmem stack on multi-plane jobs
+#: default items per grid step.  Multi-job kernels unroll one [tb, N_LO]
+#: LoV temporary per digit-dot; ~25 dots x tb=2048 x 128 x 2B ~= 13 MB
+#: stays inside Mosaic's 16 MB scoped-vmem stack (tb=4096 overflows on
+#: some job mixes) and measures within noise of 4096 at bench shapes.
+TILE = 2048
+#: gather kernels hold [tb, N_LO] f32 select products per unrolled digit
 TILE_GATHER = 2048
 
 #: one-hot minor-axis width — 128 lanes exactly, so Lo is a single vreg
@@ -92,6 +93,16 @@ def _pad_axis(x: jax.Array, axis: int, to: int, fill) -> jax.Array:
     return jnp.pad(x, widths, constant_values=fill)
 
 
+#: max digit-dot units per pallas call — Mosaic's 16 MB scoped-vmem stack
+#: holds ~25-30 unrolled [tb, N_LO] temporaries at tb=2048; larger job
+#: mixes (e.g. rules_per_resource > 1 configs) split across calls
+_MAX_UNITS_PER_CALL = 28
+
+
+def _job_units(j: "Job") -> int:
+    return j.rows.shape[0] * sum(j.digits)
+
+
 def scatter_many(jobs: Sequence[Job], tb: int = TILE, interpret: Optional[bool] = None):
     """Run every job's scatter in ONE Pallas kernel over a shared item axis.
 
@@ -100,12 +111,32 @@ def scatter_many(jobs: Sequence[Job], tb: int = TILE, interpret: Optional[bool] 
     digit planes already recombined; integer-exact within the documented
     bounds.  The caller lands these into window/sketch state with plain
     elementwise adds (ops/window.add_dense etc.).
+
+    Job lists whose total digit-dot count exceeds the scoped-vmem budget
+    are transparently split across several pallas calls (per-call overhead
+    is small against the per-dot cost).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = interpret_mode()
+
+    total_units = sum(_job_units(j) for j in jobs)
+    if total_units > _MAX_UNITS_PER_CALL and len(jobs) > 1:
+        chunks: list = [[]]
+        acc = 0
+        for j in jobs:
+            u = _job_units(j)
+            if chunks[-1] and acc + u > _MAX_UNITS_PER_CALL:
+                chunks.append([])
+                acc = 0
+            chunks[-1].append(j)
+            acc += u
+        out: list = []
+        for ch in chunks:
+            out.extend(scatter_many(ch, tb=tb, interpret=interpret))
+        return out
 
     N = jobs[0].rows.shape[-1]
     for j in jobs:

@@ -144,21 +144,27 @@ def add_dense(
     count_hist: jax.Array,  # int32 [rows, NUM_EVENTS] — dense per-row deltas
     rt_hist: Optional[jax.Array],  # float32 [rows] or None
     cfg: WindowConfig,
+    row_min=None,  # optional (mins f32 [rows], present bool [rows])
 ) -> WindowState:
     """Apply a precomputed dense per-row delta to the current bucket column.
 
     The MXU-path companion of add_batch: the batch is first reduced to a
     dense histogram (ops/tables.histogram — one-hot matmuls), then landing
     it in the window is a plain elementwise add on the current column.
-    Per-row rt_min is NOT maintained on this path (the serialized
-    scatter-min costs more than the whole tick); callers that need a min
-    keep it for fixed rows via reductions."""
+    Per-row rt_min lands from ``row_min`` — the exact dense minimum built
+    by ops/rowmin.py (sort + segmented scan + head sum-scatter)."""
     state = refresh(state, now_ms, cfg)
     idx = current_index(now_ms, cfg)
     counts = state.counts.at[:, idx, :].add(count_hist.astype(state.counts.dtype))
     rt_sum = state.rt_sum if rt_hist is None else state.rt_sum.at[:, idx].add(rt_hist)
+    rt_min = state.rt_min
+    if row_min is not None:
+        mins, present = row_min
+        rt_min = rt_min.at[:, idx].min(
+            jnp.where(present, mins, jnp.float32(RT_MIN_INIT))
+        )
     return WindowState(
-        counts=counts, rt_sum=rt_sum, rt_min=state.rt_min, epochs=state.epochs
+        counts=counts, rt_sum=rt_sum, rt_min=rt_min, epochs=state.epochs
     )
 
 

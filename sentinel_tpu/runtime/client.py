@@ -1309,8 +1309,8 @@ class SentinelClient:
 
 
 def _mask_min_rt(v: float) -> float:
-    """RT_MIN_INIT (5000) is the 'no data yet' sentinel — also what the MXU
-    backend leaves for per-resource rows (it skips per-row minimums).
+    """RT_MIN_INIT (5000) is the 'no completions in window' sentinel
+    (every backend maintains per-row minRt exactly — ops/rowmin.py).
     Report 0.0 instead of a phantom 5-second minimum."""
     return 0.0 if v >= W.RT_MIN_INIT else v
 

@@ -109,6 +109,8 @@ def test_backend_equivalence(features):
                 conc=np.asarray(state.concurrency),
                 cb=np.asarray(state.cb_state),
                 latest=np.asarray(state.latest_passed_ms),
+                rt_min=np.asarray(state.win_sec.rt_min),
+                rt_min_minute=np.asarray(state.win_min.rt_min),
             )
         )
     a, b = outs
@@ -117,3 +119,7 @@ def test_backend_equivalence(features):
     np.testing.assert_array_equal(a["conc"], b["conc"])
     np.testing.assert_array_equal(a["cb"], b["cb"])
     np.testing.assert_allclose(a["latest"], b["latest"], rtol=1e-6, atol=1e-3)
+    # per-row windowed minRt is maintained exactly on BOTH paths over RAW
+    # rts (ops/rowmin.py) — bit-equal even though rt_sum quantizes on MXU
+    np.testing.assert_array_equal(a["rt_min"], b["rt_min"])
+    np.testing.assert_array_equal(a["rt_min_minute"], b["rt_min_minute"])

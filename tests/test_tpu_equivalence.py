@@ -1,0 +1,57 @@
+"""On-TPU three-path equivalence (VERDICT r2 #4): scatter vs MXU vs fused
+paths must produce bit-identical verdicts and state ON THE REAL CHIP —
+the only place the bf16 digit-plane tricks actually exercise the MXU.
+
+The check runs in a subprocess WITHOUT the conftest CPU forcing (the suite
+itself runs on a virtual CPU mesh); it is skipped when no TPU is
+reachable, and green in the bench environment."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    # drop the virtual-device forcing the suite sets for CPU sharding tests
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def _tpu_available() -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env=_clean_env(),
+        )
+        return r.returncode == 0 and "cpu" not in r.stdout.lower()
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tpu_available(), reason="needs a real TPU")
+def test_three_path_equivalence_on_device():
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "benchmarks", "tpu_equivalence.py")],
+        env=_clean_env(),
+        cwd=_REPO,
+        timeout=1500,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, f"on-device equivalence failed:\n{r.stdout[-4000:]}\n{r.stderr[-4000:]}"
