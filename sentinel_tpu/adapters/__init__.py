@@ -1,6 +1,7 @@
 """Adapters (SURVEY §2.7): entry points that bridge user traffic into the
 engine — decorator, WSGI/ASGI middleware, gRPC interceptors, outbound HTTP
-client guards, and the API-gateway rule/param bridge."""
+client guards, the chained-resource RPC provider/consumer pattern, the
+async-streaming wrapper, and the API-gateway rule/param bridge."""
 
 from sentinel_tpu.adapters.decorator import sentinel_resource
 from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
@@ -9,6 +10,17 @@ from sentinel_tpu.adapters.http_client import (
     SentinelHttpClient,
     guarded_urlopen,
     default_url_resource,
+)
+from sentinel_tpu.adapters.rpc import (
+    consumer_call,
+    consumer_entry,
+    provider_call,
+    provider_entry,
+)
+from sentinel_tpu.adapters.streaming import (
+    guard_aiter,
+    guard_awaitable,
+    guard_stream,
 )
 from sentinel_tpu.adapters.gateway import (
     ApiDefinition,
@@ -28,6 +40,13 @@ __all__ = [
     "SentinelWSGIMiddleware",
     "SentinelASGIMiddleware",
     "SentinelHttpClient",
+    "consumer_call",
+    "consumer_entry",
+    "provider_call",
+    "provider_entry",
+    "guard_aiter",
+    "guard_awaitable",
+    "guard_stream",
     "guarded_urlopen",
     "default_url_resource",
     "ApiDefinition",

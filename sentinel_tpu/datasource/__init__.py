@@ -28,6 +28,11 @@ from sentinel_tpu.datasource.converters import (
     json_rule_converter,
     json_rule_encoder,
 )
+from sentinel_tpu.datasource.redis import (
+    RedisConnection,
+    RedisDataSource,
+    RespError,
+)
 from sentinel_tpu.datasource.remote import CallbackDataSource, HttpDataSource
 
 __all__ = [
@@ -47,4 +52,7 @@ __all__ = [
     "Converter",
     "json_rule_converter",
     "json_rule_encoder",
+    "RedisConnection",
+    "RedisDataSource",
+    "RespError",
 ]

@@ -1353,6 +1353,13 @@ class ClientStats:
             return self._sketch_stats([rid])[0]
         return self._row_stats(rid)
 
+    def origin(self, resource: str, origin: str) -> Optional[Dict[str, float]]:
+        """Per-(resource, caller) stats — the ClusterNode.getOriginNode
+        read (ClusterBuilderSlot origin rows).  None until that caller has
+        been seen (the row is created on first entry with the origin)."""
+        row = self._c.registry.origin_row_if_exists(resource, origin)
+        return None if row is None else self._row_stats(row)
+
     def _sketch_stats(self, rids, now_ms: Optional[int] = None) -> list:
         """Windowed CMS estimates for sketch-id resources (ops/gsketch.py);
         pass/block are small overestimates bounded by the sketch (eps,delta)."""

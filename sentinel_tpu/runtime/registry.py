@@ -156,6 +156,13 @@ class Registry:
     def origin_node_row(self, resource: str, origin: str) -> int:
         return self.extra_row("origin", f"{resource}\x00{origin}")
 
+    def origin_row_if_exists(self, resource: str, origin: str) -> Optional[int]:
+        """Non-creating lookup of an origin stat row (None until that
+        caller has been seen) — the single place the key encoding lives
+        besides origin_node_row."""
+        row = self._extra_rows.get(("origin", f"{resource}\x00{origin}"))
+        return None if row is None or row == self.cfg.trash_row else row
+
     def ctx_node_row(self, resource: str, ctx: str) -> int:
         return self.extra_row("ctx", f"{resource}\x00{ctx}")
 
