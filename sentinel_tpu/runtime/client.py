@@ -95,6 +95,8 @@ class Entry:
         "param_hash",
         "_errors",
         "_exited",
+        "slots",
+        "slot_ctx",
     )
 
     def __init__(self, client, resource, res, origin_node, ctx_node, inbound, count, create_ms, wait_ms=0, param_hash=()):
@@ -110,6 +112,8 @@ class Entry:
         self.param_hash = param_hash
         self._errors = 0
         self._exited = False
+        self.slots = ()  # entered custom slots (runtime/slots.py)
+        self.slot_ctx = None
 
     def trace(self, exc: Optional[BaseException] = None, count: int = 1) -> None:
         if exc is not None and isinstance(exc, ERR.BlockException):
@@ -141,6 +145,13 @@ class Entry:
                 param_hash=self.param_hash,
             )
         )
+        if self.slots:
+            from sentinel_tpu.runtime.slots import run_exit
+
+            self.slot_ctx.rt_ms = rt
+            self.slot_ctx.success = n
+            self.slot_ctx.errors = self._errors
+            run_exit(self.slots, self.slot_ctx)
 
     def __enter__(self):
         return self
@@ -227,11 +238,16 @@ class SentinelClient:
         # when off, every entry is a pass-through and nothing is counted
         self.enabled = True
 
-        # custom entry hooks — the custom-ProcessorSlot SPI analog
-        # (sentinel-demo-slot-chain-spi): each hook sees (resource, origin,
-        # args) before the engine check and may raise a BlockException to
-        # reject; exit-side extension points are the metrics SPI
+        # custom entry hooks — the lightweight pre-check form: each hook
+        # sees (resource, origin, args) before the engine check and may
+        # raise a BlockException to reject
         self.entry_hooks: List[Any] = []
+        # full custom-slot SPI (ProcessorSlot analog, runtime/slots.py):
+        # ordered slots with entry AND exit hooks; register via
+        # client.slots.register(slot)
+        from sentinel_tpu.runtime.slots import SlotChain
+
+        self.slots = SlotChain()
 
         self.registry = Registry(self.cfg)
         self.flow_rules = RuleManager(self, "flow")
@@ -672,6 +688,28 @@ class SentinelClient:
                 CTX.push_entry(e)
             return e  # capacity overflow → pass-through (CtSph.java:200)
 
+        # ordered custom slots (runtime/slots.py): entry side here; the
+        # exit side unwinds on Entry.exit OR on rejection below.  Pass-
+        # through entries above skip custom slots entirely — the analog of
+        # lookProcessChain returning null (no chain runs at all).
+        slot_ctx = None
+        entered_slots: list = []
+        slot_list = self.slots.snapshot()
+        if slot_list and hook_exc is None:
+            from sentinel_tpu.runtime.slots import SlotContext, run_entry
+
+            slot_ctx = SlotContext(
+                resource=resource,
+                origin=origin or "",
+                args=args,
+                count=count,
+                prioritized=prioritized,
+                inbound=inbound,
+            )
+            entered_slots, slot_exc = run_entry(slot_list, slot_ctx)
+            if slot_exc is not None:
+                hook_exc = slot_exc
+
         origin_id = self.registry.origin_id(origin) if origin else -1
         origin_node = (
             self.registry.origin_node_row(resource, origin)
@@ -746,6 +784,11 @@ class SentinelClient:
                     self.time.wall_ms(), resource, type(exc).__name__, origin or "", count
                 )
             MEXT.safe_dispatch("on_block", resource, count, origin or "", exc, args)
+            if entered_slots:
+                from sentinel_tpu.runtime.slots import run_exit
+
+                slot_ctx.block_exception = exc
+                run_exit(entered_slots, slot_ctx)
             raise exc
         if verdict == ERR.PASS_WAIT and wait_ms > 0:
             self.time.sleep_ms(wait_ms)
@@ -763,6 +806,8 @@ class SentinelClient:
             wait_ms,
             tuple(param_hashes),
         )
+        e.slots = entered_slots
+        e.slot_ctx = slot_ctx
         if _push_ctx:
             CTX.push_entry(e)
         return e
