@@ -144,8 +144,7 @@ def main():
     slots_f = T.big_gather(cfg, f.res_rules, res_l, cfg.max_resources + 1, max_int=cfg.max_flow_rules).reshape(-1)
     packed13 = T.pack_fields([f.enabled, f.limit_app, f.strategy, f.ref_node, f.ref_ctx,
                               f.grade, f.count, f.behavior, f.max_queue_ms,
-                              f.warning_token, f.slope, state.warmup_tokens,
-                              state.occ_tokens])
+                              f.warning_token, f.slope, state.warmup_tokens])
     bench("flow: fields small_gather", lambda i: T.small_gather_fields(cfg, packed13 + i, slots_f))
     bench("flow: latest small_gather_int", lambda i: T.small_gather_int(cfg, jnp.round(state.latest_passed_ms).astype(jnp.int32) + i, slots_f))
     cntf = jnp.ones((slots_f.shape[0],), jnp.float32)

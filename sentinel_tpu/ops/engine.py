@@ -102,9 +102,11 @@ class EngineState(NamedTuple):
     warm_acc: jax.Array  # float32 [F+1]
     # prioritized occupy-ahead (OccupiableBucketLeapArray / tryOccupyNext):
     # tokens borrowed against window epoch occ_epoch, folded into that
-    # window's pass counts when it becomes current
-    occ_tokens: jax.Array  # float32 [F+1]
-    occ_epoch: jax.Array  # int32 [F+1]
+    # window's pass counts when it becomes current.  Keyed by NODE row
+    # (the FutureBucket lives on the node), so RELATE/CHAIN/origin-metered
+    # rules borrow like DIRECT ones
+    occ_tokens: jax.Array  # float32 [node_rows]
+    occ_epoch: jax.Array  # int32 [node_rows]
     # per degrade-rule circuit breaker
     cb_state: jax.Array  # int32 [D+1]
     cb_retry_ms: jax.Array  # int32 [D+1]
@@ -186,8 +188,8 @@ def init_state(cfg: EngineConfig) -> EngineState:
         warmup_tokens=jnp.zeros((F + 1,), dtype=jnp.float32),
         warmup_last_s=jnp.full((F + 1,), -1, dtype=jnp.int32),
         warm_acc=jnp.zeros((F + 1,), dtype=jnp.float32),
-        occ_tokens=jnp.zeros((F + 1,), dtype=jnp.float32),
-        occ_epoch=jnp.full((F + 1,), -1, dtype=jnp.int32),
+        occ_tokens=jnp.zeros((rows,), dtype=jnp.float32),
+        occ_epoch=jnp.full((rows,), -1, dtype=jnp.int32),
         cb_state=jnp.zeros((Dn + 1,), dtype=jnp.int32),
         cb_retry_ms=jnp.zeros((Dn + 1,), dtype=jnp.int32),
         cb_counts=jnp.zeros((Dn + 1, cfg.cb_sample_count, 3), dtype=jnp.int32),
@@ -1046,12 +1048,6 @@ def _acquire_effects_fused(
             planes.append(jnp.where(adm, cnt_f, 0))
             digits.append(cd)
             slot_planes.append("warm")
-        if occ_grant is not None:
-            grant_lane, oslots, ocnt = occ_grant
-            commit = grant_lane & _fan(occupying, K)
-            planes.append(jnp.where(commit, jnp.round(ocnt).astype(jnp.int32), 0))
-            digits.append(cd)
-            slot_planes.append("occ")
         if rl_info is not None:
             rl_ok, cost = rl_info
             # costs are whole ms (RateLimiter rounds); values beyond the
@@ -1065,6 +1061,26 @@ def _acquire_effects_fused(
             vals_f = jnp.stack(planes).reshape(len(planes), b, K).transpose(2, 0, 1)
             jobs.append(FU.Job("fslots", F, rows_f, vals_f, tuple(digits)))
             n_flow_jobs = 1
+
+    # --- occupy booking: node-keyed (the grant's metered node row) --------
+    n_occ_jobs = 0
+    if occ_grant is not None:
+        K = cfg.flow_rules_per_resource
+        grant_lane, onodes, ocnt = occ_grant
+        commit = grant_lane & _fan(occupying, K)
+        occ_rows = jnp.where(commit & (onodes < cfg.max_nodes), onodes, -1)
+        jobs.append(
+            FU.Job(
+                "occ",
+                cfg.max_nodes,
+                occ_rows.reshape(b, K).T,
+                jnp.where(commit, jnp.round(ocnt).astype(jnp.int32), 0)
+                .reshape(b, K)
+                .T[:, None, :],
+                (cd,),
+            )
+        )
+        n_occ_jobs = 1
 
     # --- param pass + THREAD concurrency (values masked, rows shared) -----
     if param_ctx is not None:
@@ -1104,6 +1120,10 @@ def _acquire_effects_fused(
     f_out = None
     if n_flow_jobs:
         f_out = outs[oi]
+        oi += 1
+    occ_out = None
+    if n_occ_jobs:
+        occ_out = outs[oi]  # [max_nodes, 1]
         oi += 1
     p_out = None
     if param_ctx is not None:
@@ -1150,17 +1170,6 @@ def _acquire_effects_fused(
             acc_add = jnp.concatenate([f_out[:, pi], pad1])
             state = state._replace(warm_acc=state.warm_acc + acc_add)
             pi += 1
-        if "occ" in slot_planes:
-            add = jnp.concatenate([f_out[:, pi], pad1])
-            cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
-            pool_vec = jnp.where(
-                state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0
-            )
-            state = state._replace(
-                occ_tokens=pool_vec + add,
-                occ_epoch=jnp.where(add > 0, cur_wid + 1, state.occ_epoch),
-            )
-            pi += 1
         if "latest" in slot_planes:
             T_s = jnp.concatenate([f_out[:, pi], pad1])
             n_s = jnp.concatenate([f_out[:, pi + 1], pad1])
@@ -1169,6 +1178,20 @@ def _acquire_effects_fused(
                     state.latest_passed_ms, T_s, n_s, now_ms
                 )
             )
+
+    if occ_out is not None:
+        add = jnp.concatenate(
+            [
+                occ_out[:, 0],
+                jnp.zeros((cfg.node_rows - cfg.max_nodes,), jnp.float32),
+            ]
+        )
+        cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+        pool_vec = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
+        state = state._replace(
+            occ_tokens=pool_vec + add,
+            occ_epoch=jnp.where(add > 0, cur_wid + 1, state.occ_epoch),
+        )
 
     if param_ctx is not None:
         upd = jnp.round(p_out).astype(jnp.int32)  # [depth, Q, 2]
@@ -1340,11 +1363,15 @@ def _check_param(
     return blocked, pcms, pcms_epochs, cur_idx, prows, qps_add, thread_add
 
 
-def _fold_occupied(cfg: EngineConfig, state: EngineState, rules: RuleSet, now_ms):
+def _fold_occupied(cfg: EngineConfig, state: EngineState, now_ms):
     """Borrowed-ahead tokens whose target bucket has arrived land as
-    PASS + OCCUPIED_PASS in the current column of their rule's node —
-    the batched form of FutureBucketLeapArray's buckets becoming current
-    (occupy/OccupiableBucketLeapArray.java:29-43)."""
+    PASS in the current column of their NODE row — the batched form of
+    FutureBucketLeapArray's buckets becoming current
+    (occupy/OccupiableBucketLeapArray.java:29-43).
+
+    The occupy state is keyed by node row, so the fold is a pure
+    elementwise land: no histogram, no rule lookup — RELATE/CHAIN/origin-
+    metered grants fold exactly like DIRECT ones."""
     cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
     due = (state.occ_epoch <= cur_wid) & (state.occ_tokens > 0)
     # debt whose target bucket already rolled OUT of the sliding window
@@ -1355,13 +1382,10 @@ def _fold_occupied(cfg: EngineConfig, state: EngineState, rules: RuleSet, now_ms
     any_due = jnp.any(due)
 
     def fold(s):
-        # occupy grants are restricted to LIMIT_ANY/DIRECT rules, whose
-        # metered node is statically the rule's resource row; OCCUPIED was
-        # already counted once at grant time — only the deferred PASS lands
-        nodes = jnp.asarray(rules.flow.res)  # [F+1] — each rule's node row
-        hist = T.histogram(cfg, nodes, tok, cfg.node_rows)  # [rows]
+        # OCCUPIED was already counted once at grant time — only the
+        # deferred PASS lands now
         delta = jnp.zeros((cfg.node_rows, W.NUM_EVENTS), jnp.int32)
-        delta = delta.at[:, W.EV_PASS].set(hist)
+        delta = delta.at[:, W.EV_PASS].set(tok)
         sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
         win_sec = W.add_dense(s.win_sec, now_ms, delta, None, sec_cfg)
         win_min = s.win_min
@@ -1466,14 +1490,6 @@ def _check_flow(
                 f.warning_token,  # 9
                 f.slope,  # 10
                 state.warmup_tokens,  # 11
-                # 12: per-slot borrow pool already booked against the next
-                # bucket (computed dense below, exact int compares)
-                jnp.where(
-                    state.occ_epoch
-                    == (now_ms // cfg.second_window_ms).astype(jnp.int32) + 1,
-                    state.occ_tokens,
-                    0.0,
-                ),
             ]
         ),
         slots_f,
@@ -1549,15 +1565,25 @@ def _check_flow(
         cfg.node_rows + cfg.max_flow_rules + 1,
     )
 
+    # occupy borrow pool already booked against the NEXT bucket, keyed by
+    # node row (the reference's FutureBucket lives on the node, so RELATE/
+    # CHAIN/origin-metered rules can borrow too — the deferred PASS lands
+    # on whatever row the grant recorded)
+    cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+    pool_dense = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
     if cfg.use_mxu_tables:
         # dense per-row windowed pass totals once (elementwise over the
-        # window tensor), then ONE one-hot gather for (pass, concurrency)
+        # window tensor), then ONE one-hot gather for (pass, concurrency,
+        # borrow pool)
         wsum = W.window_event(state.win_sec, now_ms, sec_cfg, W.EV_PASS)
-        tab = jnp.stack([wsum, state.concurrency], axis=1)
+        tab = jnp.stack(
+            [wsum, state.concurrency, jnp.round(pool_dense).astype(jnp.int32)],
+            axis=1,
+        )
         if _use_fused(cfg):
             cap = jnp.int32((1 << 24) - 1)
             (both,) = FU.gather_many(
-                [FU.GatherJob("wsum", node_safe, jnp.minimum(tab, cap), (3, 3))]
+                [FU.GatherJob("wsum", node_safe, jnp.minimum(tab, cap), (3, 3, 3))]
             )
         else:
             both = T.big_gather(
@@ -1569,10 +1595,12 @@ def _check_flow(
             )
         wp = both[:, 0].astype(jnp.float32)
         conc = both[:, 1].astype(jnp.float32)
+        pool = both[:, 2].astype(jnp.float32)
     else:
         wp = W.gather_window_event(state.win_sec, now_ms, node_safe, sec_cfg, W.EV_PASS)
         wp = wp.astype(jnp.float32)
         conc = state.concurrency[node_safe].astype(jnp.float32)
+        pool = pool_dense[node_safe]
 
     # DefaultController.canPass:31-49
     thr_eff = jnp.where(is_warm, warm_qps, rcount)
@@ -1604,26 +1632,31 @@ def _check_flow(
     occ_wait = jnp.zeros((b,), jnp.float32)
     occ_grant = None
     if occupy:
-        pool = fg[:, 12]
-        # only rules whose metered node is statically their own resource
-        # row can borrow ahead — the fold knows where to land the deferred
-        # PASS (LIMIT_ANY + DIRECT; origin/relate/chain meter other nodes)
+        # any DEFAULT/QPS rule can borrow ahead regardless of strategy or
+        # limitApp: the grant records its metered NODE row, and the fold
+        # lands the deferred PASS there (FutureBucketLeapArray lives on
+        # the node in the reference too — tryOccupyNext on the selected
+        # node, DefaultController.java:49-68)
         cand = (
             (_fan(acq.prio, K) > 0)
             & (behavior == CONTROL_DEFAULT)
             & (grade == GRADE_QPS)
-            & (la == RT.LIMIT_ANY)
-            & (strategy == STRATEGY_DIRECT)
             & applicable
             & elig_f
             & qps_block
         )
 
         # the occupy rank pass only runs when the batch carries prioritized
-        # items at all (lax.cond skips ~1.2 ms of rank work for the common
-        # all-normal batch)
+        # items at all (lax.cond skips the rank work for the common
+        # all-normal batch); contention is per NODE bucket.  Keying by node
+        # means a second rule watching the same node sees the first rule's
+        # pending borrow — exactly the reference, where tryOccupyNext
+        # checks the node's currentWaiting against each rule's own count
+        # (DefaultController.java:49-68).  Note the key space is node_rows,
+        # so large configs take the sort-based rank here (prioritized
+        # batches only).
         def _occ_rank(cand):
-            (rank_occ,) = _rank(cfg, slots_f, [cnt], cand, cfg.max_flow_rules + 1)
+            (rank_occ,) = _rank(cfg, node_safe, [cnt], cand, cfg.node_rows)
             return cand & (pool + rank_occ + cnt <= rcount)  # maxOccupyRatio=1
 
         granted = jax.lax.cond(
@@ -1643,10 +1676,14 @@ def _check_flow(
         # booking is deferred to the tick (after degrade): a later stage may
         # still block the item, and its grant must not be committed.  Book
         # ONE lane per item (first granted) — one request borrows once even
-        # when several rules on the node granted it.
+        # when several rules on the node granted it.  (Deliberate
+        # divergence: the reference books addOccupiedPass once per GRANTING
+        # RULE, so one request with two same-node rules charges the future
+        # bucket twice and folds two passes for one real request; charging
+        # once keeps the folded pass count equal to admitted traffic.)
         grant_mtx = (granted & elig_f).reshape(b, K)
         first_lane = grant_mtx & (jnp.cumsum(grant_mtx, axis=1) == 1)
-        occ_grant = (first_lane.reshape(-1), slots_f, cnt)
+        occ_grant = (first_lane.reshape(-1), node_safe, cnt)
 
     # pacing delay for admitted rate-limited entries
     rl_ok = is_rl & applicable & ~entry_block & elig_f & ~_fan(blocked, K)
@@ -1874,7 +1911,7 @@ def tick(
     if "warmup" in features:
         state = _sync_warmup(cfg, state, rules, now_ms)
     if "occupy" in features and "flow" in features:
-        state = _fold_occupied(cfg, state, rules, now_ms)
+        state = _fold_occupied(cfg, state, now_ms)
 
     valid = acq.res != cfg.trash_row
     forced = valid & (acq.pre_verdict > 0)
@@ -1955,16 +1992,17 @@ def tick(
     occupying = occupying & passed
     fused = _use_fused(cfg)
     if occ_grant is not None and not fused:
-        grant_lane, oslots, ocnt = occ_grant
+        grant_lane, onodes, ocnt = occ_grant
         b_k = grant_lane.shape[0] // b
-        item_g = jnp.repeat(jnp.arange(b), b_k)
         commit = grant_lane & _fan(occupying, b_k)
-        add = T.small_scatter_add(
+        # node-keyed booking (FutureBucket lives on the node): one
+        # histogram over the node table
+        add = T.histogram(
             cfg,
-            jnp.zeros((cfg.max_flow_rules + 1,), jnp.float32),
-            jnp.where(commit, oslots, jnp.int32(-1)),
-            jnp.where(commit, ocnt, 0.0),
-        )
+            jnp.where(commit, onodes, jnp.int32(-1)),
+            jnp.where(commit, jnp.round(ocnt).astype(jnp.int32), 0),
+            cfg.node_rows,
+        ).astype(jnp.float32)
         cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
         pool_vec = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
         state = state._replace(

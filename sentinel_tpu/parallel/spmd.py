@@ -58,8 +58,8 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
         warmup_tokens=rep,
         warmup_last_s=rep,
         warm_acc=rep,
-        occ_tokens=rep,
-        occ_epoch=rep,
+        occ_tokens=row,  # node-keyed borrow pools shard with their rows
+        occ_epoch=row,
         cb_state=rep,
         cb_retry_ms=rep,
         cb_counts=rep,

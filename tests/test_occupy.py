@@ -174,3 +174,63 @@ def test_normal_requests_never_occupy(c, vt):
         c.entry("norm")
     s = c.stats.resource("norm")
     assert s["occupiedPassQps"] == 0
+
+
+def test_relate_rule_occupies_ref_node(c, vt):
+    """RELATE rules can borrow ahead (VERDICT r2 #9): the grant records the
+    METERED node (the referenced resource's row), the deferred PASS folds
+    there, and the next bucket's budget shrinks by the borrow."""
+    c.flow_rules.load(
+        [
+            st.FlowRule(
+                resource="write",
+                count=2,
+                strategy=st.STRATEGY_RELATE,
+                ref_resource="read",
+            )
+        ]
+    )
+    # the metered node is "read": fill its budget with real read traffic
+    assert _fill(c, vt, "read", 2) == 2
+    with pytest.raises(st.FlowException):
+        c.entry("write")  # read's window is full
+    # prioritized borrow waits into the next bucket and enters
+    t0 = c.time.now_ms()
+    e = c.entry("write", prioritized=True)
+    waited = c.time.now_ms() - t0
+    assert 0 < waited <= c.cfg.second_window_ms
+    e.exit()
+    # slide past the original reads' bucket: the window then holds only the
+    # bucket the borrow folded into
+    vt.advance(c.cfg.second_window_ms + 10)
+    # the deferred PASS folded onto the REF node's row ("read"), matching
+    # where the rule meters — the borrow consumed the new bucket's budget
+    s_read = c.stats.resource("read")
+    assert s_read["passQps"] == 1
+    with c.entry("read"):  # unruled: counts on read's node (now 2/2)
+        pass
+    with pytest.raises(st.FlowException):
+        c.entry("write")
+
+
+def test_chain_rule_occupies_ctx_node(c, vt):
+    """CHAIN rules borrow against their (resource, context) node."""
+    c.flow_rules.load(
+        [
+            st.FlowRule(
+                resource="task",
+                count=1,
+                strategy=st.STRATEGY_CHAIN,
+                ref_resource="ctx-a",
+            )
+        ]
+    )
+    with c.context("ctx-a"):
+        with c.entry("task"):
+            pass
+        with pytest.raises(st.FlowException):
+            c.entry("task")
+        e = c.entry("task", prioritized=True)
+        e.exit()
+    s = c.stats.resource("task")
+    assert s["occupiedPassQps"] == 1
