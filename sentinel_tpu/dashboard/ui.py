@@ -38,7 +38,10 @@ PAGE = r"""<!doctype html>
 
 <h2>qps <span class="muted" id="resname"></span></h2>
 <canvas id="chart" width="860" height="220"></canvas>
-<div class="muted">green: pass/s &nbsp; red: block/s &nbsp; (trailing 5 min, 1 s points)</div>
+<div class="muted">green: pass/s &nbsp; red: block/s &nbsp; blue (right axis): avg rt ms &nbsp; (trailing 5 min, 1 s points)</div>
+
+<h2>top resources <span class="muted">(last second)</span></h2>
+<table id="top"><tr><th>resource</th><th>pass/s</th><th>block/s</th><th>avg rt</th><th>threads</th></tr></table>
 
 <h2>flow rules <span class="muted">(first healthy machine)</span></h2>
 <table id="rules"><tr><th>resource</th><th>count</th><th>grade</th><th>behavior</th><th>limitApp</th></tr></table>
@@ -111,14 +114,37 @@ async function refreshChart() {
     ctx.beginPath(); ctx.moveTo(35, y); ctx.lineTo(c.width - 5, y); ctx.stroke();
     ctx.fillText(v.toFixed(0), 2, y + 4);
   }
-  const line = (key, color) => {
+  const line = (key, color, yf) => {
     ctx.strokeStyle = color; ctx.lineWidth = 1.5; ctx.beginPath();
-    series.forEach((p, i) => i ? ctx.lineTo(X(p.timestamp), Y(p[key]))
-                               : ctx.moveTo(X(p.timestamp), Y(p[key])));
+    series.forEach((p, i) => i ? ctx.lineTo(X(p.timestamp), yf(p[key]))
+                               : ctx.moveTo(X(p.timestamp), yf(p[key])));
     ctx.stroke();
   };
-  line("pass_qps", "#2a2");
-  line("block_qps", "#c33");
+  line("pass_qps", "#2a2", Y);
+  line("block_qps", "#c33", Y);
+  // avg RT on its own right-hand scale (the reference chart's second axis)
+  const rmax = Math.max(1, ...series.map(p => p.rt)) * 1.15;
+  const Yr = v => c.height - 18 - v / rmax * (c.height - 30);
+  ctx.fillStyle = "#36c";
+  ctx.fillText(rmax.toFixed(0) + "ms", c.width - 38, 12);
+  line("rt", "#36c", Yr);
+}
+
+async function refreshTop() {
+  const app = $("app").value;
+  if (!app) return;
+  const names = await j(`/metric/top?app=${encodeURIComponent(app)}&limit=12`);
+  const since = Date.now() - 3000;
+  const t = $("top");
+  t.innerHTML = "<tr><th>resource</th><th>pass/s</th><th>block/s</th><th>avg rt</th><th>threads</th></tr>";
+  for (const name of names) {
+    const pts = await j(`/metric?app=${encodeURIComponent(app)}&identity=${encodeURIComponent(name)}&startTime=${since}`);
+    const p = pts.length ? pts[pts.length - 1] : null;
+    const row = t.insertRow();
+    row.innerHTML = `<td>${esc(name)}</td><td>${p ? esc(p.pass_qps) : "-"}</td>` +
+      `<td>${p ? esc(p.block_qps) : "-"}</td><td>${p ? esc(p.rt.toFixed(1)) : "-"}</td>` +
+      `<td>${p ? esc(p.concurrency) : "-"}</td>`;
+  }
 }
 
 async function refreshRules() {
@@ -171,6 +197,7 @@ async function tick() {
     await refreshApps();
     await refreshResources();
     await refreshChart();
+    await refreshTop();
     await refreshRules();
     await refreshAssign();
     $("err").textContent = "";
