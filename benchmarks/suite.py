@@ -251,7 +251,7 @@ def bench_degrade_100k() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0, native_front: bool = False, procs: int = 1) -> dict:
+def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0, native_front: bool = False, procs: int = 1, shards: int = 1) -> dict:
     _force_cpu()
     import asyncio
     import struct
@@ -289,13 +289,20 @@ def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0, native_fron
         ],
     )
     door = None
+    doors = []
     if native_front:
         from sentinel_tpu.cluster.front_door import NativeFrontDoor
 
-        door = NativeFrontDoor(port=0)
-        door.follow(svc)
-        decision.attach_front_door(door)
-        door.start()
+        # SO_REUSEPORT sharding: N io threads on one port (the multi-core
+        # scaling axis; on a 1-core host the curve documents the ceiling)
+        doors = [NativeFrontDoor(port=0, reuseport=shards > 1)]
+        for _ in range(shards - 1):
+            doors.append(NativeFrontDoor(port=doors[0].port, reuseport=True))
+        for d in doors:
+            d.follow(svc)
+            decision.attach_front_door(d)
+            d.start()
+        door = doors[0]
         port = door.port
         server = None
     else:
@@ -334,11 +341,11 @@ def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0, native_fron
         wall = active  # interpreter/jax startup excluded
         if server is not None:
             server.stop()
-        if door is not None:
-            door.stop()
+        for d in doors:
+            d.stop()
         decision.stop()
-        if door is not None:
-            door.close()
+        for d in doors:
+            d.close()
         total = sum(agg.values())
         qps = total / wall if wall > 0 else 0.0
         return {
@@ -353,6 +360,7 @@ def bench_cluster_4096(n_nodes: int = 4096, duration_s: float = 8.0, native_fron
             "errors": agg["other"],
             "engine_backend": "cpu",
             "front_door": "native-epoll" if native_front else "asyncio",
+            "io_shards": shards if native_front else 1,
             "config": "#5 simulated cluster (4096 TCP nodes -> one token server)",
         }
 
@@ -518,6 +526,8 @@ def main():
     ap.add_argument("--duration", type=float, default=8.0)
     ap.add_argument("--native-front", action="store_true",
                     help="config #5: native epoll front door instead of asyncio")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="config #5: SO_REUSEPORT io shards for the native door")
     args = ap.parse_args()
     if args.config == "_client5":
         _client5(args.port, args.nodes, args.duration)
@@ -551,7 +561,7 @@ def main():
     fn = BENCHES[k]
     if k == "5":
         r = fn(n_nodes=args.nodes, duration_s=args.duration,
-               native_front=args.native_front, procs=args.procs)
+               native_front=args.native_front, procs=args.procs, shards=args.shards)
     else:
         r = fn()
     print(json.dumps(r), flush=True)

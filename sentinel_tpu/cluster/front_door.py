@@ -13,8 +13,12 @@ Python executes once per TICK: the SentinelClient's tick loop drains the
 door's acquire ring straight into engine batch lanes and answers through
 ``respond`` — no Python objects, no futures, no per-request code.
 
-Protocol subset: PING and MSG_TYPE_FLOW (the hot path).  Param/concurrent
-types stay on the asyncio server, which binds its own port.
+Protocol: PING, MSG_TYPE_FLOW, MSG_TYPE_PARAM_FLOW (values hashed in C
+with hash_param parity; doubles answer STATUS_FAIL) and CONCURRENT
+acquire/release (TTL token table on the host, batched per tick) — every
+token type on ONE port, the TokenServerHandler.java:61-75 dispatch map.
+SO_REUSEPORT sharding (``shards=N``) runs N io threads on the same port
+for multi-core hosts.
 
 Reference analog: the Netty pipeline + TokenServerHandler
 (NettyTransportServer.java:88-93, TokenServerHandler.java:61-75) — the
@@ -30,7 +34,7 @@ from typing import Optional
 import numpy as np
 
 from sentinel_tpu.cluster import constants as C
-from sentinel_tpu.cluster.rules import flow_resource
+from sentinel_tpu.cluster.rules import flow_resource, param_resource
 from sentinel_tpu.core import errors as ERR
 from sentinel_tpu.native.loader import load_native
 
@@ -50,16 +54,20 @@ class NativeFrontDoor:
         pending: int = 1 << 16,
         fmap_pow2: int = 1 << 12,
         max_qps: Optional[float] = None,
+        reuseport: bool = False,
     ):
         self._lib = load_native()
         if self._lib is None:
             raise RuntimeError("native library unavailable — front door needs C")
-        self._f = self._lib.sx_front_new(port, ring_pow2, pending, fmap_pow2)
+        self._f = self._lib.sx_front_new(
+            port, ring_pow2, pending, fmap_pow2, 1 if reuseport else 0
+        )
         if not self._f:
             raise RuntimeError("sx_front_new failed (bind error?)")
         if max_qps is not None:
             self._lib.sx_front_set_guard(self._f, int(max_qps))
         self._started = False
+        self._service = None  # set by follow(); serves concurrent tokens
         # tick-side drain buffers (single consumer — the tick thread)
         self._buf_n = 0
         self._bufs = None
@@ -91,9 +99,14 @@ class NativeFrontDoor:
     def map_flow(self, flow_id: int, row: int) -> None:
         self._lib.sx_front_map_flow(self._f, int(flow_id), int(row))
 
+    def map_param(self, flow_id: int, row: int, lane: int = 0) -> None:
+        self._lib.sx_front_map_param(self._f, int(flow_id), int(row), int(lane))
+
     def follow(self, service) -> None:
-        """Track a DefaultTokenService's cluster flow rules: whenever they
-        (re)load, refresh the id → engine-row map."""
+        """Track a DefaultTokenService's cluster flow AND param rules:
+        whenever either (re)loads, refresh the id → engine-row maps.  Also
+        binds the service for host-managed CONCURRENT tokens."""
+        self._service = service
 
         def _sync(*_a) -> None:
             reg = service.client.registry
@@ -105,8 +118,19 @@ class NativeFrontDoor:
                 row = reg.resource_id(flow_resource(fid))
                 if row is not None:
                     self.map_flow(fid, row)
+            for fid in service.param_rules.all_ids():
+                name = param_resource(fid)
+                row = reg.resource_id(name)
+                if row is None:
+                    continue
+                lanes = service.client._param_lanes_by_res.get(name) or [0]
+                # the decision rule's param_idx is 0; its hash lane is
+                # wherever the compile assigned idx 0
+                lane = lanes.index(0) if 0 in lanes else 0
+                self.map_param(fid, row, lane)
 
         service.flow_rules.add_listener(_sync)
+        service.param_rules.add_listener(_sync)
         _sync()
 
     # -- tick-side API -------------------------------------------------------
@@ -116,18 +140,50 @@ class NativeFrontDoor:
         return int(self._lib.sx_front_acq_backlog(self._f))
 
     def drain(self, max_n: int):
-        """(row, count, prio, corr) int32 arrays of length n <= max_n.
+        """(row, count, prio, corr, kind, a0, a1) int32 arrays of length
+        n <= max_n.  kind = wire MSG_TYPE: 1 flow, 2 param (a0/a1 = hash
+        lanes), 3/4 concurrent acquire/release (a0/a1 = 64-bit id halves).
         Buffers are preallocated once (single consumer: the tick thread);
         callers must consume the views before the next drain."""
         if self._bufs is None or self._buf_n < max_n:
-            self._bufs = tuple(np.empty(max_n, np.int32) for _ in range(4))
+            self._bufs = tuple(np.empty(max_n, np.int32) for _ in range(7))
             self._buf_n = max_n
-        row, cnt, prio, corr = self._bufs
+        row, cnt, prio, corr, kind, a0, a1 = self._bufs
         cp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
-        n = self._lib.sx_front_drain_acquires(
-            self._f, max_n, cp(row), cp(cnt), cp(prio), cp(corr)
+        n = self._lib.sx_front_drain_acquires2(
+            self._f, max_n, cp(row), cp(cnt), cp(prio), cp(corr), cp(kind),
+            cp(a0), cp(a1)
         )
-        return row[:n], cnt[:n], prio[:n], corr[:n]
+        return row[:n], cnt[:n], prio[:n], corr[:n], kind[:n], a0[:n], a1[:n]
+
+    def handle_host_events(self, kind, cnt, corr, a0, a1) -> None:
+        """Serve CONCURRENT acquire/release events against the followed
+        service's token manager and answer through the typed respond path.
+        Per-event host work is a dict op (~us) — concurrent-mode traffic is
+        orders below flow traffic (reference: TokenCacheNodeManager)."""
+        svc = self._service
+        n = len(kind)
+        status = np.empty(n, np.int32)
+        tok_hi = np.zeros(n, np.int32)
+        tok_lo = np.zeros(n, np.int32)
+        for i in range(n):
+            ident = (int(np.uint32(a0[i])) << 32) | int(np.uint32(a1[i]))
+            if svc is None:
+                status[i] = C.STATUS_FAIL
+            elif kind[i] == C.MSG_TYPE_CONCURRENT_ACQUIRE:
+                r = svc.request_concurrent_token(ident, int(cnt[i]))
+                status[i] = r.status
+                tok_hi[i] = np.uint32((r.token_id >> 32) & 0xFFFFFFFF).astype(np.int32)
+                tok_lo[i] = np.uint32(r.token_id & 0xFFFFFFFF).astype(np.int32)
+            else:
+                r = svc.release_concurrent_token(ident)
+                status[i] = r.status
+        corr = np.ascontiguousarray(corr, np.int32)
+        waits = np.zeros(n, np.int32)
+        cp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        self._lib.sx_front_respond_ex(
+            self._f, n, cp(corr), cp(status), cp(waits), cp(tok_hi), cp(tok_lo)
+        )
 
     def respond(self, corr: np.ndarray, verdicts: np.ndarray, waits: np.ndarray) -> None:
         """Answer drained acquires: engine verdicts map to wire statuses."""

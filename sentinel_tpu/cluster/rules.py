@@ -171,6 +171,12 @@ class ClusterParamFlowRuleManager:
         self._by_id: Dict[int, R.ParamFlowRule] = {}
         self._ns_by_id: Dict[int, str] = {}
         self._on_change = on_change
+        self._listeners: List[Callable[[], None]] = []
+
+    def add_listener(self, fn: Callable[[], None]) -> None:
+        """Fires after every load, AFTER the primary on_change (so engine
+        recompilation precedes dependents like the front door's id map)."""
+        self._listeners.append(fn)
 
     def load(self, namespace: str, rules: List[R.ParamFlowRule]) -> None:
         rules = [r for r in rules if r.cluster_mode and r.cluster_flow_id > 0]
@@ -186,6 +192,8 @@ class ClusterParamFlowRuleManager:
                 self._ns_by_id[r.cluster_flow_id] = namespace
         if self._on_change:
             self._on_change()
+        for fn in list(self._listeners):
+            fn()
 
     def get_by_id(self, flow_id: int) -> Optional[R.ParamFlowRule]:
         return self._by_id.get(flow_id)

@@ -87,12 +87,13 @@ async function refreshApps() {
 
 async function refreshResources() {
   const app = $("app").value;
-  if (!app) return;
-  const top = await j(`/metric/top?app=${encodeURIComponent(app)}`);
+  if (!app) return [];
+  const top = await j(`/metric/top?app=${encodeURIComponent(app)}&limit=12`);
   const sel = $("res"), cur = sel.value;
   sel.innerHTML = "";
   top.forEach(r => sel.add(new Option(r, r)));
   if (cur && top.includes(cur)) sel.value = cur;
+  return top;
 }
 
 async function refreshChart() {
@@ -130,16 +131,18 @@ async function refreshChart() {
   line("rt", "#36c", Yr);
 }
 
-async function refreshTop() {
+async function refreshTop(names) {
   const app = $("app").value;
-  if (!app) return;
-  const names = await j(`/metric/top?app=${encodeURIComponent(app)}&limit=12`);
+  if (!app || !names) return;
   const since = Date.now() - 3000;
+  // parallel fetches: 12 serial awaits would overrun the 1 s tick
+  const rows = await Promise.all(names.map(async name => {
+    const pts = await j(`/metric?app=${encodeURIComponent(app)}&identity=${encodeURIComponent(name)}&startTime=${since}`);
+    return [name, pts.length ? pts[pts.length - 1] : null];
+  }));
   const t = $("top");
   t.innerHTML = "<tr><th>resource</th><th>pass/s</th><th>block/s</th><th>avg rt</th><th>threads</th></tr>";
-  for (const name of names) {
-    const pts = await j(`/metric?app=${encodeURIComponent(app)}&identity=${encodeURIComponent(name)}&startTime=${since}`);
-    const p = pts.length ? pts[pts.length - 1] : null;
+  for (const [name, p] of rows) {
     const row = t.insertRow();
     row.innerHTML = `<td>${esc(name)}</td><td>${p ? esc(p.pass_qps) : "-"}</td>` +
       `<td>${p ? esc(p.block_qps) : "-"}</td><td>${p ? esc(p.rt.toFixed(1)) : "-"}</td>` +
@@ -195,9 +198,9 @@ $("assign").onclick = async () => {
 async function tick() {
   try {
     await refreshApps();
-    await refreshResources();
+    const top = await refreshResources();
     await refreshChart();
-    await refreshTop();
+    await refreshTop(top);
     await refreshRules();
     await refreshAssign();
     $("err").textContent = "";

@@ -111,7 +111,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sx_intern_count.argtypes = [p, i32]
     # native front door (epoll token-protocol server)
     lib.sx_front_new.restype = p
-    lib.sx_front_new.argtypes = [i32, u64, u64, u64]
+    lib.sx_front_new.argtypes = [i32, u64, u64, u64, i32]
     lib.sx_front_free.argtypes = [p]
     lib.sx_front_port.restype = i32
     lib.sx_front_port.argtypes = [p]
@@ -120,14 +120,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sx_front_stop.argtypes = [p]
     lib.sx_front_map_flow.restype = i32
     lib.sx_front_map_flow.argtypes = [p, i64, i32]
+    lib.sx_front_map_param.restype = i32
+    lib.sx_front_map_param.argtypes = [p, i64, i32, i32]
     lib.sx_front_set_guard.argtypes = [p, i64]
     lib.sx_front_clear_flows.argtypes = [p]
     lib.sx_front_acq_backlog.restype = i64
     lib.sx_front_acq_backlog.argtypes = [p]
     lib.sx_front_drain_acquires.restype = i64
     lib.sx_front_drain_acquires.argtypes = [p, i64] + [p] * 4
+    lib.sx_front_drain_acquires2.restype = i64
+    lib.sx_front_drain_acquires2.argtypes = [p, i64] + [p] * 7
     lib.sx_front_respond.restype = i32
     lib.sx_front_respond.argtypes = [p, i64] + [p] * 3
+    lib.sx_front_respond_ex.restype = i32
+    lib.sx_front_respond_ex.argtypes = [p, i64] + [p] * 5
     return lib
 
 

@@ -246,10 +246,15 @@ int32_t sx_intern_count(sx_intern* t, int32_t first_id) {
 // verdicts return through a response ring that this thread writes back to
 // the sockets.  Python runs per TICK, not per request.
 //
-// Protocol subset handled natively: PING (replied inline) and MSG_TYPE_FLOW.
-// Anything else is answered STATUS_FAIL — richer types belong to the Python
-// server (cluster/server.py), which can share the port via a fronting LB in
-// real deployments; here they bind separate ports.
+// Protocol handled natively on ONE port (TokenServerHandler.java:61-75
+// parity): PING (replied inline), MSG_TYPE_FLOW, MSG_TYPE_PARAM_FLOW
+// (param values hashed in C — int/long/bool/string; a double falls back to
+// STATUS_FAIL, matching ParamFlowRequestDataWriter's primitives+strings
+// envelope), and CONCURRENT acquire/release (routed to the host manager
+// via the same ring, answered through respond_ex).  Multi-param requests
+// fan out to one engine item per value and JOIN in the pend slot (all
+// values must pass).  SO_REUSEPORT sharding: N fronts on one port, the
+// kernel load-balances accepted connections across io threads.
 // ---------------------------------------------------------------------------
 
 #include <sys/epoll.h>
@@ -266,6 +271,7 @@ int32_t sx_intern_count(sx_intern* t, int32_t first_id) {
 namespace {
 
 constexpr int8_t ST_TOO_MANY = -2;
+constexpr int8_t ST_BAD = -4;
 constexpr int8_t ST_FAIL = -1;
 constexpr int8_t ST_OK = 0;
 constexpr int8_t ST_NO_RULE = 5;
@@ -283,11 +289,15 @@ struct Pend {
     int fd;
     uint32_t gen;
     int32_t xid;
+    uint8_t type;       // request MSG_TYPE (response framing + joins)
+    int16_t remaining;  // outstanding engine items (multi-param join)
+    int8_t worst;       // first non-OK status seen across joined items
 };
 
 struct FlowSlot {
-    std::atomic<int64_t> key;
+    std::atomic<int64_t> key;  // (flow_id << 1) | is_param; 0 = empty
     std::atomic<int32_t> row;
+    std::atomic<int32_t> lane;  // param hash lane (param mappings only)
 };
 
 }  // namespace
@@ -322,7 +332,7 @@ static void sxf_set_nonblock(int fd) {
 }
 
 sx_front* sx_front_new(int port, uint64_t ring_pow2, uint64_t pending_cap,
-                       uint64_t fmap_pow2) {
+                       uint64_t fmap_pow2, int32_t reuseport) {
     auto* f = new (std::nothrow) sx_front();
     if (!f) return nullptr;
     f->acq = sx_ring_new(ring_pow2);
@@ -339,6 +349,7 @@ sx_front* sx_front_new(int port, uint64_t ring_pow2, uint64_t pending_cap,
     for (uint64_t i = 0; i < fmap_pow2; ++i) {
         f->fmap[i].key.store(0, std::memory_order_relaxed);
         f->fmap[i].row.store(-1, std::memory_order_relaxed);
+        f->fmap[i].lane.store(0, std::memory_order_relaxed);
     }
     // INVARIANT: pending_cap <= ring capacity, so at most pending_cap
     // responses can ever be in flight and the response ring cannot fill —
@@ -361,6 +372,8 @@ sx_front* sx_front_new(int port, uint64_t ring_pow2, uint64_t pending_cap,
     if (f->listen_fd < 0) return fail();
     int one = 1;
     setsockopt(f->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    if (reuseport)
+        setsockopt(f->listen_fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof one);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
@@ -378,20 +391,34 @@ sx_front* sx_front_new(int port, uint64_t ring_pow2, uint64_t pending_cap,
 
 int32_t sx_front_port(sx_front* f) { return f ? f->port : -1; }
 
-// flow_id -> engine row; 0 is not a valid flow id (used as empty marker)
-int32_t sx_front_map_flow(sx_front* f, int64_t flow_id, int32_t row) {
-    if (!f || flow_id == 0) return -1;
-    uint64_t h = (uint64_t)flow_id * 0x9E3779B97F4A7C15ull;
+// typed key: (flow_id << 1) | is_param — flow and param rule ids live in
+// independent spaces (ClusterFlowRuleManager vs ClusterParamFlowRuleManager)
+static int32_t sxf_map_put(sx_front* f, int64_t key, int32_t row, int32_t lane) {
+    uint64_t h = (uint64_t)key * 0x9E3779B97F4A7C15ull;
     for (uint64_t i = 0; i <= f->fmask; ++i) {
         uint64_t idx = (h + i) & f->fmask;
         int64_t k = f->fmap[idx].key.load(std::memory_order_acquire);
-        if (k == flow_id || k == 0) {
+        if (k == key || k == 0) {
             f->fmap[idx].row.store(row, std::memory_order_relaxed);
-            f->fmap[idx].key.store(flow_id, std::memory_order_release);
+            f->fmap[idx].lane.store(lane, std::memory_order_relaxed);
+            f->fmap[idx].key.store(key, std::memory_order_release);
             return 0;
         }
     }
     return -1;  // map full
+}
+
+// flow_id -> engine row; 0 is not a valid flow id (used as empty marker)
+int32_t sx_front_map_flow(sx_front* f, int64_t flow_id, int32_t row) {
+    if (!f || flow_id == 0) return -1;
+    return sxf_map_put(f, flow_id << 1, row, 0);
+}
+
+// param flow_id -> engine row of its $cluster/param resource + hash lane
+int32_t sx_front_map_param(sx_front* f, int64_t flow_id, int32_t row,
+                           int32_t lane) {
+    if (!f || flow_id == 0) return -1;
+    return sxf_map_put(f, (flow_id << 1) | 1, row, lane);
 }
 
 // wipe every flow mapping (rule reload re-adds the live set; clear-all
@@ -401,6 +428,7 @@ void sx_front_clear_flows(sx_front* f) {
     if (!f) return;
     for (uint64_t i = 0; i <= f->fmask; ++i) {
         f->fmap[i].row.store(-1, std::memory_order_relaxed);
+        f->fmap[i].lane.store(0, std::memory_order_relaxed);
         f->fmap[i].key.store(0, std::memory_order_release);
     }
 }
@@ -414,20 +442,41 @@ void sx_front_set_guard(sx_front* f, int64_t max_per_sec) {
     if (f) f->guard_max.store(max_per_sec, std::memory_order_relaxed);
 }
 
-static int32_t sxf_lookup(sx_front* f, int64_t flow_id) {
-    uint64_t h = (uint64_t)flow_id * 0x9E3779B97F4A7C15ull;
+static int32_t sxf_lookup(sx_front* f, int64_t key, int32_t* lane_out) {
+    uint64_t h = (uint64_t)key * 0x9E3779B97F4A7C15ull;
     for (uint64_t i = 0; i <= f->fmask; ++i) {
         uint64_t idx = (h + i) & f->fmask;
         int64_t k = f->fmap[idx].key.load(std::memory_order_acquire);
-        if (k == flow_id) return f->fmap[idx].row.load(std::memory_order_relaxed);
+        if (k == key) {
+            if (lane_out) *lane_out = f->fmap[idx].lane.load(std::memory_order_relaxed);
+            return f->fmap[idx].row.load(std::memory_order_relaxed);
+        }
         if (k == 0) return -1;
     }
     return -1;
 }
 
+// hash_param parity with core/rule_tensors.hash_param: ints/bools multiply
+// by the golden ratio constant (low bits survive mod-2^64 wrap, so this
+// matches Python's arbitrary-precision product & 0x7FFFFFFF); strings are
+// 32-bit FNV-1a masked to 31 bits; 0 maps to 1 ("no parameter" sentinel).
+static int32_t sxf_hash_int(int64_t v) {
+    uint64_t h = (uint64_t)v * 0x9E3779B1ull;
+    int32_t r = (int32_t)(h & 0x7FFFFFFFull);
+    return r == 0 ? 1 : r;
+}
+static int32_t sxf_hash_str(const uint8_t* s, size_t n) {
+    uint32_t h = 2166136261u;
+    for (size_t i = 0; i < n; ++i) h = (h ^ s[i]) * 16777619u;
+    int32_t r = (int32_t)(h & 0x7FFFFFFFu);
+    return r == 0 ? 1 : r;
+}
+
 static void sxf_queue_resp(sx_conn* c, int32_t xid, uint8_t type, int8_t status,
-                           int32_t remaining, int32_t wait_ms) {
-    // 2-byte BE length + xid(4) type(1) status(1) [+ remaining(4) wait(4)]
+                           int32_t remaining, int32_t wait_ms,
+                           int64_t token_id = 0) {
+    // 2-byte BE length + xid(4) type(1) status(1) + typed payload:
+    //   flow/param/batch -> remaining(4) wait(4); concurrent acq -> token(8)
     uint8_t body[14];
     body[0] = (uint8_t)(xid >> 24); body[1] = (uint8_t)(xid >> 16);
     body[2] = (uint8_t)(xid >> 8);  body[3] = (uint8_t)xid;
@@ -439,6 +488,10 @@ static void sxf_queue_resp(sx_conn* c, int32_t xid, uint8_t type, int8_t status,
         body[8] = (uint8_t)(remaining >> 8);  body[9] = (uint8_t)remaining;
         body[10] = (uint8_t)(wait_ms >> 24);  body[11] = (uint8_t)(wait_ms >> 16);
         body[12] = (uint8_t)(wait_ms >> 8);   body[13] = (uint8_t)wait_ms;
+        n = 14;
+    } else if (type == 3) {
+        for (int i = 0; i < 8; ++i)
+            body[6 + i] = (uint8_t)(token_id >> (8 * (7 - i)));
         n = 14;
     }
     c->wbuf.push_back((uint8_t)(n >> 8));
@@ -495,36 +548,140 @@ static void sxf_parse(sx_front* f, sx_conn* c) {
             sxf_queue_resp(c, xid, 0, ST_OK, 0, 0);
             continue;
         }
-        if (type != 1 || len < 5 + 13) {  // only FLOW is native
-            sxf_queue_resp(c, xid, type, ST_FAIL, 0, 0);
+        if (type == 1 && len >= 5 + 13) {  // FLOW
+            int64_t flow_id = 0;
+            for (int i = 0; i < 8; ++i) flow_id = (flow_id << 8) | p[5 + i];
+            int32_t count = ((int32_t)p[13] << 24) | ((int32_t)p[14] << 16) |
+                            ((int32_t)p[15] << 8) | (int32_t)p[16];
+            uint8_t prio = p[17];
+            int32_t row = sxf_lookup(f, flow_id << 1, nullptr);
+            if (row < 0) {
+                sxf_queue_resp(c, xid, 1, ST_NO_RULE, 0, 0);
+                continue;
+            }
+            if (!sxf_guard_ok(f) || f->freelist.empty()) {
+                sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
+                continue;
+            }
+            int32_t corr = f->freelist.back();
+            f->freelist.pop_back();
+            f->pend[corr] = Pend{c->fd, c->gen, xid, 1, 1, ST_OK};
+            if (sx_ring_push(f->acq, row, count, 0, 0, (1 << 4) | (prio ? 2 : 0),
+                             0.0f, 0, corr, 0, 0) != 0) {
+                f->freelist.push_back(corr);
+                sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
+            }
             continue;
         }
-        int64_t flow_id = 0;
-        for (int i = 0; i < 8; ++i) flow_id = (flow_id << 8) | p[5 + i];
-        int32_t count = ((int32_t)p[13] << 24) | ((int32_t)p[14] << 16) |
+        if (type == 2 && len >= 5 + 12) {  // PARAM_FLOW
+            int64_t flow_id = 0;
+            for (int i = 0; i < 8; ++i) flow_id = (flow_id << 8) | p[5 + i];
+            int32_t count = ((int32_t)p[13] << 24) | ((int32_t)p[14] << 16) |
+                            ((int32_t)p[15] << 8) | (int32_t)p[16];
+            int32_t lane = 0;
+            int32_t row = sxf_lookup(f, (flow_id << 1) | 1, &lane);
+            if (row < 0) {
+                sxf_queue_resp(c, xid, 2, ST_NO_RULE, 0, 0);
+                continue;
+            }
+            // parse typed params (ParamFlowRequestDataWriter envelope,
+            // protocol.py tags): int(0x00 i32) long(0x01 i64) double(0x02)
+            // string(0x03 u16+utf8) bool(0x04).  A double can't reproduce
+            // Python's str() hashing in C — answer FAIL and let the caller
+            // use the asyncio server.
+            int32_t hashes[16];
+            int k = 0;
+            bool bad = false, dbl = false;
+            size_t q = 17;  // offset of the params blob within the frame
+            while (q < len && k < 16) {
+                uint8_t tag = p[q++];
+                if (tag == 0 && q + 4 <= len) {
+                    int32_t v = ((int32_t)p[q] << 24) | ((int32_t)p[q + 1] << 16) |
+                                ((int32_t)p[q + 2] << 8) | (int32_t)p[q + 3];
+                    hashes[k++] = sxf_hash_int(v);
+                    q += 4;
+                } else if (tag == 1 && q + 8 <= len) {
+                    int64_t v = 0;
+                    for (int i = 0; i < 8; ++i) v = (v << 8) | p[q + i];
+                    hashes[k++] = sxf_hash_int(v);
+                    q += 8;
+                } else if (tag == 4 && q + 1 <= len) {
+                    hashes[k++] = sxf_hash_int(p[q] ? 1 : 0);
+                    q += 1;
+                } else if (tag == 3 && q + 2 <= len) {
+                    size_t sn = ((size_t)p[q] << 8) | p[q + 1];
+                    q += 2;
+                    if (q + sn > len) { bad = true; break; }
+                    hashes[k++] = sxf_hash_str(p + q, sn);
+                    q += sn;
+                } else if (tag == 2) {
+                    dbl = true;
+                    break;
+                } else {
+                    bad = true;
+                    break;
+                }
+            }
+            if (dbl) { sxf_queue_resp(c, xid, 2, ST_FAIL, 0, 0); continue; }
+            if (k == 16 && q < len) {
+                // more than 16 values: refuse loudly rather than silently
+                // check a prefix (the asyncio server handles such requests)
+                sxf_queue_resp(c, xid, 2, ST_FAIL, 0, 0);
+                continue;
+            }
+            if (bad || k == 0) { sxf_queue_resp(c, xid, 2, ST_BAD, 0, 0); continue; }
+            if (!sxf_guard_ok(f) || f->freelist.empty()) {
+                sxf_queue_resp(c, xid, 2, ST_TOO_MANY, 0, 0);
+                continue;
+            }
+            int32_t corr = f->freelist.back();
+            f->freelist.pop_back();
+            f->pend[corr] = Pend{c->fd, c->gen, xid, 2, (int16_t)k, ST_OK};
+            int pushed = 0;
+            for (int i = 0; i < k; ++i) {
+                int32_t a0 = lane == 0 ? hashes[i] : 0;
+                int32_t a1 = lane == 1 ? hashes[i] : 0;
+                if (sx_ring_push(f->acq, row, count, 0, 0, (2 << 4), 0.0f, 0,
+                                 corr, a0, a1) != 0)
+                    break;
+                ++pushed;
+            }
+            if (pushed == 0) {
+                f->freelist.push_back(corr);
+                sxf_queue_resp(c, xid, 2, ST_TOO_MANY, 0, 0);
+            } else if (pushed < k) {
+                // partial push: the join completes over the pushed items
+                // with a TOO_MANY floor so the caller sees backpressure
+                f->pend[corr].remaining = (int16_t)pushed;
+                f->pend[corr].worst = ST_TOO_MANY;
+            }
+            continue;
+        }
+        if ((type == 3 && len >= 5 + 12) || (type == 4 && len >= 5 + 8)) {
+            // CONCURRENT acquire/release: host-managed (TTL token table) —
+            // ride the same ring, answered via sx_front_respond_ex
+            int64_t v = 0;
+            for (int i = 0; i < 8; ++i) v = (v << 8) | p[5 + i];
+            int32_t count = 1;
+            if (type == 3)
+                count = ((int32_t)p[13] << 24) | ((int32_t)p[14] << 16) |
                         ((int32_t)p[15] << 8) | (int32_t)p[16];
-        uint8_t prio = p[17];
-        int32_t row = sxf_lookup(f, flow_id);
-        if (row < 0) {
-            sxf_queue_resp(c, xid, 1, ST_NO_RULE, 0, 0);
+            if (!sxf_guard_ok(f) || f->freelist.empty()) {
+                sxf_queue_resp(c, xid, type, ST_TOO_MANY, 0, 0);
+                continue;
+            }
+            int32_t corr = f->freelist.back();
+            f->freelist.pop_back();
+            f->pend[corr] = Pend{c->fd, c->gen, xid, type, 1, ST_OK};
+            if (sx_ring_push(f->acq, -1, count, 0, 0, ((int32_t)type << 4),
+                             0.0f, 0, corr, (int32_t)(v >> 32),
+                             (int32_t)(v & 0xFFFFFFFF)) != 0) {
+                f->freelist.push_back(corr);
+                sxf_queue_resp(c, xid, type, ST_TOO_MANY, 0, 0);
+            }
             continue;
         }
-        if (!sxf_guard_ok(f)) {
-            sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
-            continue;
-        }
-        if (f->freelist.empty()) {
-            sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
-            continue;
-        }
-        int32_t corr = f->freelist.back();
-        f->freelist.pop_back();
-        f->pend[corr] = Pend{c->fd, c->gen, xid};
-        if (sx_ring_push(f->acq, row, count, 0, 0, prio ? 2 : 0, 0.0f, 0,
-                         corr, 0, 0) != 0) {
-            f->freelist.push_back(corr);
-            sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
-        }
+        sxf_queue_resp(c, xid, type, ST_FAIL, 0, 0);
     }
     if (off) b.erase(b.begin(), b.begin() + off);
 }
@@ -532,21 +689,28 @@ static void sxf_parse(sx_front* f, sx_conn* c) {
 static void sxf_drain_responses(sx_front* f) {
     constexpr int64_t MAXB = 8192;
     static thread_local std::vector<int32_t> corr(MAXB), verdict(MAXB),
-        wait(MAXB), i0(MAXB), i1(MAXB), i2(MAXB), i3(MAXB);
+        wait(MAXB), th(MAXB), tl(MAXB), i2(MAXB), i3(MAXB), a0(MAXB), a1(MAXB);
     static thread_local std::vector<float> f0(MAXB);
     for (;;) {
         int64_t n = sx_ring_drain(f->resp, MAXB, corr.data(), verdict.data(),
-                                  wait.data(), i0.data(), i1.data(), f0.data(),
-                                  i2.data(), i3.data(), i0.data(), i1.data());
+                                  wait.data(), th.data(), tl.data(), f0.data(),
+                                  i2.data(), i3.data(), a0.data(), a1.data());
         if (n <= 0) break;
         for (int64_t i = 0; i < n; ++i) {
             int32_t slot = corr[i];
             if (slot < 0 || (size_t)slot >= f->pend.size()) continue;
-            Pend pd = f->pend[slot];
+            Pend& pd = f->pend[slot];
+            int8_t st = (int8_t)verdict[i];
+            if (st != ST_OK && pd.worst == ST_OK) pd.worst = st;
+            if (--pd.remaining > 0) continue;  // multi-param join pending
+            Pend done = pd;
             f->freelist.push_back(slot);
-            auto it = f->conns.find(pd.fd);
-            if (it == f->conns.end() || it->second->gen != pd.gen) continue;
-            sxf_queue_resp(it->second, pd.xid, 1, (int8_t)verdict[i], 0, wait[i]);
+            auto it = f->conns.find(done.fd);
+            if (it == f->conns.end() || it->second->gen != done.gen) continue;
+            int8_t final_st = done.type == 2 ? done.worst : st;
+            int64_t tok = ((int64_t)(uint32_t)th[i] << 32) | (uint32_t)tl[i];
+            sxf_queue_resp(it->second, done.xid, done.type, final_st, 0,
+                           wait[i], tok);
         }
         if (n < MAXB) break;
     }
@@ -665,6 +829,30 @@ int64_t sx_front_drain_acquires(sx_front* f, int64_t max_n, int32_t* row,
     return n;
 }
 
+// tick side: typed drain — kind[i] = MSG_TYPE (1 flow, 2 param, 3/4
+// concurrent acquire/release); a0/a1 carry param hash lanes (kind 2) or
+// the 64-bit flow/token id halves (kinds 3/4)
+int64_t sx_front_drain_acquires2(sx_front* f, int64_t max_n, int32_t* row,
+                                 int32_t* count, int32_t* prio, int32_t* corr,
+                                 int32_t* kind, int32_t* a0, int32_t* a1) {
+    static thread_local std::vector<int32_t> scratch_i;
+    static thread_local std::vector<float> scratch_f;
+    if ((int64_t)scratch_i.size() < max_n * 3) scratch_i.resize(max_n * 3);
+    if ((int64_t)scratch_f.size() < max_n) scratch_f.resize(max_n);
+    int32_t* origin = scratch_i.data();
+    int32_t* ph = origin + max_n;
+    int32_t* err = ph + max_n;
+    int64_t n = sx_ring_drain(f->acq, max_n, row, count, origin, ph, prio,
+                              scratch_f.data(), err, corr, a0, a1);
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t fl = prio[i];
+        prio[i] = (fl >> 1) & 1;
+        int32_t k = fl >> 4;
+        kind[i] = k ? k : 1;  // legacy pushes carried no kind bits
+    }
+    return n;
+}
+
 // tick side: push verdicts for drained acquires
 int32_t sx_front_respond(sx_front* f, int64_t n, const int32_t* corr,
                          const int32_t* status, const int32_t* wait_ms) {
@@ -672,6 +860,19 @@ int32_t sx_front_respond(sx_front* f, int64_t n, const int32_t* corr,
     for (int64_t i = 0; i < n; ++i) {
         if (sx_ring_push(f->resp, corr[i], status[i], wait_ms[i], 0, 0, 0.0f,
                          0, 0, 0, 0) != 0)
+            ++dropped;
+    }
+    return dropped;
+}
+
+// tick side: typed respond with 64-bit token ids (concurrent acquire)
+int32_t sx_front_respond_ex(sx_front* f, int64_t n, const int32_t* corr,
+                            const int32_t* status, const int32_t* wait_ms,
+                            const int32_t* tok_hi, const int32_t* tok_lo) {
+    int32_t dropped = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (sx_ring_push(f->resp, corr[i], status[i], wait_ms[i], tok_hi[i],
+                         tok_lo[i], 0.0f, 0, 0, 0, 0) != 0)
             ++dropped;
     }
     return dropped;

@@ -284,7 +284,7 @@ class SentinelClient:
         self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
         self._rules_dirty = False
 
-        self._front_door = None
+        self._front_doors: list = []
         self._lock = threading.Lock()  # guards the acquire queue
         self._engine_lock = threading.Lock()  # guards state/tick execution
         self._acquires: List[AcquireRequest] = []
@@ -1082,25 +1082,44 @@ class SentinelClient:
                         )
                     )
                     n_comp += len(spill)
-            front = None
-            door = self._front_door
-            if door is not None:
-                room = self.cfg.batch_size - len(acq)
-                if room > 0:
-                    cols = door.drain(room)
-                    if len(cols[0]):
-                        front = cols
-            if not acq and not n_comp and front is None and now_ms is None:
+            fronts = []
+            room = self.cfg.batch_size - len(acq)
+            # rotate the drain order so a saturated first shard can't
+            # starve later shards' rings across ticks
+            doors = self._front_doors
+            if len(doors) > 1:
+                rr = self._door_rr = (getattr(self, "_door_rr", -1) + 1) % len(doors)
+                doors = doors[rr:] + doors[:rr]
+            for door in doors:
+                if room <= 0:
+                    break
+                row, cnt, prio, corr, kind, a0, a1 = door.drain(room)
+                if not len(row):
+                    continue
+                host = kind >= 3  # concurrent acquire/release
+                if host.any():
+                    door.handle_host_events(
+                        kind[host], cnt[host], corr[host], a0[host], a1[host]
+                    )
+                eng = ~host
+                if eng.any():
+                    cols = (
+                        row[eng].copy(), cnt[eng].copy(), prio[eng].copy(),
+                        corr[eng].copy(), a0[eng].copy(), a1[eng].copy(),
+                    )
+                    fronts.append((door, cols))
+                    room -= len(cols[0])
+            if not acq and not n_comp and not fronts and now_ms is None:
                 return
-            self._run_tick(acq, comp if n_comp else None, now_ms, front=front)
+            self._run_tick(acq, comp if n_comp else None, now_ms, fronts=fronts)
             with self._lock:
                 more = (
                     bool(self._acquires)
                     or bool(self._comp_ring)
                     or bool(self._comp_overflow)
                 )
-            if not more and door is not None:
-                more = door.pending() > 0
+            if not more:
+                more = any(d.pending() > 0 for d in self._front_doors)
             if not more:
                 return
             now_ms = None  # subsequent drain loops use fresh time
@@ -1192,8 +1211,10 @@ class SentinelClient:
         """Serve a NativeFrontDoor's traffic from this client's tick loop:
         its pending acquires join every engine batch as array lanes and
         their verdicts return through the door's response ring —
-        per-request work never touches Python (cluster/front_door.py)."""
-        self._front_door = door
+        per-request work never touches Python (cluster/front_door.py).
+        May be called once per SO_REUSEPORT shard — every attached door is
+        drained into the same engine batches."""
+        self._front_doors.append(door)
 
     def pending_acquires(self) -> int:
         """Depth of the un-ticked acquire queue (load-shedding probe)."""
@@ -1220,11 +1241,20 @@ class SentinelClient:
         acq: List[AcquireRequest],
         comp,  # Optional[Tuple[np.ndarray, ...]] — drained ring columns
         now_ms: Optional[int],
-        front=None,  # Optional (row, count, prio, corr) int32 arrays
+        fronts=(),  # [(door, (row, count, prio, corr, a0, a1)), ...]
     ) -> None:
         cfg = self.cfg
         M = cfg.param_dims
         trash = cfg.trash_row
+        # concatenate every attached door's drained engine items; responses
+        # route back per door by slice
+        if fronts:
+            f_cols = [
+                np.concatenate([cols[j] for _d, cols in fronts]) for j in range(6)
+            ]
+            front = tuple(f_cols)
+        else:
+            front = None
         n_front = 0 if front is None else len(front[0])
 
         # adaptive batch shape: a light tick (queue <= 256) runs at a small
@@ -1252,6 +1282,19 @@ class SentinelClient:
             f_row = front[0] if n_front else None
             f_cnt = front[1] if n_front else None
             f_prio = front[2] if n_front else None
+
+            def _ph_cols():
+                ph = np.zeros((B, M), dtype=np.int32)
+                for i, r in enumerate(acq):
+                    t = tuple(r.param_hash)[:M]
+                    ph[i, : len(t)] = t
+                if n_front:
+                    # native param requests carry pre-hashed lane values
+                    ph[n : n + n_front, 0] = front[4]
+                    if M > 1:
+                        ph[n : n + n_front, 1] = front[5]
+                return ph
+
             from sentinel_tpu.ops.engine import _use_fused
 
             clamp = _use_fused(cfg)
@@ -1275,13 +1318,7 @@ class SentinelClient:
                 ctx_node=jnp.asarray(arr("ctx_node", trash, np.int32)),
                 ctx_name=jnp.asarray(arr("ctx_name", -1, np.int32)),
                 inbound=jnp.asarray(arr("inbound", 0, np.int32)),
-                param_hash=jnp.asarray(
-                    np.asarray(
-                        [(tuple(r.param_hash) + (0,) * M)[:M] for r in acq]
-                        + [(0,) * M] * (B - n),
-                        dtype=np.int32,
-                    )
-                ),
+                param_hash=jnp.asarray(_ph_cols()),
                 pre_verdict=jnp.asarray(arr("pre_verdict", 0, np.int32)),
             )
         c = E.empty_complete(cfg, b=min(256, cfg.complete_batch_size))
@@ -1345,12 +1382,15 @@ class SentinelClient:
             if r.future is not None:
                 r.future.set_result((int(verdict[i]), int(wait[i])))
         if n_front:
-            n0 = len(acq)
-            self._front_door.respond(
-                front[3],
-                verdict[n0 : n0 + n_front].astype(np.int32),
-                wait[n0 : n0 + n_front].astype(np.int32),
-            )
+            off = len(acq)
+            for door, cols in fronts:
+                k = len(cols[0])
+                door.respond(
+                    cols[3],
+                    verdict[off : off + k].astype(np.int32),
+                    wait[off : off + k].astype(np.int32),
+                )
+                off += k
 
 
 def _mask_min_rt(v: float) -> float:

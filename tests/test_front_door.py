@@ -79,14 +79,109 @@ def test_front_door_flow_roundtrip(door_setup):
         norule = _rpc(s, P.ClusterRequest(xid=99, type=C.MSG_TYPE_FLOW, flow_id=777))
         assert norule.status == C.STATUS_NO_RULE
 
-        # unsupported type answered, not hung
-        bad = _rpc(
+        # unknown type answered FAIL, not hung (raw frame — the client
+        # encoder refuses to build one)
+        raw = struct.pack(">iB", 100, 99)
+        s.sendall(struct.pack(">H", len(raw)) + raw)
+        head = s.recv(2)
+        (n2,) = struct.unpack(">H", head)
+        body = b""
+        while len(body) < n2:
+            body += s.recv(n2 - len(body))
+        bad = P.decode_response(body)
+        assert bad.xid == 100 and bad.status == C.STATUS_FAIL
+    finally:
+        s.close()
+
+
+def test_front_door_param_flow(door_setup):
+    """MSG_TYPE_PARAM_FLOW served natively: C-side value hashing must agree
+    with hash_param, per-value budgets enforce, multi-value requests join
+    (all values must pass)."""
+    door, decision = door_setup
+    svc = door._service
+    svc.param_rules.load(
+        "default",
+        [
+            R.ParamFlowRule(
+                resource="res-55", param_idx=0, count=2.0,
+                cluster_mode=True, cluster_flow_id=55,
+            )
+        ],
+    )
+    s = socket.create_connection(("127.0.0.1", door.port), timeout=5)
+    try:
+        def param(xid, values):
+            return _rpc(
+                s,
+                P.ClusterRequest(
+                    xid=xid, type=C.MSG_TYPE_PARAM_FLOW, flow_id=55,
+                    count=1, params=list(values),
+                ),
+            ).status
+
+        # per-value budget 2/s: strings hash in C with Python parity
+        assert param(1, ["alice"]) == C.STATUS_OK
+        assert param(2, ["alice"]) == C.STATUS_OK
+        assert param(3, ["alice"]) == C.STATUS_BLOCKED
+        assert param(4, ["bob"]) == C.STATUS_OK  # independent value
+        assert param(5, [7]) == C.STATUS_OK  # int hashing parity
+        assert param(6, [7]) == C.STATUS_OK
+        assert param(7, [7]) == C.STATUS_BLOCKED
+        # multi-value join: "carol" has budget, "alice" is exhausted -> all
+        # must pass, so the request blocks
+        assert param(8, ["carol", "alice"]) == C.STATUS_BLOCKED
+        assert param(9, ["carol"]) == C.STATUS_OK
+        # doubles can't hash natively (str() parity) -> explicit FAIL
+        assert param(10, [3.5]) == C.STATUS_FAIL
+        norule = _rpc(
             s,
             P.ClusterRequest(
-                xid=100, type=C.MSG_TYPE_CONCURRENT_ACQUIRE, flow_id=101
+                xid=12, type=C.MSG_TYPE_PARAM_FLOW, flow_id=777,
+                count=1, params=["x"],
             ),
         )
-        assert bad.status == C.STATUS_FAIL
+        assert norule.status == C.STATUS_NO_RULE
+    finally:
+        s.close()
+
+
+def test_front_door_concurrent_tokens(door_setup):
+    """CONCURRENT acquire/release on the same port: TTL token table served
+    host-side, token ids round-trip through the native response path."""
+    door, decision = door_setup
+    s = socket.create_connection(("127.0.0.1", door.port), timeout=5)
+    try:
+        def acquire(xid):
+            return _rpc(
+                s,
+                P.ClusterRequest(
+                    xid=xid, type=C.MSG_TYPE_CONCURRENT_ACQUIRE,
+                    flow_id=101, count=1,
+                ),
+            )
+
+        def release(xid, tid):
+            return _rpc(
+                s,
+                P.ClusterRequest(
+                    xid=xid, type=C.MSG_TYPE_CONCURRENT_RELEASE, token_id=tid
+                ),
+            )
+
+        # rule 101 count=3 (AVG_LOCAL x 0 connected... GLOBAL threshold):
+        # acquire up to the limit, then blocked, then release frees a slot
+        got = [acquire(200 + i) for i in range(4)]
+        ok = [r for r in got if r.status == C.STATUS_OK]
+        blocked = [r for r in got if r.status == C.STATUS_BLOCKED]
+        assert len(ok) == 3 and len(blocked) == 1
+        assert all(r.token_id > 0 for r in ok)
+        assert len({r.token_id for r in ok}) == 3  # distinct tokens
+        rel = release(300, ok[0].token_id)
+        assert rel.status == C.STATUS_RELEASE_OK
+        again = release(301, ok[0].token_id)
+        assert again.status == C.STATUS_ALREADY_RELEASE
+        assert acquire(302).status == C.STATUS_OK  # freed slot reusable
     finally:
         s.close()
 
@@ -127,3 +222,49 @@ def test_front_door_pipelined_burst(door_setup):
         assert all(v in (C.STATUS_OK, C.STATUS_BLOCKED) for v in got.values())
     finally:
         s.close()
+
+
+def test_front_door_reuseport_shards():
+    """SO_REUSEPORT sharding: N doors on ONE port, each with its own io
+    thread; the kernel spreads connections and every shard's traffic is
+    served by the same engine (the multi-core scaling architecture)."""
+    from sentinel_tpu.cluster.front_door import NativeFrontDoor
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    decision = SentinelClient(
+        cfg=small_engine_config(), mode="threaded", tick_interval_ms=2.0
+    )
+    decision.start()
+    svc = DefaultTokenService(decision)
+    svc.flow_rules.load(
+        "default",
+        [R.FlowRule(resource="res-7", count=1000.0, cluster_mode=True, cluster_flow_id=7)],
+    )
+    doors = [NativeFrontDoor(port=0, reuseport=True)]
+    port = doors[0].port
+    doors.append(NativeFrontDoor(port=port, reuseport=True))
+    try:
+        for d in doors:
+            d.follow(svc)
+            decision.attach_front_door(d)
+            d.start()
+        # many short-lived connections: REUSEPORT hashes per 4-tuple, so
+        # distinct source ports spread across the two shards
+        ok = 0
+        for i in range(24):
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            try:
+                r = _rpc(s, P.ClusterRequest(xid=i, type=C.MSG_TYPE_FLOW, flow_id=7))
+                if r.status == C.STATUS_OK:
+                    ok += 1
+            finally:
+                s.close()
+        assert ok == 24
+    finally:
+        for d in doors:
+            d.stop()
+        decision.stop()
+        for d in doors:
+            d.close()
