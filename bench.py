@@ -201,15 +201,32 @@ def device_tick_ms(cfg, E, ruleset, acqs, comps, k1=8, k2=40) -> float:
     m1, m2 = make(k1), make(k2)
     jax.block_until_ready(m1(state0, jnp.int32(0), stacked_acq, stacked_comp))
     jax.block_until_ready(m2(state0, jnp.int32(0), stacked_acq, stacked_comp))
-    t1s, t2s = [], []
-    for s in range(4):
-        t0 = time.perf_counter()
-        jax.block_until_ready(m1(state0, jnp.int32(999 * s), stacked_acq, stacked_comp))
-        t1s.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        jax.block_until_ready(m2(state0, jnp.int32(999 * s), stacked_acq, stacked_comp))
-        t2s.append(time.perf_counter() - t0)
-    return max((min(t2s) - min(t1s)) / (k2 - k1) * 1000.0, 0.001)
+
+    def samples(n):
+        slopes = []
+        for s in range(n):
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                m1(state0, jnp.int32(999 * s), stacked_acq, stacked_comp)
+            )
+            t1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                m2(state0, jnp.int32(999 * s), stacked_acq, stacked_comp)
+            )
+            t2 = time.perf_counter() - t0
+            slopes.append((t2 - t1) / (k2 - k1) * 1000.0)
+        return slopes
+
+    # median of per-sample slopes, NOT min-of-mins: the tunnel's ±20 ms
+    # call variance can make a min-based slope collapse to ~0 and report
+    # a nonsense tick time; retry once if the result is implausible
+    sl = sorted(samples(4))
+    d = sl[len(sl) // 2]
+    if d < 0.05:
+        sl = sorted(samples(6))
+        d = sl[len(sl) // 2]
+    return max(d, 0.001)
 
 
 def main() -> None:
@@ -276,6 +293,10 @@ def main() -> None:
             # call variance must be small against (k2-k1) x tick_ms
             k2 = 288 if Bl <= 16384 else 40
             d = device_tick_ms(cfg_l, E_l, ruleset_l, acqs_l, comps_l, k1=8, k2=k2)
+            if d < 0.1:  # implausible slope (tunnel glitch): one full retry
+                d = device_tick_ms(
+                    cfg_l, E_l, ruleset_l, acqs_l, comps_l, k1=8, k2=k2
+                )
             interval = max(d, 1.0)  # ticking back-to-back at device rate
             lat_table.append(
                 {
