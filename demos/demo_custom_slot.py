@@ -1,0 +1,71 @@
+"""Custom processor slots — the slot-chain SPI demo
+(sentinel-demo-slot-chain-spi analog).
+
+Two ordered slots around the engine check: an auditing slot (order -100)
+that stamps a trace id on entry and logs outcome + RT on exit, and a
+tenant-guard slot (order 0) that rejects a blacklisted tenant — the
+rejection flows through the engine as a pre-verdict, so the block is
+COUNTED like any rule block (StatisticSlot parity).
+
+    JAX_PLATFORMS=cpu python demos/demo_custom_slot.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401
+from _bootstrap import warm
+import itertools
+
+import sentinel_tpu as st
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.runtime.slots import ProcessorSlot, SlotContext
+
+
+class AuditSlot(ProcessorSlot):
+    order = -100  # before everything, exits last (LIFO)
+    _ids = itertools.count(1)
+
+    def on_entry(self, ctx: SlotContext):
+        ctx.attachments["trace"] = f"t-{next(self._ids)}"
+
+    def on_exit(self, ctx: SlotContext):
+        outcome = (
+            f"BLOCKED({type(ctx.block_exception).__name__})"
+            if ctx.block_exception is not None
+            else f"ok rt={ctx.rt_ms:.0f}ms errors={ctx.errors}"
+        )
+        print(f"  [audit {ctx.attachments['trace']}] {ctx.resource} -> {outcome}")
+
+
+class TenantGuard(ProcessorSlot):
+    order = 0
+
+    def on_entry(self, ctx: SlotContext):
+        if ctx.args and ctx.args[0] == "tenant-banned":
+            raise st.FlowException(ctx.resource)
+
+
+def main():
+    client = st.init(cfg=small_engine_config(), metric_log=False)
+    warm(client, "api")
+    client.slots.register(AuditSlot())
+    client.slots.register(TenantGuard())
+
+    for tenant in ("tenant-a", "tenant-banned", "tenant-b"):
+        try:
+            with client.entry("api", args=[tenant]):
+                pass
+            print(f"{tenant}: served")
+        except st.BlockException as e:
+            print(f"{tenant}: rejected by custom slot ({type(e).__name__})")
+
+    s = client.stats.resource("api")
+    print(f"stats: pass={s['passQps']:.0f} block={s['blockQps']:.0f} "
+          "(the slot rejection was counted by the engine)")
+    st.reset()
+
+
+if __name__ == "__main__":
+    main()
