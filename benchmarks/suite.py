@@ -2,7 +2,7 @@
 cluster harness (SURVEY §4).
 
 Each config prints ONE JSON line; `--all` runs every config and also
-writes benchmarks/RESULTS_r2.json.  Config #2 (10k ruled resources,
+writes benchmarks/RESULTS_r3.json.  Config #2 (10k ruled resources,
 full-feature engine tick) is the repo-root bench.py headline and is not
 duplicated here.
 
@@ -552,7 +552,7 @@ def main():
                     continue
                 print(json.dumps(r), flush=True)
                 results.append(r)
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "RESULTS_r2.json")
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "RESULTS_r3.json")
         with open(path, "w") as f:
             json.dump(results, f, indent=1)
         return
