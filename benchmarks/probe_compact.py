@@ -1,0 +1,115 @@
+"""Microbenchmarks sizing the sorted-tick compaction design (round 4).
+
+Questions answered on the real chip:
+  1. multi-operand stable sort cost at B=128K vs operand count
+  2. XLA dynamic gather cost (random + monotone indices) — is the one-hot
+     MXU gather still needed for the expand step?
+  3. int32 cumsum cost over [P, B]
+  4. searchsorted (table queries into sorted keys)
+  5. scatter_many cost at item axis 131072 vs 16384 (the compaction prize)
+  6. distinct-key counts of the bench Zipf(1.3) traffic at several B
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.timing import device_time_ms, scan_op
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.ops import fused as FU
+
+    B = 131072
+    U = 16384
+    rng = np.random.default_rng(0)
+
+    # --- 6. distinct keys in bench traffic (host-side, exact) -------------
+    for b in (8192, 16384, 65536, 131072):
+        z = rng.zipf(1.3, size=b).astype(np.int64)
+        ids = (z - 1) % ((1 << 20) - 1) + 1
+        uniq = np.unique(ids).size
+        ruled = np.unique(ids[ids <= 10000]).size
+        print(f"zipf1.3 B={b:7d}: distinct={uniq:6d} ({uniq/b:.2%})  "
+              f"distinct_ruled={ruled}")
+
+    keys = jnp.asarray(rng.integers(0, 1 << 20, B, dtype=np.int32))
+    payload = [jnp.asarray(rng.integers(0, 255, B, dtype=np.int32)) for _ in range(12)]
+    tab8 = jnp.asarray(rng.integers(0, 1 << 20, (U, 8), dtype=np.int32))
+    idx_rand = jnp.asarray(rng.integers(0, U, B, dtype=np.int32))
+    idx_mono = jnp.sort(idx_rand)
+
+    def t(name, body):
+        ms = device_time_ms(scan_op(body), k1=8, k2=72, samples=3)
+        print(f"{name:46s} {ms:8.4f} ms")
+
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    t("sort (key, iota) stable", lambda i: jax.lax.sort(
+        [keys + i, iota], num_keys=1, is_stable=True)[1])
+    t("sort (key, iota, 4 payloads)", lambda i: jax.lax.sort(
+        [keys + i, iota] + payload[:4], num_keys=1, is_stable=True)[1])
+    t("sort (key, iota, 12 payloads)", lambda i: jax.lax.sort(
+        [keys + i, iota] + payload[:12], num_keys=1, is_stable=True)[1])
+    t("sort (2 keys, iota, 8 payloads)", lambda i: jax.lax.sort(
+        [keys + i, keys, iota] + payload[:8], num_keys=2, is_stable=True)[2])
+
+    t("gather [B] from [U,8] random", lambda i: tab8[(idx_rand + i) % U])
+    t("gather [B] from [U,8] monotone", lambda i: tab8[jnp.minimum(idx_mono + i, U - 1)])
+    t("gather [B] from [U] 1col random", lambda i: tab8[:, 0][(idx_rand + i) % U])
+    t("take_along [B] from [U] mono", lambda i: jnp.take(
+        tab8[:, 0], jnp.minimum(idx_mono + i, U - 1)))
+
+    vp = jnp.stack(payload)  # [12, B]
+    t("cumsum [12,B] i32 axis1", lambda i: jnp.cumsum(vp + i, axis=1))
+    t("cumsum [45,B] i32 axis1", lambda i: jnp.cumsum(
+        jnp.tile(vp, (4, 1))[:45] + i, axis=1))
+    skeys = jnp.sort(keys)
+    q = jnp.arange(U, dtype=jnp.int32) * 64
+    t("searchsorted 16K q into sorted [B]", lambda i: jnp.searchsorted(
+        skeys, q + i, side="right"))
+
+    t("xla scatter-add [B]->[U]", lambda i: jnp.zeros((U,), jnp.int32).at[
+        (idx_rand + i) % U].add(1, mode="drop"))
+    t("xla scatter-add [U]->[U]", lambda i: jnp.zeros((U,), jnp.int32).at[
+        (idx_rand[:U] + i) % U].add(1, mode="drop"))
+
+    # --- 5. scatter_many at two item-axis lengths -------------------------
+    def stat_job(n_items, digits):
+        rows = jnp.stack([
+            jnp.asarray(rng.integers(0, 16376, n_items, dtype=np.int32))
+            for _ in range(3)
+        ])
+        vals = jnp.stack([
+            jnp.asarray(rng.integers(0, 255, n_items, dtype=np.int32))
+            for _ in range(3)
+        ])
+        def body(i):
+            outs = FU.scatter_many(
+                [FU.Job("stat", 16376, (rows + i) % 16376, vals, digits)]
+            )
+            return outs[0]
+        return body
+
+    t("scatter_many stat-3fan N=131072 d=(2,2,3)", stat_job(B, (2, 2, 3)))
+    t("scatter_many stat-3fan N=16384 d=(2,2,3)", stat_job(U, (2, 2, 3)))
+    t("scatter_many stat-3fan N=16384 d=(4,4,5)", stat_job(U, (4, 4, 5)))
+
+    gj = FU.GatherJob("wsum", idx_rand, tab8[:, :3] % (1 << 20), (3, 3, 3))
+    t("gather_many [B] from [U,3] d=(3,3,3)", lambda i: FU.gather_many(
+        [gj._replace(ids=(idx_rand + i) % U)])[0])
+    gj2 = FU.GatherJob("wsum", idx_rand[:U], tab8[:, :3] % (1 << 20), (3, 3, 3))
+    t("gather_many [U] from [U,3] d=(3,3,3)", lambda i: FU.gather_many(
+        [gj2._replace(ids=(idx_rand[:U] + i) % U)])[0])
+
+
+if __name__ == "__main__":
+    main()

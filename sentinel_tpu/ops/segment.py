@@ -1,0 +1,263 @@
+"""Sorted-batch segment machinery: the round-4 aggregation primitive.
+
+The fused one-hot digit-dot kernels (ops/fused.py) stream the whole item
+axis through the MXU for every destination table — cost LINEAR in batch
+size with no amortization (the round-3 cost model).  Real traffic is
+Zipf-skewed: a 128K-item tick touches ~12K distinct resources (9%), so
+almost all of that streaming is redundant.
+
+This module exploits a batch that arrives SORTED by a composite key
+(resource id first): equal-key items form contiguous *segments*, and
+
+  - per-table scatters contract SEGMENT SUMS over a short compacted axis
+    (U entries) instead of per-item payloads over the full batch,
+  - per-item table reads (rule fields, window totals) happen once per
+    segment and expand back with ONE monotone gather,
+  - within-tick FCFS ranks (ops/rank.py) become segmented prefix sums on
+    the already-sorted order — no per-rank sort networks.
+
+Sorting stably by key preserves arrival order within each segment, so
+every rank/verdict is bit-identical to the unsorted engine (integer
+digit-plane sums are order-independent; see tests/test_segment.py and
+the engine equivalence suite).
+
+Exactness scheme: segments are capped at BLOCK=256 items by synthetic
+breaks at block boundaries, so a segment never spans two 256-item blocks.
+Per-item payloads are split into base-256 digit planes (<= 255 each),
+prefix-summed in int32 (exact: 255 * 2^23 < 2^31), and differenced at
+segment ends; a digit-plane segment sum is <= 255*256 = 65280 and two
+adjacent digit sums recombine to < 2^24 — inside the bf16 digit-dot
+exactness envelope of ops/fused.py.
+
+Reference map: this replaces the per-request LongAdder adds of
+StatisticSlot.java:54-164 and the CAS ranking of
+RateLimiterController.java:50-105 with sort + segmented scans — the
+batched form of "group requests by resource, then admit in arrival
+order".
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: segments never span a BLOCK-item boundary (synthetic heads), capping
+#: segment length so digit-plane sums stay exact (see module docstring)
+BLOCK = 256
+
+_INT_MIN = jnp.int32(-(2**31) + 1)
+_INT_MAX = jnp.int32(2**31 - 1)
+
+
+class SegCtx(NamedTuple):
+    """Segment structure of one sorted batch (item axis N, capacity U)."""
+
+    head: jax.Array  # bool [N] — first item of its segment
+    sid: jax.Array  # int32 [N] — segment id, 0-based, nondecreasing
+    n_seg: jax.Array  # int32 scalar — live segment count
+    ok: jax.Array  # bool scalar — n_seg <= U (compacted outputs valid)
+    seg_end: jax.Array  # int32 [U] — last item position per live segment
+    live: jax.Array  # bool [U] — segment slot holds a live segment
+
+    @property
+    def U(self) -> int:
+        return self.seg_end.shape[0]
+
+
+def heads_from_keys(*cols: jax.Array) -> jax.Array:
+    """Segment-start marks from sorted key columns + BLOCK boundaries."""
+    n = cols[0].shape[0]
+    change = jnp.zeros((n,), bool)
+    for c in cols:
+        change = change | jnp.concatenate(
+            [jnp.ones((1,), bool), c[1:] != c[:-1]]
+        )
+    pos = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
+    return change | (pos % BLOCK == 0)
+
+
+def build(key_cols: Sequence[jax.Array], U: int) -> SegCtx:
+    """Segment structure for a batch sorted by ``key_cols`` (stably).
+
+    One 2-operand sort compacts segment-end positions into [U]; when the
+    live segment count exceeds U, ``ok`` is False and the caller must take
+    its uncompacted fallback (compacted outputs would drop segments).
+    """
+    head = heads_from_keys(*key_cols)
+    n = head.shape[0]
+    sid = jnp.cumsum(head.astype(jnp.int32)) - 1
+    n_seg = sid[-1] + 1
+    ok = n_seg <= U
+    tail = jnp.concatenate([head[1:], jnp.ones((1,), bool)])
+    pos = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
+    skey = jnp.where(tail & (sid < U), sid, _INT_MAX)
+    skeys, spos = jax.lax.sort([skey, pos], num_keys=1, is_stable=False)
+    if U > n:  # short batches still produce [U]-shaped compacted outputs
+        skeys = jnp.concatenate([skeys, jnp.full((U - n,), _INT_MAX, jnp.int32)])
+        spos = jnp.concatenate([spos, jnp.zeros((U - n,), jnp.int32)])
+    seg_end = spos[:U]
+    live = skeys[:U] != _INT_MAX
+    return SegCtx(head=head, sid=sid, n_seg=n_seg, ok=ok, seg_end=seg_end, live=live)
+
+
+def compact(ctx: SegCtx, arr: jax.Array, fill=0) -> jax.Array:
+    """Per-segment value (constant within each segment): [N(,P)] -> [U(,P)].
+
+    Reads each segment's LAST item; dead slots get ``fill``.
+    """
+    g = arr[ctx.seg_end]
+    mask = ctx.live if g.ndim == 1 else ctx.live[:, None]
+    return jnp.where(mask, g, fill)
+
+
+def seg_sums(
+    ctx: SegCtx,
+    planes: Sequence[jax.Array],  # each int32 [N], values in [0, maxes[p]]
+    maxes: Sequence[int],
+) -> list:
+    """Exact per-segment sums of int32 payload planes.
+
+    Returns, per input plane, a list of (sums [U] int32, weight, digits):
+    the plane's segment sum is sum(weight_k * sums_k), each sums_k < 2^24
+    and scatter-able with ``digits`` base-256 digit planes (ops/fused.Job).
+    Planes wider than 255 are digit-split BEFORE the prefix sum so the
+    int32 cumsum stays exact (item axis <= 2^23).
+    """
+    n = planes[0].shape[0]
+    assert n <= (1 << 23), "item axis too long for exact int32 digit cumsum"
+    split: list = []  # (plane_idx, weight)
+    cols = []
+    for p, (v, m) in enumerate(zip(planes, maxes)):
+        v = v.astype(jnp.int32)
+        if m <= 255:
+            cols.append(v)
+            split.append((p, 1))
+        else:
+            d = max(1, (int(m).bit_length() + 7) // 8)
+            for k in range(d):
+                cols.append((v >> (8 * k)) & 0xFF)
+                split.append((p, 1 << (8 * k)))
+    X = jnp.stack(cols, axis=0)  # [Pd, N] — lane-axis scan (probe-validated)
+    C = jnp.cumsum(X, axis=1)
+    Ce = C[:, ctx.seg_end].T  # [U, Pd]
+    prev = jnp.concatenate([jnp.zeros((1, Ce.shape[1]), jnp.int32), Ce[:-1]])
+    sums_d = jnp.where(ctx.live[:, None], Ce - prev, 0)  # [U, Pd], each <= 65280
+
+    # recombine: chunks of <=2 digit sums -> one scatter plane < 2^24
+    out: list = [[] for _ in planes]
+    j = 0
+    while j < len(split):
+        p, w = split[j]
+        if (
+            j + 1 < len(split)
+            and split[j + 1][0] == p
+            and split[j + 1][1] == w * 256
+        ):
+            s = sums_d[:, j] + sums_d[:, j + 1] * 256
+            out[p].append((s, w, 3))
+            j += 2
+        else:
+            out[p].append((sums_d[:, j], w, 2))
+            j += 1
+    return out
+
+
+def _two_level_max(x: jax.Array) -> jax.Array:
+    """Inclusive running max along the last axis via block scan + cross-
+    block offsets (both lane-parallel associative scans)."""
+    *lead, n = x.shape
+    pad = (-n) % BLOCK
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.full((*lead, pad), _INT_MIN, x.dtype)], axis=-1
+        )
+    nb = x.shape[-1] // BLOCK
+    r = x.reshape(*lead, nb, BLOCK)
+    within = jax.lax.associative_scan(jnp.maximum, r, axis=len(lead) + 1)
+    blast = within[..., -1]
+    cross = jax.lax.associative_scan(jnp.maximum, blast, axis=len(lead))
+    cross_excl = jnp.concatenate(
+        [jnp.full((*lead, 1), _INT_MIN, x.dtype), cross[..., :-1]], axis=-1
+    )
+    out = jnp.maximum(within, cross_excl[..., None]).reshape(*lead, -1)
+    return out[..., :n]
+
+
+def seg_excl_cumsum(head: jax.Array, values: jax.Array) -> jax.Array:
+    """Segmented EXCLUSIVE prefix sums over sorted items, int32-exact.
+
+    ``head`` marks segment starts (head[0] must be True); ``values`` is
+    [V, N] (or [N]) nonnegative int32 with sum(values) < 2^31 per row.
+    Item i receives the sum of earlier same-segment items — the batched
+    arrival-order rank of ops/rank.py, without the sort (the batch IS the
+    sorted order here).  Segments may span BLOCK boundaries (two-level
+    scan); use this for node-run ranks where runs aren't block-capped.
+    """
+    squeeze = values.ndim == 1
+    v = values[None, :] if squeeze else values
+    v = v.astype(jnp.int32)
+    C = jnp.cumsum(v, axis=1)
+    E = C - v
+    base = _two_level_max(jnp.where(head[None, :], E, _INT_MIN))
+    out = E - base
+    return out[0] if squeeze else out
+
+
+class _MinCarry(NamedTuple):
+    m: jax.Array
+    flag: jax.Array
+
+
+def seg_min_f32(ctx: SegCtx, v: jax.Array, fill: float) -> jax.Array:
+    """Per-segment minimum of a float32 plane, compacted to [U].
+
+    Segments never span BLOCK boundaries (build() inserts synthetic
+    heads), so one within-block composite scan suffices: the carry resets
+    at each head.  f32 min is order-free, so this is bit-exact.
+    """
+    n = v.shape[0]
+    assert n % BLOCK == 0, "item axis must be BLOCK-aligned"
+    nb = n // BLOCK
+    m = v.reshape(nb, BLOCK)
+    f = ctx.head.reshape(nb, BLOCK)
+
+    def op(a: _MinCarry, b: _MinCarry) -> _MinCarry:
+        return _MinCarry(
+            m=jnp.where(b.flag, b.m, jnp.minimum(a.m, b.m)),
+            flag=a.flag | b.flag,
+        )
+
+    scanned = jax.lax.associative_scan(op, _MinCarry(m=m, flag=f), axis=1)
+    inc = scanned.m.reshape(-1)
+    return jnp.where(ctx.live, inc[ctx.seg_end], fill)
+
+
+def expand(ctx: SegCtx, seg_vals: jax.Array) -> jax.Array:
+    """Broadcast per-segment values back to items: [U(,P)] -> [N(,P)].
+
+    One monotone gather (sid is sorted) — pack every per-segment quantity
+    into seg_vals' columns so the whole tick pays this once per side.
+    """
+    return seg_vals[ctx.sid]
+
+
+def sort_batch(key_cols: Sequence[jax.Array], payloads: Sequence[jax.Array]):
+    """Device-side stable sort fallback for callers without a presorted
+    batch: returns (perm, sorted_payloads).  The runtime client presorts
+    on the host (C radix argsort) and skips this."""
+    n = key_cols[0].shape[0]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
+    ops = list(key_cols) + [pos] + [p for p in payloads]
+    out = jax.lax.sort(ops, num_keys=len(key_cols), is_stable=True)
+    perm = out[len(key_cols)]
+    return perm, list(out[len(key_cols) + 1 :])
+
+
+def unsort(perm: jax.Array, cols: Sequence[jax.Array]):
+    """Restore batch order for output planes (device-side fallback)."""
+    out = jax.lax.sort(
+        [perm] + [c for c in cols], num_keys=1, is_stable=False
+    )
+    return list(out[1:])

@@ -1,0 +1,171 @@
+"""ops/segment.py vs a NumPy oracle: structure, sums, ranks, mins."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sentinel_tpu.ops import segment as SG
+
+
+def _sorted_batch(rng, n, key_space, aux_space=1):
+    k1 = np.sort(rng.integers(0, key_space, n).astype(np.int32))
+    k2 = rng.integers(0, aux_space, n).astype(np.int32)
+    # sort stably by (k1, k2) like the host presort
+    order = np.lexsort((np.arange(n), k2, k1))
+    return k1[order], k2[order]
+
+
+def _oracle_segments(k1, k2):
+    n = len(k1)
+    head = np.zeros(n, bool)
+    head[0] = True
+    head[1:] = (k1[1:] != k1[:-1]) | (k2[1:] != k2[:-1])
+    head |= np.arange(n) % SG.BLOCK == 0
+    sid = np.cumsum(head) - 1
+    return head, sid
+
+
+@pytest.mark.parametrize("n,space", [(1024, 37), (2048, 500), (512, 2)])
+def test_build_structure(n, space):
+    rng = np.random.default_rng(0)
+    k1, k2 = _sorted_batch(rng, n, space, aux_space=3)
+    head_o, sid_o = _oracle_segments(k1, k2)
+    U = int(sid_o[-1]) + 1 + 8
+    ctx = SG.build([jnp.asarray(k1), jnp.asarray(k2)], U)
+    assert bool(ctx.ok)
+    np.testing.assert_array_equal(np.asarray(ctx.head), head_o)
+    np.testing.assert_array_equal(np.asarray(ctx.sid), sid_o)
+    assert int(ctx.n_seg) == sid_o[-1] + 1
+    seg_end = np.asarray(ctx.seg_end)
+    live = np.asarray(ctx.live)
+    for s in range(sid_o[-1] + 1):
+        assert live[s]
+        assert seg_end[s] == np.max(np.nonzero(sid_o == s))
+    assert not live[sid_o[-1] + 1 :].any()
+
+
+def test_build_overflow_flags_not_ok():
+    rng = np.random.default_rng(1)
+    k1, k2 = _sorted_batch(rng, 1024, 900)
+    ctx = SG.build([jnp.asarray(k1)], 16)
+    assert not bool(ctx.ok)
+
+
+def test_compact_and_expand():
+    rng = np.random.default_rng(2)
+    k1, k2 = _sorted_batch(rng, 1024, 100)
+    head_o, sid_o = _oracle_segments(k1, k2)
+    U = int(sid_o[-1]) + 1 + 4
+    ctx = SG.build([jnp.asarray(k1)], U)
+    # k1 is constant per segment -> compaction then expansion round-trips
+    c = SG.compact(ctx, jnp.asarray(k1), fill=-1)
+    back = SG.expand(ctx, c)
+    np.testing.assert_array_equal(np.asarray(back), k1)
+    # 2-D variant
+    arr2 = jnp.stack([jnp.asarray(k1), jnp.asarray(k1) * 7], axis=1)
+    c2 = SG.compact(ctx, arr2, fill=0)
+    np.testing.assert_array_equal(np.asarray(SG.expand(ctx, c2))[:, 1], k1 * 7)
+
+
+@pytest.mark.parametrize("maxes", [(255,), (65535,), (255, 40000, 16_000_000)])
+def test_seg_sums_exact(maxes):
+    rng = np.random.default_rng(3)
+    n = 2048
+    k1, _ = _sorted_batch(rng, n, 61)
+    head_o, sid_o = _oracle_segments(k1, k1 * 0)
+    U = int(sid_o[-1]) + 1 + 4
+    ctx = SG.build([jnp.asarray(k1)], U)
+    planes = [rng.integers(0, m + 1, n).astype(np.int32) for m in maxes]
+    outs = SG.seg_sums(ctx, [jnp.asarray(p) for p in planes], list(maxes))
+    for p, (plane, chunks) in enumerate(zip(planes, outs)):
+        total = np.zeros(U, np.int64)
+        for arr, w, digits in chunks:
+            a = np.asarray(arr).astype(np.int64)
+            assert a.max() < (1 << 24)
+            assert a.max() < 256**digits
+            total += a * w
+        want = np.zeros(U, np.int64)
+        np.add.at(want, sid_o, plane)
+        np.testing.assert_array_equal(total, want)
+
+
+def test_seg_excl_cumsum_matches_rank_oracle():
+    rng = np.random.default_rng(4)
+    n = 4096
+    k1, _ = _sorted_batch(rng, n, 19)  # long runs spanning blocks
+    # node-run heads WITHOUT block caps (the flow-rank use)
+    head = np.zeros(n, bool)
+    head[0] = True
+    head[1:] = k1[1:] != k1[:-1]
+    vals = rng.integers(0, 255, (3, n)).astype(np.int32)
+    got = np.asarray(
+        SG.seg_excl_cumsum(jnp.asarray(head), jnp.asarray(vals))
+    )
+    want = np.zeros_like(vals)
+    for row in range(3):
+        acc = {}
+        for i in range(n):
+            kk = k1[i]
+            want[row, i] = acc.get(kk, 0)
+            acc[kk] = acc.get(kk, 0) + vals[row, i]
+    np.testing.assert_array_equal(got, want)
+    # 1-D form
+    got1 = np.asarray(SG.seg_excl_cumsum(jnp.asarray(head), jnp.asarray(vals[0])))
+    np.testing.assert_array_equal(got1, want[0])
+
+
+def test_seg_min_f32():
+    rng = np.random.default_rng(5)
+    n = 2048
+    k1, _ = _sorted_batch(rng, n, 97)
+    head_o, sid_o = _oracle_segments(k1, k1 * 0)
+    U = int(sid_o[-1]) + 1 + 4
+    ctx = SG.build([jnp.asarray(k1)], U)
+    v = rng.random(n).astype(np.float32) * 100
+    got = np.asarray(SG.seg_min_f32(ctx, jnp.asarray(v), fill=1e30))
+    want = np.full(U, 1e30, np.float32)
+    np.minimum.at(want, sid_o, v)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sort_unsort_roundtrip():
+    rng = np.random.default_rng(6)
+    n = 1024
+    keys = rng.integers(0, 50, n).astype(np.int32)
+    payload = rng.integers(0, 1000, n).astype(np.int32)
+    perm, (sp,) = SG.sort_batch([jnp.asarray(keys)], [jnp.asarray(payload)])
+    perm_np = np.asarray(perm)
+    np.testing.assert_array_equal(np.asarray(sp), payload[perm_np])
+    # stability: equal keys keep arrival order
+    assert all(
+        perm_np[i] < perm_np[i + 1]
+        for i in range(n - 1)
+        if keys[perm_np[i]] == keys[perm_np[i + 1]]
+    )
+    (restored,) = SG.unsort(perm, [sp])
+    np.testing.assert_array_equal(np.asarray(restored), payload)
+
+
+def test_seg_sums_respects_block_cap():
+    # one giant run: blocks force segment breaks every BLOCK items so no
+    # digit-plane segment sum exceeds 255*BLOCK
+    n = 4 * SG.BLOCK
+    k1 = np.zeros(n, np.int32)
+    ctx = SG.build([jnp.asarray(k1)], 8)
+    assert int(ctx.n_seg) == 4
+    planes = [np.full(n, 255, np.int32)]
+    outs = SG.seg_sums(ctx, [jnp.asarray(planes[0])], [255])
+    (arr, w, digits) = outs[0][0]
+    assert int(np.asarray(arr).max()) == 255 * SG.BLOCK
+    assert digits == 3 or int(np.asarray(arr).max()) < 256**digits
+
+
+def test_build_capacity_exceeds_batch():
+    # U > N must still produce [U]-shaped outputs (short tail batches)
+    k1 = np.sort(np.random.default_rng(7).integers(0, 5, 64)).astype(np.int32)
+    ctx = SG.build([jnp.asarray(k1)], 128)
+    assert ctx.U == 128 and ctx.seg_end.shape == (128,)
+    c = SG.compact(ctx, jnp.asarray(k1), fill=-1)
+    assert c.shape == (128,)
+    assert bool(ctx.ok)
