@@ -138,6 +138,13 @@ class EngineConfig:
     param_bucket_ms: int = 500
     param_classes: int = 4
     param_dims: int = 2
+    # digit planes of the hot-param windowed estimate gather: estimates
+    # saturate at 256^d - 1, so thresholds >= that per window cannot trip
+    # (enforcement stays EXACT for thresholds below it — saturation only
+    # over-estimates).  Default 3 preserves the historical ~16.7M cap;
+    # deployments with per-value thresholds under 65535/window can set 2
+    # for 1/3 less gather cost (the benchmark config does).
+    param_est_digits: int = 3
     # top-k tracking for hot params
     topk_k: int = 32
     # statistic max RT clamp (SentinelConfig.java:63)
@@ -161,6 +168,23 @@ class EngineConfig:
     # clamp larger counts at entry.  The unfused paths remain exact to
     # 65535 regardless.
     max_batch_count: int = 255
+    # segment-compacted effects (ops/engine_seg.py): contract scatter
+    # payloads per key-run segment instead of per item — ~10x fewer MXU
+    # digit-dot items on Zipf traffic when the host presorts batches by
+    # resource.  Requires fused_effects; falls back per-tick to the
+    # per-item kernels when live segments exceed seg_u (bit-identical
+    # either way, sorted or not).
+    seg_effects: bool = False
+    seg_u: int = 0  # compacted-axis capacity; 0 = auto (~B/8 + B/256)
+    # True compiles BOTH the compacted and per-item effect paths and picks
+    # per tick (lax.cond on live-segment count) — always exact.  False
+    # compiles ONLY the compacted path: when live segments exceed seg_u,
+    # the overflow segments' EFFECTS are dropped (windows under-count;
+    # rule checks still run) and TickOutput.seg_dropped reports the
+    # dropped item count.  Use only when the caller presorts batches and
+    # sizes seg_u with headroom; halves the effects code size, which the
+    # tunnel-attached benchmark needs (program-cache thrash)
+    seg_fallback: bool = True
     # global stats sketch: resources beyond the exact row space get sketch
     # ids and windowed CMS observability instead of pass-through (ops/
     # gsketch.py) — tick cost independent of resource count

@@ -167,6 +167,12 @@ class CompleteBatch(NamedTuple):
 class TickOutput(NamedTuple):
     verdict: jax.Array  # int8 [B] PASS / BLOCK_* / PASS_WAIT
     wait_ms: jax.Array  # int32 [B] pacing delay for PASS_WAIT
+    # items whose EFFECTS were dropped by segment-capacity overflow (only
+    # ever nonzero with seg_effects=True, seg_fallback=False; verdicts are
+    # still exact).  Callers monitoring this can resize seg_u or re-enable
+    # the fallback.  (Plain-int default: a jnp scalar here would initialize
+    # the backend at import time.)
+    seg_dropped: object = 0  # int32 scalar on the seg path
 
 
 # ---------------------------------------------------------------------------
@@ -1247,9 +1253,11 @@ def _check_system(
 
     inbound = (acq.inbound > 0) & eligible
     cnt = acq.count.astype(jnp.float32)
-    # single group (the global ENTRY node) → plain exclusive prefix sum
-    vim = jnp.where(inbound, cnt, 0.0)
-    rank_q = fast_cumsum(vim) - vim
+    # single group (the global ENTRY node) → plain exclusive prefix sum.
+    # Integer cumsum: exact to 2^31 (the f32 MXU prefix lost exactness at
+    # 2^24 and cost ~0.6 ms at B=128K)
+    vim_i = jnp.where(inbound, acq.count, 0)
+    rank_q = (jnp.cumsum(vim_i) - vim_i).astype(jnp.float32)
     rank_t = rank_q  # one concurrent slot per inbound attempt (count≈1)
 
     s = rules.system
@@ -1552,7 +1560,14 @@ def _check_flow(
     pace_qps = jnp.where(
         behavior == CONTROL_WARM_UP_RATE_LIMITER, warm_qps, jnp.maximum(rcount, 1e-9)
     )
-    cost = jnp.where(is_rl, jnp.floor(1000.0 * cnt / pace_qps + 0.5), 0.0)
+    # clamp pacing cost to the fused effects path's 3-digit envelope
+    # (~4.6 h of pacing per item — larger is unreal and would overflow the
+    # int32 segmented ranks); the clamped item still blocks via rl_wait
+    cost = jnp.where(
+        is_rl,
+        jnp.minimum(jnp.floor(1000.0 * cnt / pace_qps + 0.5), float((1 << 24) - 1)),
+        0.0,
+    )
 
     # --- within-tick ranks (key: decision node; RL keys by rule slot)
     key = jnp.where(is_rl, jnp.int32(cfg.node_rows) + slots_f, node_safe)
@@ -1885,39 +1900,32 @@ ALL_FEATURES = frozenset(
 )
 
 
-def tick(
+def _run_checks_plain(
+    cfg: EngineConfig,
     state: EngineState,
     rules: RuleSet,
     acq: AcquireBatch,
-    comp: CompleteBatch,
-    now_ms: jax.Array,  # int32 scalar, engine epoch ms
-    sys_load: jax.Array,  # float32 scalar — host-sampled load average
-    sys_cpu: jax.Array,  # float32 scalar — host-sampled CPU usage [0,1]
-    cfg: EngineConfig,
-    features: frozenset = ALL_FEATURES,
-) -> Tuple[EngineState, TickOutput]:
-    """One engine tick: completions, then batched decisions, then effects."""
+    now_ms,
+    sys_load,
+    sys_cpu,
+    valid,
+    forced,
+    features: frozenset,
+):
+    """The per-item check phase (Authority -> System -> ParamFlow -> Flow
+    (+tail) -> Degrade, first-fail order), extracted so the segment engine
+    can lax.cond against it.  Returns
+
+      (auth_block, sys_block, param_block, param_state, flow_block,
+       wait_ms, occupying, occ_grant, fslots, rl_info, degrade_block,
+       cb_state, latest_passed)
+
+    with param_state = (pcms, pcms_epochs, pcms_idx, prows, qps_add,
+    thread_add) or None, and every *_block already masked by its stage's
+    eligibility."""
     b = acq.res.shape[0]
-    now_ms = now_ms.astype(jnp.int32)
     zero_block = jnp.zeros((b,), bool)
 
-    # 1. exits first: they release concurrency and update breakers
-    if _use_fused(cfg):
-        state = _process_completions_fused(cfg, state, rules, comp, now_ms, features)
-    else:
-        state = _process_completions(cfg, state, rules, comp, now_ms, features)
-
-    # 2. warm-up token sync (per second, vectorized over rules)
-    if "warmup" in features:
-        state = _sync_warmup(cfg, state, rules, now_ms)
-    if "occupy" in features and "flow" in features:
-        state = _fold_occupied(cfg, state, now_ms)
-
-    valid = acq.res != cfg.trash_row
-    forced = valid & (acq.pre_verdict > 0)
-
-    # 3. rule checks in reference slot order; each stage's blocks remove
-    #    the item from later stages' rank accounting
     if "authority" in features:
         auth_block = _check_authority(cfg, rules, acq) & valid & ~forced
     else:
@@ -1943,8 +1951,10 @@ def tick(
             p_thread_add,
         ) = _check_param(cfg, state, rules, acq, now_ms, eligible)
         param_block = param_block & eligible
+        param_state = (pcms, pcms_epochs, pcms_idx, prows, p_qps_add, p_thread_add)
     else:
         param_block = zero_block
+        param_state = None
     eligible = eligible & ~param_block
 
     if "flow" in features:
@@ -1961,14 +1971,13 @@ def tick(
         )
         flow_block = flow_block & eligible
         occupying = occupying & eligible
-        if latest_passed is not None:
-            state = state._replace(latest_passed_ms=latest_passed)
     else:
         flow_block = zero_block
         occupying = zero_block
         occ_grant = None
         fslots = None
         rl_info = None
+        latest_passed = None
         wait_ms = jnp.zeros((b,), jnp.int32)
     if "tail_flow" in features and cfg.sketch_stats:
         tail_block = _check_tail_flow(cfg, state, rules, acq, now_ms, eligible)
@@ -1980,9 +1989,134 @@ def tick(
             cfg, state, rules, acq, now_ms, eligible
         )
         degrade_block = degrade_block & eligible
-        state = state._replace(cb_state=cb_state)
     else:
         degrade_block = zero_block
+        cb_state = state.cb_state
+
+    return (
+        auth_block,
+        sys_block,
+        param_block,
+        param_state,
+        flow_block,
+        wait_ms,
+        occupying,
+        occ_grant,
+        fslots,
+        rl_info,
+        degrade_block,
+        cb_state,
+        latest_passed,
+    )
+
+
+def tick(
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    comp: CompleteBatch,
+    now_ms: jax.Array,  # int32 scalar, engine epoch ms
+    sys_load: jax.Array,  # float32 scalar — host-sampled load average
+    sys_cpu: jax.Array,  # float32 scalar — host-sampled CPU usage [0,1]
+    cfg: EngineConfig,
+    features: frozenset = ALL_FEATURES,
+) -> Tuple[EngineState, TickOutput]:
+    """One engine tick: completions, then batched decisions, then effects."""
+    b = acq.res.shape[0]
+    now_ms = now_ms.astype(jnp.int32)
+    zero_block = jnp.zeros((b,), bool)
+
+    # segment-compacted effects (ops/engine_seg.py): build the key-run
+    # structure once per side; each effects phase lax.cond-falls back to
+    # the per-item kernels when live segments exceed capacity
+    use_seg = cfg.seg_effects and _use_fused(cfg)
+    if use_seg:
+        # binds ES for every use_seg-guarded block below (checks, effects)
+        from sentinel_tpu.ops import engine_seg as ES
+
+        ctx_c, carry_c = ES.prepare_completions(cfg, comp, features)
+        ctx_a, carry_a = ES.prepare_acquire(cfg, acq)
+
+    # 1. exits first: they release concurrency and update breakers
+    seg_dropped = jnp.int32(0)
+    if use_seg:
+        if cfg.seg_fallback:
+            state = jax.lax.cond(
+                ctx_c.ok,
+                lambda: ES.process_completions_seg(
+                    cfg, state, rules, comp, now_ms, features, ctx_c, carry_c
+                ),
+                lambda: _process_completions_fused(
+                    cfg, state, rules, comp, now_ms, features
+                ),
+            )
+        else:
+            state = ES.process_completions_seg(
+                cfg, state, rules, comp, now_ms, features, ctx_c, carry_c
+            )
+            seg_dropped = seg_dropped + ES.dropped_items(ctx_c)
+    elif _use_fused(cfg):
+        state = _process_completions_fused(cfg, state, rules, comp, now_ms, features)
+    else:
+        state = _process_completions(cfg, state, rules, comp, now_ms, features)
+
+    # 2. warm-up token sync (per second, vectorized over rules)
+    if "warmup" in features:
+        state = _sync_warmup(cfg, state, rules, now_ms)
+    if "occupy" in features and "flow" in features:
+        state = _fold_occupied(cfg, state, now_ms)
+
+    valid = acq.res != cfg.trash_row
+    forced = valid & (acq.pre_verdict > 0)
+
+    # 3. rule checks in reference slot order; each stage's blocks remove
+    #    the item from later stages' rank accounting.  With segmented
+    #    effects + single-rule lanes the whole phase switches between the
+    #    segment-level implementation (ops/engine_seg.run_checks_seg) and
+    #    this per-item one — verdicts are exact in both.
+    seg_checks = (
+        use_seg
+        and cfg.flow_rules_per_resource == 1
+        and cfg.degrade_rules_per_resource == 1
+        and cfg.param_rules_per_resource == 1
+    )
+    if seg_checks:
+        checks = jax.lax.cond(
+            ctx_a.ok,
+            lambda: ES.run_checks_seg(
+                cfg, state, rules, acq, now_ms, sys_load, sys_cpu,
+                valid, forced, ctx_a, carry_a, features,
+            ),
+            lambda: _run_checks_plain(
+                cfg, state, rules, acq, now_ms, sys_load, sys_cpu,
+                valid, forced, features,
+            ),
+        )
+    else:
+        checks = _run_checks_plain(
+            cfg, state, rules, acq, now_ms, sys_load, sys_cpu,
+            valid, forced, features,
+        )
+    (
+        auth_block,
+        sys_block,
+        param_block,
+        param_state,
+        flow_block,
+        wait_ms,
+        occupying,
+        occ_grant,
+        fslots,
+        rl_info,
+        degrade_block,
+        cb_state,
+        latest_passed,
+    ) = checks
+    state = state._replace(cb_state=cb_state)
+    if latest_passed is not None:
+        state = state._replace(latest_passed_ms=latest_passed)
+    if "param" in features:
+        (pcms, pcms_epochs, pcms_idx, prows, p_qps_add, p_thread_add) = param_state
 
     passed = valid & ~forced & ~(
         auth_block | sys_block | param_block | flow_block | degrade_block
@@ -2028,22 +2162,47 @@ def tick(
         param_ctx = None
         if "param" in features:
             param_ctx = (pcms, pcms_epochs, pcms_idx, prows, p_qps_add, p_thread_add)
-        state = _acquire_effects_fused(
-            cfg,
-            state,
-            rules,
-            acq,
-            now_ms,
-            features,
-            passed,
-            occupying,
-            valid,
-            fslots,
-            occ_grant,
-            rl_info,
-            param_ctx,
+        if use_seg:
+            if cfg.seg_fallback:
+                state = jax.lax.cond(
+                    ctx_a.ok,
+                    lambda: ES.acquire_effects_seg(
+                        cfg, state, rules, acq, now_ms, features, passed,
+                        occupying, valid, fslots, occ_grant, rl_info,
+                        param_ctx, ctx_a, carry_a,
+                    ),
+                    lambda: _acquire_effects_fused(
+                        cfg, state, rules, acq, now_ms, features, passed,
+                        occupying, valid, fslots, occ_grant, rl_info,
+                        param_ctx,
+                    ),
+                )
+            else:
+                state = ES.acquire_effects_seg(
+                    cfg, state, rules, acq, now_ms, features, passed,
+                    occupying, valid, fslots, occ_grant, rl_info,
+                    param_ctx, ctx_a, carry_a,
+                )
+                seg_dropped = seg_dropped + ES.dropped_items(ctx_a)
+        else:
+            state = _acquire_effects_fused(
+                cfg,
+                state,
+                rules,
+                acq,
+                now_ms,
+                features,
+                passed,
+                occupying,
+                valid,
+                fslots,
+                occ_grant,
+                rl_info,
+                param_ctx,
+            )
+        return state, TickOutput(
+            verdict=verdict, wait_ms=wait_ms, seg_dropped=seg_dropped
         )
-        return state, TickOutput(verdict=verdict, wait_ms=wait_ms)
 
     with_nodes = "nodes" in features
     rows = _stat_rows(cfg, acq.res, acq.ctx_node, acq.origin_node, with_nodes)

@@ -182,13 +182,14 @@ def scatter_many(jobs: Sequence[Job], tb: int = TILE, interpret: Optional[bool] 
     vals_all = _pad_axis(jnp.concatenate(val_stack, axis=0), 1, Np, 0)
     SR = rows_all.shape[0]
     SV = vals_all.shape[0]
-    ins = [
-        rows_all.reshape(SR, nT, tb).transpose(1, 0, 2),
-        vals_all.reshape(SV, nT, tb).transpose(1, 0, 2),
-    ]
+    # 2-D blocks over the natural [S, Np] stacks: the tile axis is sliced
+    # by the index map, so kernel inputs need no layout transpose — the
+    # old [nT, S, tb] form cost a ~0.1 ms HBM copy per stacked input at
+    # B=128K (profiled)
+    ins = [rows_all, vals_all]
     in_specs = [
-        pl.BlockSpec((1, SR, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, SV, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((SR, tb), lambda t: (0, t), memory_space=pltpu.VMEM),
+        pl.BlockSpec((SV, tb), lambda t: (0, t), memory_space=pltpu.VMEM),
     ]
 
     def kernel(*refs):
@@ -206,7 +207,7 @@ def scatter_many(jobs: Sequence[Job], tb: int = TILE, interpret: Optional[bool] 
         for ji, (R, P, per_row, n_hi, pd, digits, n, roff, voff) in enumerate(plans):
             iota_h = jax.lax.broadcasted_iota(jnp.int32, (n_hi, tb), 0)
             for r in range(R):
-                k = rows_ref[0, roff + r, :]
+                k = rows_ref[roff + r, :]
                 ok = (k >= 0) & (k < n)
                 safe = jnp.where(ok, k, 0)
                 hi = safe // N_LO
@@ -218,7 +219,7 @@ def scatter_many(jobs: Sequence[Job], tb: int = TILE, interpret: Optional[bool] 
                 Lo = (lo[:, None] == iota_l).astype(jnp.bfloat16)
                 pdoff = 0
                 for p in range(P):
-                    v = vals_ref[0, voff + (r * P + p if per_row else p), :]
+                    v = vals_ref[voff + (r * P + p if per_row else p), :]
                     for d in range(digits[p]):
                         dig = ((v >> (8 * d)) & 0xFF)[:, None].astype(jnp.bfloat16)
                         orefs[ji][pdoff, :, :] += jax.lax.dot(
@@ -308,9 +309,9 @@ def gather_many(
         plans.append((P, n_hi, pd, tuple(j.digits), n))
 
         ids_p = _pad_axis(j.ids.astype(jnp.int32)[None, :], 1, Np, -1)
-        ins.append(ids_p.reshape(1, nT, tb).transpose(1, 0, 2))
+        ins.append(ids_p)
         in_specs.append(
-            pl.BlockSpec((1, 1, tb), lambda t: (t, 0, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec((1, tb), lambda t: (0, t), memory_space=pltpu.VMEM)
         )
         # digit planes of the table: [pd, n_hi, N_LO] bf16
         t32 = j.table.astype(jnp.int32)
@@ -340,7 +341,7 @@ def gather_many(
             ids_ref = nrefs[ri]
             tab_ref = nrefs[ri + 1]
             ri += 2
-            k = ids_ref[0, 0, :]
+            k = ids_ref[0, :]
             ok = (k >= 0) & (k < n)
             safe = jnp.where(ok, k, 0)
             hi = safe // N_LO

@@ -128,19 +128,20 @@ def estimate(
         == jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
     ).astype(jnp.float32)
     ests = []
-    cap = jnp.int32((1 << 24) - 1)
+    cap_i = 256**cfg.param_est_digits - 1
+    cap = jnp.int32(cap_i)
     for d in range(wtab.shape[0]):
-        # saturate at 2^24-1 before the digit-plane gather: values beyond
-        # would WRAP (dropping high bits) and flip the CMS overestimate
-        # into an underestimate; saturation keeps enforcement conservative
-        # for any threshold below ~16.7M-per-window (thresholds above that
-        # cannot trip and are documented as unenforceable)
+        # saturate at the configured digit bound before the digit-plane
+        # gather: values beyond would WRAP (dropping high bits) and flip
+        # the CMS overestimate into an underestimate; saturation keeps
+        # enforcement conservative for any threshold below the cap
+        # (thresholds above it cannot trip — cfg.param_est_digits)
         g = T.big_gather(
             cfg,
             jnp.minimum(wtab[d].astype(jnp.int32), cap),
             rows[:, d],
             cfg.param_width,
-            max_int=(1 << 24) - 1,
+            max_int=cap_i,
         )  # [N, C]
         ests.append(jnp.sum(g.astype(jnp.float32) * cls_oh, axis=1))
     return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
@@ -158,13 +159,14 @@ def estimate_fused(
     from sentinel_tpu.ops import fused as FU
 
     C = wtab.shape[2]
-    cap = jnp.int32((1 << 24) - 1)
+    nd = cfg.param_est_digits
+    cap = jnp.int32(256**nd - 1)
     jobs = [
         FU.GatherJob(
             f"pest{d}",
             rows[:, d],
             jnp.minimum(wtab[d].astype(jnp.int32), cap),
-            (3,) * C,
+            (nd,) * C,
         )
         for d in range(wtab.shape[0])
     ]

@@ -78,14 +78,25 @@ def heads_from_keys(*cols: jax.Array) -> jax.Array:
     return change | (pos % BLOCK == 0)
 
 
-def build(key_cols: Sequence[jax.Array], U: int) -> SegCtx:
+def build(key_cols: Sequence[jax.Array], U: int, payloads: Sequence[jax.Array] = ()):
     """Segment structure for a batch sorted by ``key_cols`` (stably).
 
-    One 2-operand sort compacts segment-end positions into [U]; when the
-    live segment count exceeds U, ``ok`` is False and the caller must take
-    its uncompacted fallback (compacted outputs would drop segments).
+    One sort compacts segment-end positions into [U]; when the live
+    segment count exceeds U, ``ok`` is False and the caller must take its
+    uncompacted fallback (compacted outputs would drop segments).
+
+    ``payloads``: per-item columns to compact THROUGH the sort — the
+    returned [U] arrays hold each segment's value at its last item
+    (exactly ``compact(ctx, p)`` but without the extra per-column [U]
+    gathers, which cost ~0.11 ms each at B=128K).  Dead slots carry junk;
+    mask with ctx.live.  Returns (ctx, compacted_payloads).
     """
     head = heads_from_keys(*key_cols)
+    return build_from_head(head, U, payloads)
+
+
+def build_from_head(head: jax.Array, U: int, payloads: Sequence[jax.Array] = ()):
+    """build() for a precomputed head vector (see heads_from_keys)."""
     n = head.shape[0]
     sid = jnp.cumsum(head.astype(jnp.int32)) - 1
     n_seg = sid[-1] + 1
@@ -93,13 +104,23 @@ def build(key_cols: Sequence[jax.Array], U: int) -> SegCtx:
     tail = jnp.concatenate([head[1:], jnp.ones((1,), bool)])
     pos = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
     skey = jnp.where(tail & (sid < U), sid, _INT_MAX)
-    skeys, spos = jax.lax.sort([skey, pos], num_keys=1, is_stable=False)
+    out = jax.lax.sort(
+        [skey, pos] + [p for p in payloads], num_keys=1, is_stable=False
+    )
+    skeys, spos = out[0], out[1]
+    comp = list(out[2:])
     if U > n:  # short batches still produce [U]-shaped compacted outputs
         skeys = jnp.concatenate([skeys, jnp.full((U - n,), _INT_MAX, jnp.int32)])
         spos = jnp.concatenate([spos, jnp.zeros((U - n,), jnp.int32)])
+        comp = [
+            jnp.concatenate([c, jnp.zeros((U - n,), c.dtype)]) for c in comp
+        ]
     seg_end = spos[:U]
     live = skeys[:U] != _INT_MAX
-    return SegCtx(head=head, sid=sid, n_seg=n_seg, ok=ok, seg_end=seg_end, live=live)
+    ctx = SegCtx(
+        head=head, sid=sid, n_seg=n_seg, ok=ok, seg_end=seg_end, live=live
+    )
+    return ctx, [c[:U] for c in comp]
 
 
 def compact(ctx: SegCtx, arr: jax.Array, fill=0) -> jax.Array:
@@ -112,19 +133,14 @@ def compact(ctx: SegCtx, arr: jax.Array, fill=0) -> jax.Array:
     return jnp.where(mask, g, fill)
 
 
-def seg_sums(
-    ctx: SegCtx,
-    planes: Sequence[jax.Array],  # each int32 [N], values in [0, maxes[p]]
-    maxes: Sequence[int],
-) -> list:
-    """Exact per-segment sums of int32 payload planes.
+def cum_cols(planes: Sequence[jax.Array], maxes: Sequence[int]):
+    """Digit-split payload planes + exact int32 prefix sums.
 
-    Returns, per input plane, a list of (sums [U] int32, weight, digits):
-    the plane's segment sum is sum(weight_k * sums_k), each sums_k < 2^24
-    and scatter-able with ``digits`` base-256 digit planes (ops/fused.Job).
-    Planes wider than 255 are digit-split BEFORE the prefix sum so the
-    int32 cumsum stays exact (item axis <= 2^23).
-    """
+    Returns (C_rows: list of [N] int32 inclusive cumsums, split: list of
+    (plane_idx, weight)).  Planes wider than 255 are digit-split BEFORE
+    the prefix sum so the int32 cumsum stays exact (item axis <= 2^23).
+    Feed the C_rows through build()'s payload sort (or gather them at
+    seg_end) and hand the per-segment values to sums_from_ce."""
     n = planes[0].shape[0]
     assert n <= (1 << 23), "item axis too long for exact int32 digit cumsum"
     split: list = []  # (plane_idx, weight)
@@ -139,14 +155,24 @@ def seg_sums(
             for k in range(d):
                 cols.append((v >> (8 * k)) & 0xFF)
                 split.append((p, 1 << (8 * k)))
-    X = jnp.stack(cols, axis=0)  # [Pd, N] — lane-axis scan (probe-validated)
-    C = jnp.cumsum(X, axis=1)
-    Ce = C[:, ctx.seg_end].T  # [U, Pd]
-    prev = jnp.concatenate([jnp.zeros((1, Ce.shape[1]), jnp.int32), Ce[:-1]])
-    sums_d = jnp.where(ctx.live[:, None], Ce - prev, 0)  # [U, Pd], each <= 65280
+    C = jnp.cumsum(jnp.stack(cols, axis=0), axis=1)  # [Pd, N]
+    return [C[i] for i in range(C.shape[0])], split
 
-    # recombine: chunks of <=2 digit sums -> one scatter plane < 2^24
-    out: list = [[] for _ in planes]
+
+def sums_from_ce(ctx: SegCtx, ce_cols: Sequence[jax.Array], split) -> list:
+    """Per-segment sums from compacted cumsum columns (each [U] int32,
+    the cumsum value at each segment's last item).
+
+    Returns, per input plane, a list of (sums [U] int32, weight, digits):
+    the plane's segment sum is sum(weight_k * sums_k), each sums_k < 2^24
+    and scatter-able with ``digits`` base-256 digit planes (ops/fused.Job).
+    """
+    Ce = jnp.stack(ce_cols, axis=1)  # [U, Pd]
+    prev = jnp.concatenate([jnp.zeros((1, Ce.shape[1]), jnp.int32), Ce[:-1]])
+    sums_d = jnp.where(ctx.live[:, None], Ce - prev, 0)  # each <= 255*BLOCK
+
+    n_planes = max(p for p, _ in split) + 1
+    out: list = [[] for _ in range(n_planes)]
     j = 0
     while j < len(split):
         p, w = split[j]
@@ -162,6 +188,21 @@ def seg_sums(
             out[p].append((sums_d[:, j], w, 2))
             j += 1
     return out
+
+
+def seg_sums(
+    ctx: SegCtx,
+    planes: Sequence[jax.Array],  # each int32 [N], values in [0, maxes[p]]
+    maxes: Sequence[int],
+) -> list:
+    """Exact per-segment sums of int32 payload planes (cum_cols +
+    ONE packed row gather at seg_end + sums_from_ce).  Callers that know
+    their planes before build() should carry the cum_cols through the
+    build sort instead (cheaper)."""
+    C_rows, split = cum_cols(planes, maxes)
+    CT = jnp.stack(C_rows, axis=1)  # [N, Pd] — one packed row gather
+    Ce = CT[ctx.seg_end]
+    return sums_from_ce(ctx, [Ce[:, i] for i in range(Ce.shape[1])], split)
 
 
 def _two_level_max(x: jax.Array) -> jax.Array:
@@ -205,23 +246,38 @@ def seg_excl_cumsum(head: jax.Array, values: jax.Array) -> jax.Array:
     return out[0] if squeeze else out
 
 
+def seg_excl_cumsum_wide(head: jax.Array, values: jax.Array) -> jax.Array:
+    """seg_excl_cumsum for values whose batch total may overflow int32
+    (e.g. rate-limiter pacing costs, <= 2^24 each): two 12-bit digit
+    lanes, recombined as f32 AFTER the exact integer differences — one
+    rounding instead of the accumulated rounding of an f32 prefix sum."""
+    v = values.astype(jnp.int32)
+    lo = v & 0xFFF
+    hi = v >> 12
+    r = seg_excl_cumsum(head, jnp.stack([lo, hi]))
+    return r[1].astype(jnp.float32) * 4096.0 + r[0].astype(jnp.float32)
+
+
 class _MinCarry(NamedTuple):
     m: jax.Array
     flag: jax.Array
 
 
-def seg_min_f32(ctx: SegCtx, v: jax.Array, fill: float) -> jax.Array:
-    """Per-segment minimum of a float32 plane, compacted to [U].
+def block_min_inclusive(head: jax.Array, v: jax.Array, fill: float) -> jax.Array:
+    """Within-segment inclusive running minimum, [N] -> [N].
 
-    Segments never span BLOCK boundaries (build() inserts synthetic
-    heads), so one within-block composite scan suffices: the carry resets
-    at each head.  f32 min is order-free, so this is bit-exact.
-    """
+    Requires segments that never span BLOCK boundaries (build() inserts
+    synthetic heads), so one within-block composite scan suffices: the
+    carry resets at each head.  f32 min is order-free, so this is
+    bit-exact.  The value at each segment's LAST item is the segment min
+    — carry this through build()'s payload sort or read it at seg_end."""
     n = v.shape[0]
-    assert n % BLOCK == 0, "item axis must be BLOCK-aligned"
-    nb = n // BLOCK
-    m = v.reshape(nb, BLOCK)
-    f = ctx.head.reshape(nb, BLOCK)
+    pad = (-n) % BLOCK
+    vp = jnp.concatenate([v, jnp.full((pad,), fill, v.dtype)]) if pad else v
+    hp = jnp.concatenate([head, jnp.ones((pad,), bool)]) if pad else head
+    nb = vp.shape[0] // BLOCK
+    m = vp.reshape(nb, BLOCK)
+    f = hp.reshape(nb, BLOCK)
 
     def op(a: _MinCarry, b: _MinCarry) -> _MinCarry:
         return _MinCarry(
@@ -230,7 +286,12 @@ def seg_min_f32(ctx: SegCtx, v: jax.Array, fill: float) -> jax.Array:
         )
 
     scanned = jax.lax.associative_scan(op, _MinCarry(m=m, flag=f), axis=1)
-    inc = scanned.m.reshape(-1)
+    return scanned.m.reshape(-1)[:n]
+
+
+def seg_min_f32(ctx: SegCtx, v: jax.Array, fill: float) -> jax.Array:
+    """Per-segment minimum of a float32 plane, compacted to [U]."""
+    inc = block_min_inclusive(ctx.head, v, fill)
     return jnp.where(ctx.live, inc[ctx.seg_end], fill)
 
 

@@ -23,6 +23,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: a persistent jax compilation cache was tried here to cut the
+# suite's re-jit cost (VERDICT r3 weak #9) but the CPU backend segfaults
+# deserializing cached executables on the second run (jaxlib
+# compilation_cache.get_executable_and_time) — do not re-enable without
+# verifying a double run passes.
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,149 @@
+"""Segment-compacted effects (ops/engine_seg.py) vs the per-item fused
+path: full-tick bit-identity, with and without capacity fallback.
+
+Runs on CPU with Pallas interpret kernels — semantics only; device speed
+is bench.py's job.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sentinel_tpu.core.config import small_engine_config
+from tests.test_fused import _tick_once
+
+_BASELINE_CACHE: dict = {}
+
+
+def _baseline(sketch: bool, base: dict):
+    if sketch not in _BASELINE_CACHE:
+        _BASELINE_CACHE[sketch] = _tick_once(small_engine_config(**base))
+    return _BASELINE_CACHE[sketch]
+
+
+def _assert_state_equal(st1, st2):
+    l1 = jax.tree.leaves(st1)
+    l2 = jax.tree.leaves(st2)
+    paths = [str(p) for p, _ in jax.tree_util.tree_flatten_with_path(st1)[0]]
+    for p, x, y in zip(paths, l1, l2):
+        np.testing.assert_array_equal(x, y, err_msg=p)
+
+
+@pytest.mark.parametrize(
+    "sketch,seg_u", [(False, 0), (True, 0), (False, 16)]
+)
+def test_seg_tick_matches_fused_path(sketch, seg_u):
+    """seg_u=0: auto capacity (compacted path taken).  seg_u=16: capacity
+    too small for the unsorted 96-item batch -> every tick falls back to
+    the per-item kernels.  Both must match the plain fused path exactly."""
+    base = dict(
+        batch_size=96,
+        complete_batch_size=96,
+        use_mxu_tables=True,
+        sketch_stats=sketch,
+        enable_minute_window=True,
+        fused_effects=True,
+    )
+    cfg_seg = small_engine_config(**base, seg_effects=True, seg_u=seg_u)
+    st1, out1 = _baseline(sketch, base)
+    st2, out2 = _tick_once(cfg_seg)
+    for a, b in zip(out1, out2):
+        np.testing.assert_array_equal(a, b)
+    _assert_state_equal(st1, st2)
+
+
+@pytest.mark.parametrize("sort_batches", [True, False])
+def test_seg_flow_check_k1(sort_batches):
+    """flow_rules_per_resource=1 activates the segment-level flow check
+    (check_flow_seg).  sorted batches take the segmented-rank branch;
+    unsorted ones overflow capacity / fail res_sorted and fall back —
+    both must match the plain fused engine bit for bit."""
+    base = dict(
+        batch_size=96,
+        complete_batch_size=96,
+        use_mxu_tables=True,
+        enable_minute_window=True,
+        fused_effects=True,
+        flow_rules_per_resource=1,
+        degrade_rules_per_resource=1,
+        param_rules_per_resource=1,
+    )
+    cfg_fused = small_engine_config(**base)
+    cfg_seg = small_engine_config(**base, seg_effects=True)
+    st1, out1 = _tick_once(cfg_fused, sort_batches=sort_batches)
+    st2, out2 = _tick_once(cfg_seg, sort_batches=sort_batches)
+    for a, b in zip(out1, out2):
+        np.testing.assert_array_equal(a, b)
+    _assert_state_equal(st1, st2)
+
+
+def test_seg_tick_sorted_batch_matches_unsorted_semantics():
+    """A batch presorted by resource (stable) must produce the same
+    per-item verdicts as the unsorted batch once un-permuted, and the same
+    final integer state (f32 rt sums may differ in summation order, so
+    they are compared with quantization tolerance)."""
+    from sentinel_tpu.core.rules import DegradeRule, FlowRule
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.runtime.registry import Registry
+
+    base = dict(
+        batch_size=128,
+        complete_batch_size=128,
+        use_mxu_tables=True,
+        fused_effects=True,
+        enable_minute_window=True,
+    )
+
+    def run(sort: bool, seg: bool):
+        cfg = small_engine_config(**base, seg_effects=seg)
+        reg = Registry(cfg)
+        flow, deg = [], []
+        for i in range(10):
+            name = f"r{i}"
+            reg.resource_id(name)
+            flow.append(FlowRule(resource=name, count=6.0))
+            deg.append(DegradeRule(resource=name, grade=0, count=3.0, time_window=5))
+        rules = E.compile_ruleset(cfg, reg, flow_rules=flow, degrade_rules=deg)
+        state = E.init_state(cfg)
+        rng = np.random.default_rng(11)
+        B = cfg.batch_size
+        verdicts = []
+        for t in range(3):
+            ids = rng.integers(1, 12, B).astype(np.int32)
+            cnt = np.ones(B, np.int32)
+            rt = rng.uniform(0.5, 9.0, B).astype(np.float32)
+            order = np.lexsort((np.arange(B), ids)) if sort else np.arange(B)
+            acq = E.empty_acquire(cfg)._replace(
+                res=jnp.asarray(ids[order]), count=jnp.asarray(cnt[order]),
+                inbound=jnp.ones((B,), jnp.int32),
+            )
+            comp = E.empty_complete(cfg)._replace(
+                res=jnp.asarray(ids[order]),
+                rt=jnp.asarray(rt[order]),
+                success=jnp.ones((B,), jnp.int32),
+            )
+            state, out = E.tick(
+                state, rules, acq, comp, jnp.int32(500 + 400 * t),
+                jnp.float32(0.0), jnp.float32(0.0), cfg=cfg,
+            )
+            v = np.asarray(out.verdict)
+            inv = np.empty(B, np.int64)
+            inv[order] = np.arange(B)
+            verdicts.append(v[inv])  # back to arrival order
+        return jax.tree.map(np.asarray, state), verdicts
+
+    st_u, v_u = run(sort=False, seg=False)
+    st_s, v_s = run(sort=True, seg=True)
+    for a, b in zip(v_u, v_s):
+        np.testing.assert_array_equal(a, b)
+    # integer state identical; f32 rt sums within summation-order noise
+    flat_u = jax.tree_util.tree_flatten_with_path(st_u)[0]
+    flat_s = jax.tree.leaves(st_s)
+    for (p, x), y in zip(flat_u, flat_s):
+        if x.dtype.kind in "iub":
+            np.testing.assert_array_equal(x, y, err_msg=str(p))
+        else:
+            np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-3, err_msg=str(p))

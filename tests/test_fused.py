@@ -66,11 +66,15 @@ def test_gather_many_exact_vs_numpy():
     assert np.array_equal(np.asarray(g).astype(np.int64), ref)
 
 
-def _tick_once(cfg, seed=0):
+def _tick_once(cfg, seed=0, sort_batches=False):
     """Run a few full-feature ticks exercising every fused plane: default +
     rate-limiter + warm-up flow rules, prioritized occupy-ahead, ctx/origin
     stat fan, QPS + THREAD param rules, slow-ratio breakers.  Returns
-    (state, outputs)."""
+    (state, outputs).
+
+    sort_batches: stably presort each batch by resource id (the segment
+    engine's fast-rank precondition) and report verdicts in arrival
+    order."""
     import jax
 
     from sentinel_tpu.core.rules import (
@@ -118,10 +122,24 @@ def _tick_once(cfg, seed=0):
         ids = rng.integers(1, 14, B).astype(np.int32)
         witho = rng.random(B) < 0.3
         withc = rng.random(B) < 0.25
+        prio = (rng.random(B) < 0.3).astype(np.int32)
+        a_inb = (rng.random(B) < 0.5).astype(np.int32)
+        a_ph = np.stack([rng.integers(1, 5, B), np.zeros(B)], axis=1).astype(np.int32)
+        rt = rng.uniform(0.5, 8.0, B).astype(np.float32)
+        err = (rng.random(B) < 0.3).astype(np.int32)
+        c_inb = (rng.random(B) < 0.5).astype(np.int32)
+        c_ph = np.stack([rng.integers(1, 5, B), np.zeros(B)], axis=1).astype(np.int32)
+        if sort_batches:
+            order = np.lexsort((np.arange(B), ids))
+            inv = np.empty(B, np.int64)
+            inv[order] = np.arange(B)
+            ids, witho, withc, prio = ids[order], witho[order], withc[order], prio[order]
+            a_inb, a_ph, rt, err = a_inb[order], a_ph[order], rt[order], err[order]
+            c_inb, c_ph = c_inb[order], c_ph[order]
         acq = E.empty_acquire(cfg)._replace(
             res=jnp.asarray(ids),
             count=jnp.ones((B,), jnp.int32),
-            prio=jnp.asarray((rng.random(B) < 0.3).astype(np.int32)),
+            prio=jnp.asarray(prio),
             origin_node=jnp.asarray(
                 np.where(witho, origin_row, cfg.trash_row).astype(np.int32)
             ),
@@ -131,22 +149,16 @@ def _tick_once(cfg, seed=0):
             ctx_name=jnp.asarray(
                 np.where(withc, ctx_id, -1).astype(np.int32)
             ),
-            inbound=jnp.asarray((rng.random(B) < 0.5).astype(np.int32)),
-            param_hash=jnp.asarray(
-                np.stack(
-                    [rng.integers(1, 5, B), np.zeros(B)], axis=1
-                ).astype(np.int32)
-            ),
+            inbound=jnp.asarray(a_inb),
+            param_hash=jnp.asarray(a_ph),
         )
         comp = E.empty_complete(cfg)._replace(
             res=jnp.asarray(ids),
-            rt=jnp.asarray(rng.uniform(0.5, 8.0, B).astype(np.float32)),
+            rt=jnp.asarray(rt),
             success=jnp.ones((B,), jnp.int32),
-            error=jnp.asarray((rng.random(B) < 0.3).astype(np.int32)),
-            inbound=jnp.asarray((rng.random(B) < 0.5).astype(np.int32)),
-            param_hash=jnp.asarray(
-                np.stack([rng.integers(1, 5, B), np.zeros(B)], axis=1).astype(np.int32)
-            ),
+            error=jnp.asarray(err),
+            inbound=jnp.asarray(c_inb),
+            param_hash=jnp.asarray(c_ph),
         )
         state, out = E.tick(
             state,
@@ -158,7 +170,8 @@ def _tick_once(cfg, seed=0):
             jnp.float32(0.0),
             cfg=cfg,
         )
-        outs.append(np.asarray(out.verdict))
+        v = np.asarray(out.verdict)
+        outs.append(v[inv] if sort_batches else v)
     return jax.tree.map(np.asarray, state), outs
 
 

@@ -32,7 +32,7 @@ def test_build_structure(n, space):
     k1, k2 = _sorted_batch(rng, n, space, aux_space=3)
     head_o, sid_o = _oracle_segments(k1, k2)
     U = int(sid_o[-1]) + 1 + 8
-    ctx = SG.build([jnp.asarray(k1), jnp.asarray(k2)], U)
+    ctx, _ = SG.build([jnp.asarray(k1), jnp.asarray(k2)], U)
     assert bool(ctx.ok)
     np.testing.assert_array_equal(np.asarray(ctx.head), head_o)
     np.testing.assert_array_equal(np.asarray(ctx.sid), sid_o)
@@ -48,7 +48,7 @@ def test_build_structure(n, space):
 def test_build_overflow_flags_not_ok():
     rng = np.random.default_rng(1)
     k1, k2 = _sorted_batch(rng, 1024, 900)
-    ctx = SG.build([jnp.asarray(k1)], 16)
+    ctx, _ = SG.build([jnp.asarray(k1)], 16)
     assert not bool(ctx.ok)
 
 
@@ -57,7 +57,7 @@ def test_compact_and_expand():
     k1, k2 = _sorted_batch(rng, 1024, 100)
     head_o, sid_o = _oracle_segments(k1, k2)
     U = int(sid_o[-1]) + 1 + 4
-    ctx = SG.build([jnp.asarray(k1)], U)
+    ctx, _ = SG.build([jnp.asarray(k1)], U)
     # k1 is constant per segment -> compaction then expansion round-trips
     c = SG.compact(ctx, jnp.asarray(k1), fill=-1)
     back = SG.expand(ctx, c)
@@ -75,7 +75,7 @@ def test_seg_sums_exact(maxes):
     k1, _ = _sorted_batch(rng, n, 61)
     head_o, sid_o = _oracle_segments(k1, k1 * 0)
     U = int(sid_o[-1]) + 1 + 4
-    ctx = SG.build([jnp.asarray(k1)], U)
+    ctx, _ = SG.build([jnp.asarray(k1)], U)
     planes = [rng.integers(0, m + 1, n).astype(np.int32) for m in maxes]
     outs = SG.seg_sums(ctx, [jnp.asarray(p) for p in planes], list(maxes))
     for p, (plane, chunks) in enumerate(zip(planes, outs)):
@@ -121,7 +121,7 @@ def test_seg_min_f32():
     k1, _ = _sorted_batch(rng, n, 97)
     head_o, sid_o = _oracle_segments(k1, k1 * 0)
     U = int(sid_o[-1]) + 1 + 4
-    ctx = SG.build([jnp.asarray(k1)], U)
+    ctx, _ = SG.build([jnp.asarray(k1)], U)
     v = rng.random(n).astype(np.float32) * 100
     got = np.asarray(SG.seg_min_f32(ctx, jnp.asarray(v), fill=1e30))
     want = np.full(U, 1e30, np.float32)
@@ -152,7 +152,7 @@ def test_seg_sums_respects_block_cap():
     # digit-plane segment sum exceeds 255*BLOCK
     n = 4 * SG.BLOCK
     k1 = np.zeros(n, np.int32)
-    ctx = SG.build([jnp.asarray(k1)], 8)
+    ctx, _ = SG.build([jnp.asarray(k1)], 8)
     assert int(ctx.n_seg) == 4
     planes = [np.full(n, 255, np.int32)]
     outs = SG.seg_sums(ctx, [jnp.asarray(planes[0])], [255])
@@ -164,7 +164,7 @@ def test_seg_sums_respects_block_cap():
 def test_build_capacity_exceeds_batch():
     # U > N must still produce [U]-shaped outputs (short tail batches)
     k1 = np.sort(np.random.default_rng(7).integers(0, 5, 64)).astype(np.int32)
-    ctx = SG.build([jnp.asarray(k1)], 128)
+    ctx, _ = SG.build([jnp.asarray(k1)], 128)
     assert ctx.U == 128 and ctx.seg_end.shape == (128,)
     c = SG.compact(ctx, jnp.asarray(k1), fill=-1)
     assert c.shape == (128,)
