@@ -123,10 +123,31 @@ class NativeFrontDoor:
                 row = reg.resource_id(name)
                 if row is None:
                     continue
-                lanes = service.client._param_lanes_by_res.get(name) or [0]
                 # the decision rule's param_idx is 0; its hash lane is
-                # wherever the compile assigned idx 0
-                lane = lanes.index(0) if 0 in lanes else 0
+                # wherever the compile assigned idx 0.  The C ring carries
+                # two hash lanes, and sx_front_map_param rejects lane>1 —
+                # such rules keep flowing through the asyncio server
+                lane = service.client.param_lane(name, 0)
+                if lane is None or lane > 1:
+                    from sentinel_tpu.utils.record_log import record_log
+
+                    if lane is None:
+                        # no hash lane at all: the ENGINE cannot enforce
+                        # this rule on any transport — a misconfiguration,
+                        # not a front-door limitation
+                        record_log().warning(
+                            "front door: param rule %s on %r has no hash "
+                            "lane for param_idx 0 — the rule is not "
+                            "enforceable (raise param_dims or consolidate "
+                            "indices)", fid, name,
+                        )
+                    else:
+                        record_log().warning(
+                            "front door: param rule %s on %r maps to lane "
+                            "%d (ring carries lanes 0-1); served by the "
+                            "asyncio server only", fid, name, lane,
+                        )
+                    continue
                 self.map_param(fid, row, lane)
 
         service.flow_rules.add_listener(_sync)

@@ -176,15 +176,28 @@ class EngineConfig:
     # either way, sorted or not).
     seg_effects: bool = False
     seg_u: int = 0  # compacted-axis capacity; 0 = auto (~B/8 + B/256)
-    # True compiles BOTH the compacted and per-item effect paths and picks
-    # per tick (lax.cond on live-segment count) — always exact.  False
-    # compiles ONLY the compacted path: when live segments exceed seg_u,
-    # the overflow segments' EFFECTS are dropped (windows under-count;
-    # rule checks still run) and TickOutput.seg_dropped reports the
-    # dropped item count.  Use only when the caller presorts batches and
-    # sizes seg_u with headroom; halves the effects code size, which the
-    # tunnel-attached benchmark needs (program-cache thrash)
+    # True compiles BOTH the compacted and per-item paths (effects AND
+    # checks) and picks per tick (lax.cond on live-segment count) — always
+    # exact, but the check-phase cond boundary alone costs ~1.4 ms at
+    # B=128K in operand/result copies.  False compiles ONLY the compacted
+    # path, cond-free: when live segments exceed seg_u, overflow segments'
+    # EFFECTS are dropped (windows under-count), their items' VERDICTS
+    # fail closed as system rejections (never pass unchecked), and
+    # TickOutput.seg_dropped reports the dropped item count.  Use only
+    # when the caller presorts batches and sizes seg_u with headroom;
+    # also halves the compiled code size, which the tunnel-attached
+    # benchmark needs (program-cache thrash)
     seg_fallback: bool = True
+    # compile ONLY the segmented-scan ranks in the seg check phase (no
+    # lax.cond to the sort-based rank kernels — each such cond boundary
+    # costs ~0.3-0.8 ms at B=128K).  Caller contract: batches are
+    # presorted by resource AND every enabled flow rule is DIRECT with
+    # limitApp "default" (rank keys contiguous).  The engine still
+    # verifies the contract at runtime and FAILS CLOSED loudly (blocks
+    # flow-ruled / tail-ruled items, elects no probes) instead of
+    # misranking silently; a caller whose rules stop qualifying must
+    # clear the flag and re-jit.  Requires seg_effects.
+    seg_static_ranks: bool = False
     # global stats sketch: resources beyond the exact row space get sketch
     # ids and windowed CMS observability instead of pass-through (ops/
     # gsketch.py) — tick cost independent of resource count
@@ -194,14 +207,29 @@ class EngineConfig:
     sketch_capacity: int = 1 << 22  # max interned sketch resources
 
     def __post_init__(self):
-        # the native completion ring transports exactly two hot-param
-        # release lanes (sx_event.aux0/aux1); a wider engine batch would
+        # the native completion ring transports exactly four hot-param
+        # release lanes (sx_event.aux0..aux3); a wider engine batch would
         # silently leak THREAD-grade concurrency for the extra lanes, so
-        # reject it here instead
-        if not (1 <= self.param_dims <= 2):
+        # reject it here instead (ParamFlowChecker.java:78 dispatches on
+        # arbitrary paramIdx — four distinct indices per resource covers
+        # it; beyond that, rule_tensors.param_lanes warns and drops)
+        if not (1 <= self.param_dims <= 4):
             raise ValueError(
-                f"param_dims must be 1 or 2 (ring transport carries two "
+                f"param_dims must be 1..4 (ring transport carries four "
                 f"release lanes); got {self.param_dims}"
+            )
+        # seg_effects rides the fused megakernels; without them the flag
+        # would silently do nothing (tick gates on seg_effects AND fused)
+        if self.seg_effects and not self.fused_effects:
+            raise ValueError(
+                "seg_effects=True requires fused_effects=True (the "
+                "segment-compacted phases replace the fused megakernels, "
+                "not the plain scatter path)"
+            )
+        if self.seg_static_ranks and not self.seg_effects:
+            raise ValueError(
+                "seg_static_ranks=True requires seg_effects=True (it "
+                "specializes the segment check phase's rank scans)"
             )
 
     # dtype policy: counters int32, rt sums float32

@@ -97,9 +97,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sx_ring_new.argtypes = [u64]
     lib.sx_ring_free.argtypes = [p]
     lib.sx_ring_push.restype = i32
-    lib.sx_ring_push.argtypes = [p, i32, i32, i32, i32, i32, f32, i32, i32, i32, i32]
+    lib.sx_ring_push.argtypes = [
+        p, i32, i32, i32, i32, i32, f32, i32, i32, i32, i32, i32, i32
+    ]
     lib.sx_ring_drain.restype = i64
-    lib.sx_ring_drain.argtypes = [p, i64] + [p] * 10
+    lib.sx_ring_drain.argtypes = [p, i64] + [p] * 12
     lib.sx_ring_size.restype = i64
     lib.sx_ring_size.argtypes = [p]
     lib.sx_intern_new.restype = p

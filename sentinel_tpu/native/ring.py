@@ -52,24 +52,27 @@ class EventRing:
         user_tag: int = 0,
         aux0: int = 0,
         aux1: int = 0,
+        aux2: int = 0,
+        aux3: int = 0,
     ) -> bool:
         if self._lib is not None:
             return (
                 self._lib.sx_ring_push(
                     self._ring, res, count, origin_id, param_hash, flags,
-                    rt_ms, error, user_tag, aux0, aux1,
+                    rt_ms, error, user_tag, aux0, aux1, aux2, aux3,
                 )
                 == 0
             )
         with self._dq_lock:
             if len(self._dq) >= self.capacity:
                 return False
-            self._dq.append((res, count, origin_id, param_hash, flags, rt_ms, error, user_tag, aux0, aux1))
+            self._dq.append((res, count, origin_id, param_hash, flags, rt_ms,
+                             error, user_tag, aux0, aux1, aux2, aux3))
             return True
 
     def drain(self, max_n: int) -> Tuple[np.ndarray, ...]:
         """(res, count, origin_id, param_hash, flags, rt_ms, error,
-        user_tag, aux0, aux1) arrays of length n <= max_n."""
+        user_tag, aux0, aux1, aux2, aux3) arrays of length n <= max_n."""
         res = np.empty(max_n, np.int32)
         count = np.empty(max_n, np.int32)
         origin = np.empty(max_n, np.int32)
@@ -80,11 +83,14 @@ class EventRing:
         tag = np.empty(max_n, np.int32)
         aux0 = np.empty(max_n, np.int32)
         aux1 = np.empty(max_n, np.int32)
+        aux2 = np.empty(max_n, np.int32)
+        aux3 = np.empty(max_n, np.int32)
         if self._lib is not None:
             cp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
             n = self._lib.sx_ring_drain(
                 self._ring, max_n, cp(res), cp(count), cp(origin), cp(ph),
                 cp(flags), cp(rt), cp(err), cp(tag), cp(aux0), cp(aux1),
+                cp(aux2), cp(aux3),
             )
         else:
             n = 0
@@ -92,9 +98,10 @@ class EventRing:
                 while n < max_n and self._dq:
                     row = self._dq.popleft()
                     (res[n], count[n], origin[n], ph[n], flags[n], rt[n],
-                     err[n], tag[n], aux0[n], aux1[n]) = row
+                     err[n], tag[n], aux0[n], aux1[n], aux2[n], aux3[n]) = row
                     n += 1
-        return tuple(a[:n] for a in (res, count, origin, ph, flags, rt, err, tag, aux0, aux1))
+        return tuple(a[:n] for a in (res, count, origin, ph, flags, rt, err,
+                                     tag, aux0, aux1, aux2, aux3))
 
     def __len__(self) -> int:
         if self._lib is not None:

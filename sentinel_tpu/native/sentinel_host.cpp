@@ -40,6 +40,8 @@ struct sx_event {
     int32_t user_tag; // round-trips to the drainer (e.g. future index)
     int32_t aux0;     // completions: hot-param release lane 0
     int32_t aux1;     // completions: hot-param release lane 1
+    int32_t aux2;     // completions: hot-param release lane 2
+    int32_t aux3;     // completions: hot-param release lane 3
 };
 
 struct sx_slot {
@@ -75,11 +77,12 @@ void sx_ring_free(sx_ring* r) {
     delete r;
 }
 
-// push one event; returns 0 on success, -1 if the ring is full
+// push one event; returns 0 on success, -1 if the ring is full.
+// aux0..aux3 carry the four hot-param release lanes (param_dims <= 4)
 int32_t sx_ring_push(sx_ring* r, int32_t res, int32_t count, int32_t origin_id,
                      int32_t param_hash, int32_t flags, float rt_ms,
                      int32_t error, int32_t user_tag, int32_t aux0,
-                     int32_t aux1) {
+                     int32_t aux1, int32_t aux2, int32_t aux3) {
     uint64_t pos = r->head.load(std::memory_order_relaxed);
     for (;;) {
         sx_slot& s = r->slots[pos & r->mask];
@@ -90,7 +93,7 @@ int32_t sx_ring_push(sx_ring* r, int32_t res, int32_t count, int32_t origin_id,
                                               std::memory_order_relaxed))
             {
                 s.ev = {res, count, origin_id, param_hash, flags, rt_ms,
-                        error, user_tag, aux0, aux1};
+                        error, user_tag, aux0, aux1, aux2, aux3};
                 s.seq.store(pos + 1, std::memory_order_release);
                 return 0;
             }
@@ -108,7 +111,8 @@ int32_t sx_ring_push(sx_ring* r, int32_t res, int32_t count, int32_t origin_id,
 int64_t sx_ring_drain(sx_ring* r, int64_t max_n, int32_t* res, int32_t* count,
                       int32_t* origin_id, int32_t* param_hash, int32_t* flags,
                       float* rt_ms, int32_t* error, int32_t* user_tag,
-                      int32_t* aux0, int32_t* aux1) {
+                      int32_t* aux0, int32_t* aux1, int32_t* aux2,
+                      int32_t* aux3) {
     int64_t n = 0;
     while (n < max_n) {
         uint64_t pos = r->tail.load(std::memory_order_relaxed);
@@ -124,6 +128,7 @@ int64_t sx_ring_drain(sx_ring* r, int64_t max_n, int32_t* res, int32_t* count,
             param_hash[n] = e.param_hash; flags[n] = e.flags;
             rt_ms[n] = e.rt_ms; error[n] = e.error; user_tag[n] = e.user_tag;
             aux0[n] = e.aux0; aux1[n] = e.aux1;
+            aux2[n] = e.aux2; aux3[n] = e.aux3;
             s.seq.store(pos + r->mask + 1, std::memory_order_release);
             ++n;
         } else {
@@ -414,10 +419,14 @@ int32_t sx_front_map_flow(sx_front* f, int64_t flow_id, int32_t row) {
     return sxf_map_put(f, flow_id << 1, row, 0);
 }
 
-// param flow_id -> engine row of its $cluster/param resource + hash lane
+// param flow_id -> engine row of its $cluster/param resource + hash lane.
+// The event ring carries exactly two hash lanes (a0/a1): a mapping with
+// lane>1 would silently hash to 0 in sxf_parse and pass unchecked, so
+// refuse it here — such rules stay on the asyncio server, which handles
+// arbitrary lanes.
 int32_t sx_front_map_param(sx_front* f, int64_t flow_id, int32_t row,
                            int32_t lane) {
-    if (!f || flow_id == 0) return -1;
+    if (!f || flow_id == 0 || lane < 0 || lane > 1) return -1;
     return sxf_map_put(f, (flow_id << 1) | 1, row, lane);
 }
 
@@ -567,7 +576,7 @@ static void sxf_parse(sx_front* f, sx_conn* c) {
             f->freelist.pop_back();
             f->pend[corr] = Pend{c->fd, c->gen, xid, 1, 1, ST_OK};
             if (sx_ring_push(f->acq, row, count, 0, 0, (1 << 4) | (prio ? 2 : 0),
-                             0.0f, 0, corr, 0, 0) != 0) {
+                             0.0f, 0, corr, 0, 0, 0, 0) != 0) {
                 f->freelist.push_back(corr);
                 sxf_queue_resp(c, xid, 1, ST_TOO_MANY, 0, 0);
             }
@@ -642,7 +651,7 @@ static void sxf_parse(sx_front* f, sx_conn* c) {
                 int32_t a0 = lane == 0 ? hashes[i] : 0;
                 int32_t a1 = lane == 1 ? hashes[i] : 0;
                 if (sx_ring_push(f->acq, row, count, 0, 0, (2 << 4), 0.0f, 0,
-                                 corr, a0, a1) != 0)
+                                 corr, a0, a1, 0, 0) != 0)
                     break;
                 ++pushed;
             }
@@ -675,7 +684,7 @@ static void sxf_parse(sx_front* f, sx_conn* c) {
             f->pend[corr] = Pend{c->fd, c->gen, xid, type, 1, ST_OK};
             if (sx_ring_push(f->acq, -1, count, 0, 0, ((int32_t)type << 4),
                              0.0f, 0, corr, (int32_t)(v >> 32),
-                             (int32_t)(v & 0xFFFFFFFF)) != 0) {
+                             (int32_t)(v & 0xFFFFFFFF), 0, 0) != 0) {
                 f->freelist.push_back(corr);
                 sxf_queue_resp(c, xid, type, ST_TOO_MANY, 0, 0);
             }
@@ -689,12 +698,14 @@ static void sxf_parse(sx_front* f, sx_conn* c) {
 static void sxf_drain_responses(sx_front* f) {
     constexpr int64_t MAXB = 8192;
     static thread_local std::vector<int32_t> corr(MAXB), verdict(MAXB),
-        wait(MAXB), th(MAXB), tl(MAXB), i2(MAXB), i3(MAXB), a0(MAXB), a1(MAXB);
+        wait(MAXB), th(MAXB), tl(MAXB), i2(MAXB), i3(MAXB), a0(MAXB), a1(MAXB),
+        a2(MAXB), a3(MAXB);
     static thread_local std::vector<float> f0(MAXB);
     for (;;) {
         int64_t n = sx_ring_drain(f->resp, MAXB, corr.data(), verdict.data(),
                                   wait.data(), th.data(), tl.data(), f0.data(),
-                                  i2.data(), i3.data(), a0.data(), a1.data());
+                                  i2.data(), i3.data(), a0.data(), a1.data(),
+                                  a2.data(), a3.data());
         if (n <= 0) break;
         for (int64_t i = 0; i < n; ++i) {
             int32_t slot = corr[i];
@@ -816,15 +827,17 @@ int64_t sx_front_drain_acquires(sx_front* f, int64_t max_n, int32_t* row,
                                 int32_t* count, int32_t* prio, int32_t* corr) {
     static thread_local std::vector<int32_t> scratch_i;
     static thread_local std::vector<float> scratch_f;
-    if ((int64_t)scratch_i.size() < max_n * 5) scratch_i.resize(max_n * 5);
+    if ((int64_t)scratch_i.size() < max_n * 7) scratch_i.resize(max_n * 7);
     if ((int64_t)scratch_f.size() < max_n) scratch_f.resize(max_n);
     int32_t* origin = scratch_i.data();
     int32_t* ph = origin + max_n;
     int32_t* err = ph + max_n;
     int32_t* a0 = err + max_n;
     int32_t* a1 = a0 + max_n;
+    int32_t* a2 = a1 + max_n;
+    int32_t* a3 = a2 + max_n;
     int64_t n = sx_ring_drain(f->acq, max_n, row, count, origin, ph, prio,
-                              scratch_f.data(), err, corr, a0, a1);
+                              scratch_f.data(), err, corr, a0, a1, a2, a3);
     for (int64_t i = 0; i < n; ++i) prio[i] = (prio[i] >> 1) & 1;
     return n;
 }
@@ -837,13 +850,15 @@ int64_t sx_front_drain_acquires2(sx_front* f, int64_t max_n, int32_t* row,
                                  int32_t* kind, int32_t* a0, int32_t* a1) {
     static thread_local std::vector<int32_t> scratch_i;
     static thread_local std::vector<float> scratch_f;
-    if ((int64_t)scratch_i.size() < max_n * 3) scratch_i.resize(max_n * 3);
+    if ((int64_t)scratch_i.size() < max_n * 5) scratch_i.resize(max_n * 5);
     if ((int64_t)scratch_f.size() < max_n) scratch_f.resize(max_n);
     int32_t* origin = scratch_i.data();
     int32_t* ph = origin + max_n;
     int32_t* err = ph + max_n;
+    int32_t* a2 = err + max_n;
+    int32_t* a3 = a2 + max_n;
     int64_t n = sx_ring_drain(f->acq, max_n, row, count, origin, ph, prio,
-                              scratch_f.data(), err, corr, a0, a1);
+                              scratch_f.data(), err, corr, a0, a1, a2, a3);
     for (int64_t i = 0; i < n; ++i) {
         int32_t fl = prio[i];
         prio[i] = (fl >> 1) & 1;
@@ -859,7 +874,7 @@ int32_t sx_front_respond(sx_front* f, int64_t n, const int32_t* corr,
     int32_t dropped = 0;
     for (int64_t i = 0; i < n; ++i) {
         if (sx_ring_push(f->resp, corr[i], status[i], wait_ms[i], 0, 0, 0.0f,
-                         0, 0, 0, 0) != 0)
+                         0, 0, 0, 0, 0, 0) != 0)
             ++dropped;
     }
     return dropped;
@@ -872,7 +887,7 @@ int32_t sx_front_respond_ex(sx_front* f, int64_t n, const int32_t* corr,
     int32_t dropped = 0;
     for (int64_t i = 0; i < n; ++i) {
         if (sx_ring_push(f->resp, corr[i], status[i], wait_ms[i], tok_hi[i],
-                         tok_lo[i], 0.0f, 0, 0, 0, 0) != 0)
+                         tok_lo[i], 0.0f, 0, 0, 0, 0, 0, 0) != 0)
             ++dropped;
     }
     return dropped;

@@ -873,6 +873,19 @@ class SentinelClient:
             counter = dict(self._hot_params.get(resource, {}))
         return sorted(counter.items(), key=lambda kv: -kv[1])[:n]
 
+    def param_lane(self, resource: str, param_idx: int) -> Optional[int]:
+        """Hash lane the compile assigned to ``param_idx`` on ``resource``,
+        or None if that index holds no lane (rule unenforceable).  Public
+        accessor for transports (e.g. the native front door) that must
+        hash a value into the same lane the engine reads."""
+        lanes = self._param_lanes_by_res.get(resource)
+        if not lanes:
+            return 0 if param_idx == 0 else None
+        try:
+            return lanes.index(param_idx)
+        except ValueError:
+            return None
+
     def trace(self, exc: BaseException, count: int = 1) -> None:
         e = CTX.current_entry()
         if e is not None:
@@ -1009,7 +1022,7 @@ class SentinelClient:
     def _submit_completion(self, c: Completion) -> None:
         from sentinel_tpu.native.ring import FLAG_COMPLETION, FLAG_INBOUND
 
-        ph = tuple(c.param_hash) + (0, 0)
+        ph = tuple(c.param_hash) + (0, 0, 0, 0)
         ok = self._comp_ring.push(
             res=c.res,
             count=c.success,
@@ -1020,6 +1033,8 @@ class SentinelClient:
             error=c.error,
             aux0=ph[0],
             aux1=ph[1],
+            aux2=ph[2],
+            aux3=ph[3],
         )
         if not ok:
             with self._lock:
@@ -1073,9 +1088,8 @@ class SentinelClient:
                             zip(
                                 *[
                                     (s.res, s.success, s.origin_node, s.ctx_node,
-                                     4 | (1 if s.inbound else 0), s.rt, s.error, 0,
-                                     (tuple(s.param_hash) + (0, 0))[0],
-                                     (tuple(s.param_hash) + (0, 0))[1])
+                                     4 | (1 if s.inbound else 0), s.rt, s.error, 0)
+                                    + (tuple(s.param_hash) + (0, 0, 0, 0))[:4]
                                     for s in spill
                                 ]
                             ),
@@ -1328,7 +1342,8 @@ class SentinelClient:
 
             clamp = _use_fused(cfg)
 
-            res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag, aux0_a, aux1_a = comp
+            (res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag,
+             *aux_a) = comp
             n = len(res_a)
 
             def pad(a, fill, dt):
@@ -1337,9 +1352,8 @@ class SentinelClient:
                 return jnp.asarray(out)
 
             ph_np = np.zeros((B2, M), dtype=np.int32)
-            ph_np[:n, 0] = aux0_a
-            if M > 1:
-                ph_np[:n, 1] = aux1_a
+            for k in range(min(M, len(aux_a))):
+                ph_np[:n, k] = aux_a[k]
             c = E.CompleteBatch(
                 res=pad(res_a, trash, np.int32),
                 origin_node=pad(org_a, trash, np.int32),

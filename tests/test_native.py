@@ -25,7 +25,7 @@ def test_ring_roundtrip(force_fallback, monkeypatch):
     for i in range(10):
         assert r.push(res=i, count=i + 1, rt_ms=float(i) / 2, user_tag=100 + i)
     assert len(r) == 10
-    res, count, origin, ph, flags, rt, err, tag, aux0, aux1 = r.drain(64)
+    res, count, origin, ph, flags, rt, err, tag, aux0, aux1, aux2, aux3 = r.drain(64)
     assert list(res) == list(range(10))
     assert list(count) == [i + 1 for i in range(10)]
     np.testing.assert_allclose(rt, [i / 2 for i in range(10)])

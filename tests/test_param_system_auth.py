@@ -100,6 +100,59 @@ def test_param_flow_multi_index(client, vt):
     assert got2 == 2
 
 
+def test_param_flow_four_distinct_indices(client_factory, vt):
+    """Four rules with four DISTINCT paramIdx on one resource all enforce
+    (ParamFlowChecker.java:78 dispatches on arbitrary paramIdx; the ring
+    transport carries four release lanes — sx_event.aux0..aux3).  The
+    r2/r3 "unenforced rule" warning path must be unreachable here."""
+    from sentinel_tpu.core.config import small_engine_config
+
+    client = client_factory(
+        cfg=small_engine_config(param_dims=4, param_rules_per_resource=4)
+    )
+    client.param_flow_rules.load(
+        [
+            st.ParamFlowRule(resource="papi4", count=50, param_idx=0),
+            st.ParamFlowRule(resource="papi4", count=2, param_idx=1),
+            st.ParamFlowRule(resource="papi4", count=3, param_idx=2),
+            st.ParamFlowRule(
+                resource="papi4", count=2, param_idx=3, grade=st.GRADE_THREAD
+            ),
+        ]
+    )
+    # every index got a hash lane (nothing dropped to the warning path)
+    assert sorted(
+        client.param_lane("papi4", k) for k in range(4)
+    ) == [0, 1, 2, 3]
+
+    # idx-1 value "y" capped at 2 while idx 0/2/3 stay distinct
+    got = sum(
+        1
+        for i in range(6)
+        if client.try_entry("papi4", args=[f"a{i}", "y", f"c{i}", f"d{i}"])
+    )
+    assert got == 2
+    # idx-2 value "w" capped at 3 under fresh values elsewhere
+    got2 = sum(
+        1
+        for i in range(6)
+        if client.try_entry("papi4", args=[f"e{i}", f"f{i}", "w", f"g{i}"])
+    )
+    assert got2 == 3
+    vt.advance(1100)
+    # idx-3 THREAD grade: per-value concurrency 2, released on exit
+    # through the ring's third release lane
+    e1 = client.try_entry("papi4", args=["p", "q", "r", "t"])
+    e2 = client.try_entry("papi4", args=["p2", "q2", "r2", "t"])
+    assert e1 and e2
+    assert client.try_entry("papi4", args=["p3", "q3", "r3", "t"]) is None
+    e1.exit()
+    e4 = client.try_entry("papi4", args=["p4", "q4", "r4", "t"])
+    assert e4
+    for e in (e2, e4):
+        e.exit()
+
+
 # ---------------- system rules ----------------
 
 
