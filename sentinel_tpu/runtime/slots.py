@@ -11,11 +11,15 @@ check, keeping the same contract:
   rejects the entry — the engine still RECORDS the block (the exception
   rides the batch as a pre-verdict, so stats/block-log/SPI all fire, the
   way a custom slot's exception flows through StatisticSlot).
-- ``on_exit`` runs for every entry whose ``on_entry`` completed, in
-  REVERSE order (fireExit unwinds the chain LIFO), both on completion
-  (with rt/success/errors) and on rejection (with ``block_exception``
-  set) — matching CtEntry.exit walking the chain even for blocked
-  entries.
+- ``on_exit`` runs for every slot whose ``on_entry`` ran — including the
+  slot that raised the BlockException — in REVERSE order (fireExit
+  unwinds the chain LIFO), both on completion (with rt/success/errors)
+  and on rejection (with ``block_exception`` set) — matching CtEntry.exit
+  walking the chain even for blocked entries.  Slots later in the chain
+  than the blocker never entered, so they do not exit (divergence from
+  the reference's full-chain fireExit, which calls exit on slots whose
+  entry never ran; pairing resources between entry and exit is safe
+  here).
 - ``SlotContext.attachments`` is scratch state shared between a slot's
   entry and exit sides for the same request (Context#customized data).
 
@@ -93,9 +97,11 @@ class SlotChain:
 
 def run_entry(slots: List[ProcessorSlot], ctx: SlotContext):
     """Run on_entry in order.  Returns (entered, block_exc): ``entered``
-    are the slots whose on_entry completed (for LIFO unwinding); a
-    BlockException stops the walk and is returned, any other exception
-    unwinds the already-entered slots and propagates."""
+    are the slots to unwind LIFO — including the slot whose on_entry
+    raised the BlockException (its entry ran up to the raise, and the
+    reference fires exit through the raising slot too: CtEntry.exit walks
+    the whole chain's fireExit).  Any non-Block exception unwinds the
+    already-entered slots and propagates."""
     from sentinel_tpu.core import errors as ERR
 
     entered: List[ProcessorSlot] = []
@@ -103,6 +109,7 @@ def run_entry(slots: List[ProcessorSlot], ctx: SlotContext):
         try:
             s.on_entry(ctx)
         except ERR.BlockException as be:
+            entered.append(s)
             return entered, be
         except BaseException:
             ctx.block_exception = None

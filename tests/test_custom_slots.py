@@ -94,12 +94,16 @@ def test_blocked_entry_unwinds_entered_slots(client):
     client.slots.register(Recorder("never", order=1, log=log))
     with pytest.raises(ERR.FlowException):
         client.entry("slot-unwind")
-    # 'a' entered and must see the exit with the block exception;
-    # 'blocker' raised IN on_entry (never entered) and 'never' never ran
+    # 'a' entered and must see the exit with the block exception; the
+    # raising slot unwinds too (reference CtEntry.exit fires exit through
+    # the whole chain, raising slot included); 'never' never ran
     assert ("entry", "a", "slot-unwind") in log
     assert ("exit", "a", "block", 0) in log
+    assert ("exit", "blocker", "block", 0) in log
     assert not any(x[1] == "never" for x in log)
-    assert not any(x[0] == "exit" and x[1] == "blocker" for x in log)
+    # LIFO: the blocker exits before 'a'
+    exits = [x[1] for x in log if x[0] == "exit"]
+    assert exits.index("blocker") < exits.index("a")
 
 
 def test_engine_block_reaches_slot_exit(client, vt):
