@@ -1254,10 +1254,18 @@ def _check_system(
     inbound = (acq.inbound > 0) & eligible
     cnt = acq.count.astype(jnp.float32)
     # single group (the global ENTRY node) → plain exclusive prefix sum.
-    # Integer cumsum: exact to 2^31 (the f32 MXU prefix lost exactness at
-    # 2^24 and cost ~0.6 ms at B=128K)
+    # Fused path: int32 cumsum, exact (counts clamp to max_batch_count at
+    # batch build, so the batch total stays < 2^31; the f32 MXU prefix
+    # lost exactness at 2^24 and cost ~0.6 ms at B=128K).  Unfused path:
+    # counts run to 65535 and an int32 total can WRAP negative (admitting
+    # the whole batch); f32 is monotone under positive addends — inexact
+    # past 2^24 but it never un-blocks, so it keeps the old behavior.
     vim_i = jnp.where(inbound, acq.count, 0)
-    rank_q = (jnp.cumsum(vim_i) - vim_i).astype(jnp.float32)
+    if _use_fused(cfg):
+        rank_q = (jnp.cumsum(vim_i) - vim_i).astype(jnp.float32)
+    else:
+        vim_f = vim_i.astype(jnp.float32)
+        rank_q = jnp.cumsum(vim_f) - vim_f
     rank_t = rank_q  # one concurrent slot per inbound attempt (count≈1)
 
     s = rules.system
@@ -1748,7 +1756,18 @@ def _check_flow(
 
 def _apply_latest(latest_passed_ms, T_s, n_s, now_ms):
     """Closed-form latestPassedTime advance from per-slot (cost, count)
-    sums — see the comment block in _check_flow."""
+    sums — see the comment block in _check_flow.
+
+    Drift bound vs the reference's per-request CAS
+    (RateLimiterController.java:50-105), pinned by
+    tests/test_rate_limiter_drift.py: with MIXED within-tick costs the
+    reset anchor uses the mean admitted cost instead of the first
+    admitted item's, so |latest - sequential| <= one maximum item cost at
+    every tick.  The error does NOT compound: the busy branch
+    (latest + T) is exact, and every idle reset re-anchors to `now`.
+    Admission divergence stays within a few items per tick and its
+    running total is conservative (slight under-admission, never a
+    sustained burst past the configured rate)."""
     mean_cost = T_s / jnp.maximum(n_s, 1.0)
     cand = jnp.maximum(
         latest_passed_ms + T_s, now_ms.astype(jnp.float32) + T_s - mean_cost
@@ -2054,7 +2073,9 @@ def tick(
             state = ES.process_completions_seg(
                 cfg, state, rules, comp, now_ms, features, ctx_c, carry_c
             )
-            seg_dropped = seg_dropped + ES.dropped_items(ctx_c)
+            seg_dropped = seg_dropped + ES.dropped_items(
+                ctx_c, comp.res != cfg.trash_row
+            )
     elif _use_fused(cfg):
         state = _process_completions_fused(cfg, state, rules, comp, now_ms, features)
     else:
@@ -2080,7 +2101,17 @@ def tick(
         and cfg.degrade_rules_per_resource == 1
         and cfg.param_rules_per_resource == 1
     )
-    if seg_checks:
+    if seg_checks and not cfg.seg_fallback:
+        # presorting callers (seg_fallback=False): run the segment check
+        # phase UNCONDITIONALLY — the lax.cond boundary alone cost ~1.4 ms
+        # at B=128K (operand/result copies) plus the whole plain branch's
+        # compile.  Items in segments past seg_u FAIL CLOSED (sys_block
+        # inside run_checks_seg) and are already counted in seg_dropped.
+        checks = ES.run_checks_seg(
+            cfg, state, rules, acq, now_ms, sys_load, sys_cpu,
+            valid, forced, ctx_a, carry_a, features,
+        )
+    elif seg_checks:
         checks = jax.lax.cond(
             ctx_a.ok,
             lambda: ES.run_checks_seg(
@@ -2183,7 +2214,7 @@ def tick(
                     occupying, valid, fslots, occ_grant, rl_info,
                     param_ctx, ctx_a, carry_a,
                 )
-                seg_dropped = seg_dropped + ES.dropped_items(ctx_a)
+                seg_dropped = seg_dropped + ES.dropped_items(ctx_a, valid)
         else:
             state = _acquire_effects_fused(
                 cfg,

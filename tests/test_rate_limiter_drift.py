@@ -1,0 +1,126 @@
+"""Bound the closed-form latestPassedTime drift vs the reference's CAS
+semantics (VERDICT r3 weak #5).
+
+The engine advances a rate-limited rule's latestPassedTime once per tick
+with a closed form over the tick's admitted (cost, count) sums
+(engine._apply_latest); the reference CASes it per request
+(RateLimiterController.java:50-105).  The closed form is exact when a
+tick's admitted costs are uniform; with MIXED per-item counts the
+reset-anchor term uses the mean admitted cost where the sequential replay
+uses the FIRST admitted item's cost, so each idle->busy transition can
+miss by up to one within-tick cost spread.  Crucially the error does NOT
+compound: on a saturated rule the busy branch (latest + T) is exact, and
+every idle reset re-anchors to `now`, wiping prior error.
+
+This test replays the reference's sequential semantics item by item over
+many saturated mixed-count ticks and asserts the cumulative drift stays
+under one maximum item cost (one "bucket" of the pacer).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from sentinel_tpu.core import rules as R
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.ops import engine as E
+from sentinel_tpu.runtime.registry import Registry
+
+RATE = 100.0  # permits/s
+MAXQ = 400  # max queueing ms
+
+
+def _oracle_step(latest, now, counts, verdicts_out):
+    """Sequential RateLimiterController.canPass over one tick's items."""
+    admitted = 0
+    for c in counts:
+        cost = float(c) / RATE * 1000.0
+        expected = latest + cost
+        if expected <= now:
+            latest = float(now)
+            admitted += 1
+            verdicts_out.append(True)
+        elif expected - now > MAXQ:
+            verdicts_out.append(False)
+        else:
+            latest += cost
+            admitted += 1
+            verdicts_out.append(True)
+    return latest, admitted
+
+
+def _run(saturated: bool):
+    cfg = small_engine_config()
+    reg = Registry(cfg)
+    rid = reg.resource_id("rl")
+    ruleset = E.compile_ruleset(
+        cfg,
+        reg,
+        flow_rules=[
+            R.FlowRule(
+                resource="rl",
+                count=RATE,
+                control_behavior=R.CONTROL_RATE_LIMITER,
+                max_queueing_time_ms=MAXQ,
+            )
+        ],
+    )
+    slot = int(np.asarray(ruleset.flow.res_rules)[rid, 0])
+    tick = E.make_tick(cfg, donate=False, features=frozenset({"flow"}))
+    state = E.init_state(cfg)
+    rng = np.random.default_rng(7)
+    b = cfg.batch_size
+    latest_oracle = 0.0
+    now = 1_000
+    max_item_cost = 5.0 / RATE * 1000.0  # 50 ms
+    drifts, adm_diffs = [], []
+    for _t in range(80):
+        if saturated:
+            n_items = b  # way beyond 100/s: the pacer queue stays full
+        else:
+            # sparse ticks: bucket often idle -> exercises the reset path
+            n_items = int(rng.integers(1, 6))
+        counts = np.zeros(b, np.int32)
+        counts[:n_items] = rng.integers(1, 6, n_items)
+        res = np.full(b, 0, np.int32)
+        res[:n_items] = rid
+        acq = E.empty_acquire(cfg)._replace(
+            res=jnp.asarray(np.where(res == 0, cfg.trash_row, res)),
+            count=jnp.asarray(counts),
+        )
+        state, out = tick(
+            state, ruleset, acq, E.empty_complete(cfg),
+            jnp.int32(now), jnp.float32(0.0), jnp.float32(0.0),
+        )
+        verd = np.asarray(out.verdict)[:n_items]
+        adm_engine = int((verd != 1).sum())  # 1 = BLOCK_FLOW
+        overdicts = []
+        latest_oracle, adm_oracle = _oracle_step(
+            latest_oracle, now, counts[:n_items], overdicts
+        )
+        eng_latest = float(np.asarray(state.latest_passed_ms)[slot])
+        drifts.append(abs(eng_latest - latest_oracle))
+        adm_diffs.append(adm_engine - adm_oracle)
+        now += 200 if not saturated else 50
+    return drifts, adm_diffs, max_item_cost
+
+
+def test_saturated_mixed_count_drift_under_one_bucket():
+    drifts, adm_diffs, max_cost = _run(saturated=True)
+    # the headline bound: latestPassedTime drift bounded by ONE max item
+    # cost at every tick — it bounces within the bucket, never compounds
+    assert max(drifts) <= max_cost, (max(drifts), max_cost)
+    # admission divergence per tick is a few items out of ~5 admitted;
+    # its RUNNING direction is conservative (the drifted latest sits
+    # AHEAD of the oracle's as often as behind, so the engine under-admits
+    # slightly rather than bursting past the configured rate)
+    assert max(abs(d) for d in adm_diffs) <= 4, adm_diffs
+    total = sum(adm_diffs)
+    n_oracle = 80 * 5  # ~rate * tick_interval admitted per tick
+    assert -0.10 * n_oracle <= total <= 1, total
+
+
+def test_idle_reset_mixed_count_drift_under_one_bucket():
+    drifts, adm_diffs, max_cost = _run(saturated=False)
+    assert max(drifts) <= max_cost, (max(drifts), max_cost)
+    assert max(abs(d) for d in adm_diffs) <= 1, adm_diffs
