@@ -62,13 +62,21 @@ def seg_capacity(cfg: EngineConfig, b: int) -> int:
     return min(b, b // 8 + b // SG.BLOCK + 64)
 
 
-def dropped_items(ctx: SG.SegCtx) -> jax.Array:
+def dropped_items(ctx: SG.SegCtx, valid: Optional[jax.Array] = None) -> jax.Array:
     """Items whose effects a no-fallback compacted pass dropped: segments
     are item-contiguous in sid order, so everything past the last kept
-    segment's end is dropped when capacity overflows."""
+    segment's end is dropped when capacity overflows.  ``valid`` (when
+    given) excludes trash-row padding from the count — a short batch
+    padded to shape would otherwise report dropped "items" whose effects
+    were no-ops anyway."""
     n = ctx.head.shape[0]
     kept = ctx.seg_end[-1] + 1
-    return jnp.where(ctx.ok, jnp.int32(0), jnp.int32(n) - kept)
+    if valid is None:
+        late = jnp.int32(n) - kept
+    else:
+        iota = jnp.arange(n, dtype=jnp.int32)
+        late = jnp.sum((valid & (iota >= kept)).astype(jnp.int32))
+    return jnp.where(ctx.ok, jnp.int32(0), late)
 
 
 class CompCarry(NamedTuple):
@@ -525,18 +533,29 @@ def run_checks_seg(
     exp.run()
 
     # ================= item-level phase (slot order) =================
+    # Items in segments past the compacted capacity have no segment-level
+    # data (their expansions clamp to slot U-1 — garbage): FAIL CLOSED.
+    # Empty whenever ctx.ok (sid < U for every item), so this is a no-op
+    # on the seg_fallback=True path, where the lax.cond guards capacity;
+    # with seg_fallback=False these items are counted by dropped_items and
+    # block as system rejections rather than pass unchecked.
+    overflow = valid & (ctx.sid >= ctx.U)
+
     if with_auth:
-        auth_block = (exp.get(i_auth) > 0) & valid & ~forced
+        # ~overflow: garbage expansions must not mislabel the fail-closed
+        # block as BLOCK_AUTHORITY (it lands as a system rejection below)
+        auth_block = (exp.get(i_auth) > 0) & valid & ~forced & ~overflow
     else:
         auth_block = zero_block
-    eligible = valid & ~auth_block & ~forced
+    eligible = valid & ~auth_block & ~forced & ~overflow
 
     if "system" in features:
         sys_block = E._check_system(
             cfg, state, rules, acq, now_ms, sys_load, sys_cpu, eligible
         )
+        sys_block = sys_block | overflow
     else:
-        sys_block = zero_block
+        sys_block = zero_block | overflow
     eligible = eligible & ~sys_block
 
     if with_param:
@@ -649,9 +668,18 @@ def run_checks_seg(
                 cfg.node_rows + cfg.max_flow_rules + 1,
             )
 
-        rank_tok, rank_thr, rank_cost = jax.lax.cond(
-            seg_rank_ok, _ranks_seg, _ranks_sort
-        )
+        if cfg.seg_static_ranks:
+            # scans only (cfg contract: sorted + DIRECT/ANY rules); if the
+            # contract breaks at runtime, ranks are garbage — fail closed
+            # below by blocking every applicable item rather than
+            # misranking silently
+            rank_tok, rank_thr, rank_cost = _ranks_seg()
+            rank_guard = ~seg_rank_ok
+        else:
+            rank_tok, rank_thr, rank_cost = jax.lax.cond(
+                seg_rank_ok, _ranks_seg, _ranks_sort
+            )
+            rank_guard = jnp.zeros((), bool)
         qps_block = rank_tok + cnt > margin_q
         thread_block = rank_thr + cnt > margin_t
         basic_block = jnp.where(qps_i, qps_block, thread_block)
@@ -660,6 +688,7 @@ def run_checks_seg(
         rl_block = rl_wait > mq_i
         entry_block = jnp.where(rl_i, rl_block, basic_block) & app_i
         entry_block = entry_block | (wurl_i & app_i & qps_block)
+        entry_block = entry_block | (rank_guard & app_i)
         flow_block = entry_block & elig_f
 
         occupying = jnp.zeros((b,), bool)
@@ -667,6 +696,11 @@ def run_checks_seg(
         occ_grant = None
         if occupy:
             cand = (acq.prio > 0) & def_i & qps_i & app_i & elig_f & qps_block
+            if cfg.seg_static_ranks:
+                # under a broken static-rank contract nothing may occupy
+                # ahead (a garbage grant would bypass the fail-closed
+                # entry_block above)
+                cand = cand & ~rank_guard
 
             def _occ_rank(cand):
                 def _seg():
@@ -682,7 +716,12 @@ def run_checks_seg(
                     (r,) = E._rank(cfg, node_i, [cnt], cand, cfg.node_rows)
                     return r
 
-                rank_occ = jax.lax.cond(seg_rank_ok, _seg, _sort)
+                if cfg.seg_static_ranks:
+                    # contract break -> rank_guard already blocks the
+                    # entry, so a garbage occupy rank cannot grant
+                    rank_occ = _seg()
+                else:
+                    rank_occ = jax.lax.cond(seg_rank_ok, _seg, _sort)
                 return cand & (rank_occ + cnt <= margin_o)
 
             granted = jax.lax.cond(
@@ -733,6 +772,14 @@ def run_checks_seg(
                 (r,) = grouped_exclusive_cumsum(acq.res, [cnt], ruled)
                 return r
 
+            if cfg.seg_static_ranks:
+                # unsorted batch under the static contract: block ruled
+                # tail items outright (fail closed, loud) — t_rank would
+                # be garbage
+                t_rank = _seg()
+                return ruled & (
+                    (est_t + t_rank + cnt > thr) | ~carry.res_sorted
+                )
             t_rank = jax.lax.cond(carry.res_sorted, _seg, _sort)
             return ruled & (est_t + t_rank + cnt > thr)
 
@@ -771,6 +818,11 @@ def run_checks_seg(
                 )
                 return r
 
+            if cfg.seg_static_ranks:
+                # unsorted under the static contract: elect NO probes
+                # (conservative — the breaker simply stays open a tick)
+                p_rank = _seg()
+                return cand & (p_rank < 0.5) & carry.res_sorted
             p_rank = jax.lax.cond(carry.res_sorted, _seg, _sort)
             return cand & (p_rank < 0.5)
 

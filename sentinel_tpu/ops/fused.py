@@ -235,6 +235,13 @@ def scatter_many(jobs: Sequence[Job], tb: int = TILE, interpret: Optional[bool] 
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
+        # Mosaic's default 16 MB scoped-vmem stack is marginal for the
+        # ~28-unit job mixes (observed 16.24 MB on a 27-val-row mix at
+        # B=4096 after the 2-D block-spec change); v5e has 128 MB VMEM
+        # per core, so double the scope rather than split finer
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024
+        ),
     )(*ins)
 
     # --- digit recombination (XLA elementwise; exact integer weights) ------
@@ -369,6 +376,10 @@ def gather_many(
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
+        # same scoped-vmem headroom as scatter_many (see comment there)
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024
+        ),
     )(*ins)
 
     results = []
