@@ -1,0 +1,135 @@
+"""Multi-host DCN-path benchmark: shard router + remote RES_CHECK shards.
+
+Measures the host-layer resource-sharding story (parallel/router.py +
+parallel/remote_shard.py) under the wire protocol it would use across
+hosts: N shard-host PROCESSES (tests/shard_host.py — full SentinelClient +
+ClusterTokenServer each), a ShardRouter fanning mixed batches out over
+real TCP sockets, results restored to arrival order.
+
+Reported per shard count (1 = single-host baseline):
+  - routed tokens/s of mixed check_batch traffic
+  - per-call p50/p99 latency (one call = one mixed batch = one concurrent
+    DCN round-trip to every shard touched)
+
+Caveats stated in the output: every "host" runs on THIS machine
+(loopback TCP, shared CPU) — the numbers isolate the router + protocol +
+per-shard engine cost; a real deployment adds wire RTT per call and gives
+each shard its own cores/chip.  The reference's cluster-server envelope is
+30k QPS/namespace (ServerFlowConfig.java:31).
+
+Writes MULTIHOST_BENCH.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+N_RESOURCES = 512
+BATCH = 256
+WARM_CALLS = 10
+MEASURE_S = 8.0
+
+
+def _spawn_shard(rules_json: str):
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tests", "shard_host.py"), rules_json],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    line = p.stdout.readline().strip()
+    assert line.startswith("PORT "), line
+    return p, int(line.split()[1])
+
+
+def run_point(n_shards: int, rng: np.random.Generator) -> dict:
+    from sentinel_tpu.parallel.remote_shard import RemoteShard
+    from sentinel_tpu.parallel.router import ShardRouter
+
+    resources = [f"svc-{i}" for i in range(N_RESOURCES)]
+    rules = json.dumps(
+        [{"resource": r, "count": 1_000_000} for r in resources]
+    )
+    procs = []
+    try:
+        ports = []
+        for _ in range(n_shards):
+            p, port = _spawn_shard(rules)
+            procs.append(p)
+            ports.append(port)
+        router = ShardRouter(
+            [RemoteShard("127.0.0.1", port, timeout_s=10) for port in ports]
+        )
+        # Zipf-ish mixed batches: every call touches many shards at once
+        ids = (rng.zipf(1.2, size=BATCH * 4096) - 1) % N_RESOURCES
+
+        def call(k):
+            batch = [resources[i] for i in ids[k * BATCH : (k + 1) * BATCH]]
+            return router.check_batch(batch)
+
+        for k in range(WARM_CALLS):
+            out = call(k)
+            assert len(out) == BATCH
+        lat = []
+        done = 0
+        t0 = time.perf_counter()
+        k = WARM_CALLS
+        while time.perf_counter() - t0 < MEASURE_S:
+            c0 = time.perf_counter()
+            call(k % 4096)
+            lat.append(time.perf_counter() - c0)
+            done += BATCH
+            k += 1
+        dt = time.perf_counter() - t0
+        lat_ms = np.asarray(lat) * 1000.0
+        return {
+            "shards": n_shards,
+            "routed_tokens_per_s": round(done / dt),
+            "calls": len(lat),
+            "call_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "call_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        }
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait()
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    points = [run_point(n, rng) for n in (1, 2, 4)]
+    base = points[0]
+    for pt in points:
+        pt["added_p99_ms_vs_single"] = round(
+            pt["call_p99_ms"] - base["call_p99_ms"], 2
+        )
+    result = {
+        "metric": "multihost_routed_tokens_per_s",
+        "batch": BATCH,
+        "resources": N_RESOURCES,
+        "points": points,
+        "environment": (
+            "all shard hosts on ONE machine over loopback TCP (shared "
+            "CPU): isolates router+protocol+engine cost; a real DCN "
+            "deployment adds wire RTT per call and dedicates cores per "
+            "shard"
+        ),
+        "reference_envelope": "30k QPS/namespace (ServerFlowConfig.java:31)",
+    }
+    print(json.dumps(result))
+    with open(os.path.join(ROOT, "MULTIHOST_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Attribute the bench tick's device time to SOURCE locations.
+
+profile_bench_trace.py buckets XLA op names ("fusion", "slice", ...), which
+cannot say WHICH slice costs a millisecond.  This runs the same traced
+scan, then joins each hot op against the compiled HLO's metadata
+(op_name="jit(many)/..." + source_file:line) so every hot op points at the
+engine source that generated it.
+
+Usage: python benchmarks/profile_bench_attrib.py [--batch 131072] [--k 12]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import re
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.profile_bench_trace import parse_xplane
+
+
+def hlo_metadata_index(hlo_text: str):
+    """op name -> (op_name metadata, source file:line) from HLO text."""
+    idx = {}
+    pat = re.compile(
+        r"%?([\w.\-]+) = [^\n]*?metadata={([^}]*)}"
+    )
+    for m in pat.finditer(hlo_text):
+        name, meta = m.group(1), m.group(2)
+        op_name = ""
+        src = ""
+        om = re.search(r'op_name="([^"]*)"', meta)
+        if om:
+            op_name = om.group(1)
+        fm = re.search(r'source_file="([^"]*)"', meta)
+        lm = re.search(r"source_line=(\d+)", meta)
+        if fm:
+            src = f"{os.path.basename(fm.group(1))}:{lm.group(1) if lm else '?'}"
+        idx[name] = (op_name, src)
+    return idx
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=131072)
+    ap.add_argument("--k", type=int, default=12)
+    ap.add_argument("--top", type=int, default=45)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+
+    cfg, E, ruleset, acqs, comps, seg_info = bench.build(args.batch, True)
+    KS = 4
+    sacq = jax.tree.map(lambda *xs: jnp.stack(xs), *(acqs[i % len(acqs)] for i in range(KS)))
+    scomp = jax.tree.map(lambda *xs: jnp.stack(xs), *(comps[i % len(comps)] for i in range(KS)))
+    state0 = E.init_state(cfg)
+    load = jnp.float32(0.0)
+    cpu = jnp.float32(0.0)
+
+    def many(state, base):
+        def body(s, t):
+            a = jax.tree.map(lambda x: x[t % KS], sacq)
+            c = jax.tree.map(lambda x: x[t % KS], scomp)
+            s, o = E.tick(s, ruleset, a, c, base + t * 7, load, cpu,
+                          cfg=cfg, features=E.ALL_FEATURES)
+            return s, o.verdict[0]
+
+        state, vs = jax.lax.scan(body, state, jnp.arange(args.k, dtype=jnp.int32))
+        return state, vs
+
+    jm = jax.jit(many)
+    lowered = jm.lower(state0, jnp.int32(0))
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+    meta = hlo_metadata_index(hlo)
+    print(f"HLO metadata entries: {len(meta)}")
+
+    jax.block_until_ready(jm(state0, jnp.int32(0)))
+    t0 = time.perf_counter()
+    jax.block_until_ready(jm(state0, jnp.int32(7)))
+    wall = time.perf_counter() - t0
+    print(f"scan of {args.k} ticks wall: {wall*1000:.2f} ms")
+
+    logdir = tempfile.mkdtemp(prefix="sentinel_attrib_")
+    jax.profiler.start_trace(logdir)
+    jax.block_until_ready(jm(state0, jnp.int32(13)))
+    jax.profiler.stop_trace()
+    agg, total_ps = parse_xplane(logdir)
+    per_tick_ms = total_ps / 1e9 / args.k
+    print(f"device total: {per_tick_ms:.3f} ms/tick")
+
+    rows = []
+    for name, ps in agg.items():
+        base = name.split(" = ")[0].lstrip("%")
+        op_name, src = meta.get(base, ("", ""))
+        rows.append((ps, base, op_name, src))
+    rows.sort(reverse=True)
+    print(f"{'ms/tick':>9}  {'%':>5}  op  |  source")
+    for ps, base, op_name, src in rows[: args.top]:
+        ms = ps / 1e9 / args.k
+        # compress the op_name path to its most informative tail
+        tail = "/".join(op_name.split("/")[-3:]) if op_name else ""
+        print(f"{ms:9.4f}  {100.0*ps/total_ps:5.1f}  {base[:44]:44s} {tail[:70]:70s} {src}")
+
+    # roll up by source line for a second view
+    by_src = collections.Counter()
+    for ps, base, op_name, src in rows:
+        key = src or ("<no-src> " + base.split(".")[0])
+        by_src[key] += ps
+    print("\nby source line:")
+    for src, ps in by_src.most_common(30):
+        print(f"{ps/1e9/args.k:9.4f}  {src}")
+
+
+if __name__ == "__main__":
+    main()

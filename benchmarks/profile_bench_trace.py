@@ -94,7 +94,8 @@ def main():
 
     import bench
 
-    cfg, E, ruleset, acqs, comps = bench.build(args.batch, True)
+    cfg, E, ruleset, acqs, comps, seg_info = bench.build(args.batch, True)
+    print("segments:", seg_info)
     KS = 4
     sacq = jax.tree.map(lambda *xs: jnp.stack(xs), *(acqs[i % len(acqs)] for i in range(KS)))
     scomp = jax.tree.map(lambda *xs: jnp.stack(xs), *(comps[i % len(comps)] for i in range(KS)))
