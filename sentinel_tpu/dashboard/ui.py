@@ -3,7 +3,10 @@
 The reference ships an AngularJS 1.x SPA with ECharts; this is the same
 idea at minimum viable scale with zero dependencies (vanilla JS + canvas):
 machine discovery table, per-app top resources, live QPS chart polling
-/metric once a second, and rule listings via the machine round-trip.
+/metric once a second, and a rule MANAGER (list/add/edit/delete for
+flow / degrade / paramFlow rules — the flow_v1.html / degrade.html /
+param_flow.html pages of the reference SPA) publishing the full per-type
+list through the same POST /rules machine round-trip the REST API exposes.
 Served by DashboardServer at GET /.
 """
 
@@ -22,6 +25,8 @@ PAGE = r"""<!doctype html>
   canvas { border: 1px solid #ddd; margin-top: .5rem; }
   select, input, button { font-size: .9rem; margin-right: .5rem; }
   #err { color: #c00; font-size: .85rem; }
+  .tab { background: #eee; border: 1px solid #bbb; padding: .2rem .7rem; }
+  #rules input, #rules select { margin: 0; }
 </style>
 </head>
 <body>
@@ -43,8 +48,22 @@ PAGE = r"""<!doctype html>
 <h2>top resources <span class="muted">(last second)</span></h2>
 <table id="top"><tr><th>resource</th><th>pass/s</th><th>block/s</th><th>avg rt</th><th>threads</th></tr></table>
 
-<h2>flow rules <span class="muted">(first healthy machine)</span></h2>
-<table id="rules"><tr><th>resource</th><th>count</th><th>grade</th><th>behavior</th><th>limitApp</th></tr></table>
+<h2>rules</h2>
+<div>
+  <label>machine <select id="rmach"></select></label>
+  <button class="tab" id="tab-flow">flow</button>
+  <button class="tab" id="tab-degrade">degrade</button>
+  <button class="tab" id="tab-paramFlow">paramFlow</button>
+  <button id="rload">reload</button>
+  <span class="muted">edits publish the FULL list for the selected type
+  (reference rule-manager semantics)</span>
+</div>
+<table id="rules"></table>
+<div>
+  <button id="radd">add rule</button>
+  <button id="rsave">save</button>
+  <span id="rout" class="muted"></span>
+</div>
 
 <h2>cluster assignment</h2>
 <div class="muted">pick one machine as token server; every other healthy
@@ -150,20 +169,162 @@ async function refreshTop(names) {
   }
 }
 
-async function refreshRules() {
-  const app = $("app").value;
-  const m = (apps[app] || []).find(m => m.healthy);
-  const t = $("rules");
-  t.innerHTML = "<tr><th>resource</th><th>count</th><th>grade</th><th>behavior</th><th>limitApp</th></tr>";
-  if (!m) return;
-  const rules = await j(`/rules?ip=${m.ip}&port=${m.port}&type=flow`);
-  for (const r of rules) {
-    const row = t.insertRow();
-    row.innerHTML = `<td>${esc(r.resource)}</td><td>${esc(r.count)}</td>` +
-      `<td>${r.grade == 1 ? "QPS" : "THREAD"}</td>` +
-      `<td>${esc(r.controlBehavior ?? 0)}</td><td>${esc(r.limitApp ?? "default")}</td>`;
-  }
+// ---- rule manager (flow_v1.html / degrade.html / param_flow.html) ------
+// column spec per rule type: [json field, label, kind]; kind: "s" text,
+// "n" number, or [value, label] pairs for a select
+const RCOLS = {
+  flow: [
+    ["resource", "resource", "s"],
+    ["grade", "grade", [[1, "QPS"], [0, "THREAD"]]],
+    ["count", "count", "n"],
+    ["strategy", "strategy", [[0, "DIRECT"], [1, "RELATE"], [2, "CHAIN"]]],
+    ["refResource", "refResource", "s"],
+    ["controlBehavior", "behavior",
+     [[0, "default"], [1, "warmUp"], [2, "rateLimiter"], [3, "warmUp+RL"]]],
+    ["maxQueueingTimeMs", "maxQueueMs", "n"],
+    ["limitApp", "limitApp", "s"],
+  ],
+  degrade: [
+    ["resource", "resource", "s"],
+    ["grade", "grade",
+     [[0, "slowRatio"], [1, "errorRatio"], [2, "errorCount"]]],
+    ["count", "count", "n"],
+    ["slowRatioThreshold", "slowRatio", "n"],
+    ["timeWindow", "windowSec", "n"],
+    ["minRequestAmount", "minRequests", "n"],
+    ["statIntervalMs", "statMs", "n"],
+  ],
+  paramFlow: [
+    ["resource", "resource", "s"],
+    ["paramIdx", "paramIdx", "n"],
+    ["grade", "grade", [[1, "QPS"], [0, "THREAD"]]],
+    ["count", "count", "n"],
+    ["durationInSec", "durationSec", "n"],
+    ["burstCount", "burst", "n"],
+  ],
+};
+const RDEFAULTS = {
+  flow: {resource: "", grade: 1, count: 10, strategy: 0, refResource: "",
+         controlBehavior: 0, maxQueueingTimeMs: 500, limitApp: "default"},
+  degrade: {resource: "", grade: 0, count: 100, slowRatioThreshold: 1.0,
+            timeWindow: 10, minRequestAmount: 5, statIntervalMs: 1000},
+  paramFlow: {resource: "", paramIdx: 0, grade: 1, count: 10,
+              durationInSec: 1, burstCount: 0},
+};
+let rtype = "flow", rrules = [];  // the editable full list for rtype
+let rloadedFrom = "";  // machine rrules was fetched from (save guard)
+
+function rmachine() {
+  const pick = $("rmach").value;
+  if (!pick) return null;
+  const [ip, port] = pick.split(":");
+  return {ip, port: +port};
 }
+
+function renderRules() {
+  const cols = RCOLS[rtype], t = $("rules");
+  document.querySelectorAll(".tab").forEach(b =>
+    b.style.fontWeight = b.id === "tab-" + rtype ? "bold" : "normal");
+  t.innerHTML = "<tr>" + cols.map(c => `<th>${esc(c[1])}</th>`).join("") +
+    "<th></th></tr>";
+  rrules.forEach((r, i) => {
+    const row = t.insertRow();
+    for (const [f, _label, kind] of cols) {
+      const cell = row.insertCell();
+      let el;
+      if (Array.isArray(kind)) {
+        el = document.createElement("select");
+        kind.forEach(([v, lab]) => el.add(new Option(lab, v)));
+        el.value = r[f] ?? kind[0][0];
+        el.onchange = () => { r[f] = +el.value; };
+      } else if (kind === "n") {
+        el = document.createElement("input");
+        el.type = "number";
+        el.style.width = "5.5rem";
+        el.value = r[f] ?? "";
+        // NaN would serialize to null and crash from_dict server-side;
+        // reject it at the field and keep the last good value
+        el.onchange = () => {
+          const v = parseFloat(el.value);
+          if (Number.isFinite(v)) { r[f] = v; el.style.background = ""; }
+          else { el.style.background = "#fdd"; el.value = r[f] ?? ""; }
+        };
+      } else {
+        el = document.createElement("input");
+        el.size = 14;
+        el.value = r[f] ?? "";
+        el.onchange = () => { r[f] = el.value; };
+      }
+      cell.appendChild(el);
+    }
+    const del = document.createElement("button");
+    del.textContent = "delete";
+    del.onclick = () => { rrules.splice(i, 1); renderRules(); };
+    row.insertCell().appendChild(del);
+  });
+}
+
+async function loadRules() {
+  const m = rmachine();
+  if (!m) { rrules = []; rloadedFrom = ""; renderRules(); return; }
+  rrules = await j(`/rules?ip=${m.ip}&port=${m.port}&type=${rtype}`);
+  rloadedFrom = $("rmach").value;
+  renderRules();
+}
+
+function refreshRuleMachines() {
+  const app = $("app").value, sel = $("rmach"), cur = sel.value;
+  sel.innerHTML = "";
+  (apps[app] || []).filter(m => m.healthy).forEach(m =>
+    sel.add(new Option(`${m.ip}:${m.port}`, `${m.ip}:${m.port}`)));
+  if (cur && [...sel.options].some(o => o.value === cur)) sel.value = cur;
+}
+
+for (const ty of ["flow", "degrade", "paramFlow"])
+  $("tab-" + ty).onclick = () => { rtype = ty; loadRules(); };
+$("rload").onclick = loadRules;
+$("rmach").onchange = loadRules;
+$("radd").onclick = () => {
+  rrules.push({...RDEFAULTS[rtype]});
+  renderRules();
+};
+$("rsave").onclick = async () => {
+  const m = rmachine();
+  if (!m) { $("rout").textContent = "no machine"; return; }
+  // publish is full-list REPLACE: saving a list loaded from machine A to
+  // machine B (select silently rebuilt by tick()) would wipe B's rules
+  if (rloadedFrom !== $("rmach").value) {
+    $("rout").textContent =
+      "machine changed since load — hit reload first (save would " +
+      "overwrite this machine's rules with the other machine's list)";
+    return;
+  }
+  const bad = rrules.find(r => !r.resource);
+  if (bad) { $("rout").textContent = "every rule needs a resource"; return; }
+  try {
+    const r = await fetch(
+      `/rules?ip=${m.ip}&port=${m.port}&type=${rtype}`, {
+        method: "POST",
+        headers: {...hdrs(), "Content-Type": "application/json"},
+        body: JSON.stringify(rrules),
+      });
+    const d = await r.json();
+    const pushed = d.pushed ?? 1, targets = d.targets ?? 1;
+    if (r.ok && pushed > 0) {
+      $("rout").textContent =
+        `published ${rrules.length} ${rtype} rules ` +
+        `(${esc(pushed)}/${esc(targets)} machines)` +
+        (pushed < targets ? " — SOME MACHINES REJECTED the push" : "");
+    } else if (r.ok) {
+      // HTTP 200 but no machine accepted: the rules are NOT live
+      $("rout").textContent =
+        `NOT published — 0/${esc(targets)} machines accepted the push`;
+    } else {
+      $("rout").textContent = `failed: ${esc(d.error || r.status)}`;
+    }
+    if (r.ok && pushed > 0) loadRules();  // re-read: what you see is live
+  } catch (e) { $("rout").textContent = String(e); }
+};
 
 async function refreshAssign() {
   const app = $("app").value;
@@ -195,13 +356,20 @@ $("assign").onclick = async () => {
   } catch (e) { $("assignout").textContent = String(e); }
 };
 
+let rulesLoadedOnce = false;
 async function tick() {
   try {
     await refreshApps();
     const top = await refreshResources();
     await refreshChart();
     await refreshTop(top);
-    await refreshRules();
+    // the rule EDITOR never auto-refreshes (it would wipe in-progress
+    // edits); machines list stays fresh, content loads on demand
+    refreshRuleMachines();
+    if (!rulesLoadedOnce && $("rmach").value) {
+      rulesLoadedOnce = true;
+      await loadRules();
+    }
     await refreshAssign();
     $("err").textContent = "";
   } catch (e) { $("err").textContent = String(e); }
