@@ -1800,7 +1800,9 @@ def _check_tail_flow(
         cols = P.cms_cell(acq.res, cfg.sketch_depth, cfg.sketch_width)
         thrs = []
         for d in range(cfg.sketch_depth):
-            t = T.big_gather(cfg, thr_tab[d], cols[:, d], cfg.sketch_width)
+            t = T.lane_gather_1col(
+                cfg, thr_tab[d], cols[:, d], cfg.sketch_width
+            )
             # invalid ids gather 0 — restore the unruled sentinel for them
             thrs.append(jnp.where(elig, t, RT.TAIL_UNRULED))
         thr = jnp.max(jnp.stack(thrs, axis=0), axis=0)

@@ -319,7 +319,11 @@ def run_checks_seg(
     with_auth = "authority" in features
     if with_auth:
         n = cfg.max_resources + 1
-        mode = T.big_gather(cfg, rules.auth.mode, res_l, n, max_int=255)
+        # 1-column slot/mode tables ride the lane-packed gather (an MXU
+        # one-hot pass per digit plane costs ~0.1 ms each at U~16K)
+        mode = T.lane_gather_1col_int(
+            cfg, jnp.asarray(rules.auth.mode), res_l, n
+        )
         origins = T.big_gather(cfg, rules.auth.origins, res_l, n)
         listed = (
             (origins == carry.origin_id[:, None]) & (origins != RT.AUTH_EMPTY)
@@ -328,10 +332,11 @@ def run_checks_seg(
 
     with_param = "param" in features
     if with_param:
-        pslot_u = T.big_gather(
-            cfg, rules.param.res_params, res_l, cfg.max_resources + 1,
-            max_int=cfg.max_param_rules,
-        ).reshape(-1)
+        # KP == 1 statically (the seg_checks gate) -> 1-column lane gather
+        pslot_u = T.lane_gather_1col_int(
+            cfg, jnp.asarray(rules.param.res_params)[:, 0], res_l,
+            cfg.max_resources + 1,
+        )
         pcms, pcms_epochs, pcms_idx = P.refresh(
             state.pcms, state.pcms_epochs, now_ms, cfg
         )
@@ -371,10 +376,10 @@ def run_checks_seg(
     if with_flow:
         f = rules.flow
         sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
-        slot_u = T.big_gather(
-            cfg, f.res_rules, res_l, cfg.max_resources + 1,
-            max_int=cfg.max_flow_rules,
-        ).reshape(-1)
+        slot_u = T.lane_gather_1col_int(
+            cfg, jnp.asarray(f.res_rules)[:, 0], res_l,
+            cfg.max_resources + 1,
+        )
         fg = T.small_gather_fields(
             cfg,
             T.pack_fields(
@@ -479,7 +484,9 @@ def run_checks_seg(
             tcols = P.cms_cell(tres_u, cfg.sketch_depth, cfg.sketch_width)
             thrs = []
             for d in range(cfg.sketch_depth):
-                t = T.big_gather(cfg, thr_tab[d], tcols[:, d], cfg.sketch_width)
+                t = T.lane_gather_1col(
+                    cfg, thr_tab[d], tcols[:, d], cfg.sketch_width
+                )
                 thrs.append(jnp.where(tail_u, t, RT.TAIL_UNRULED))
             thr_u = jnp.max(jnp.stack(thrs, axis=0), axis=0)
             est_u = GS.estimate_plane_mxu(
@@ -501,10 +508,10 @@ def run_checks_seg(
 
     with_degrade = "degrade" in features
     if with_degrade:
-        dslot_u = T.big_gather(
-            cfg, rules.degrade.res_cbs, res_l, cfg.max_resources + 1,
-            max_int=cfg.max_degrade_rules,
-        ).reshape(-1)
+        dslot_u = T.lane_gather_1col_int(
+            cfg, jnp.asarray(rules.degrade.res_cbs)[:, 0], res_l,
+            cfg.max_resources + 1,
+        )
         dgu = T.small_gather_fields(
             cfg, T.pack_fields([rules.degrade.enabled, state.cb_state]), dslot_u
         )

@@ -151,12 +151,9 @@ def estimate_plane_mxu(
     cap = jnp.int32((1 << 24) - 1)
     ests = []
     for d in range(cfg.depth):
-        g = T.big_gather(
-            ecfg,
-            jnp.minimum(windowed[d], cap),
-            cols[:, d],
-            cfg.width,
-            max_int=(1 << 24) - 1,
+        # lane-packed 1-column gather: exact for counts <= 2^24 (clamped)
+        g = T.lane_gather_1col(
+            ecfg, jnp.minimum(windowed[d], cap), cols[:, d], cfg.width
         )
         ests.append(g)
     return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)

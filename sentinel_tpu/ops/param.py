@@ -167,20 +167,10 @@ def estimate_fused(
     depth, Q, C = wtab.shape
     cap = jnp.int32(256**cfg.param_est_digits - 1)
     idx = jnp.clip(rows, 0, Q - 1) * C + jnp.clip(cls, 0, C - 1)[:, None]
-    lane_oh = (
-        (idx & 7)[:, :, None]
-        == jax.lax.broadcasted_iota(jnp.int32, (1, 1, 8), 2)
-    ).astype(jnp.float32)  # [N, depth, 8]
     ests = []
     for d in range(depth):
-        flat = jnp.minimum(
-            wtab[d].reshape(-1).astype(jnp.int32), cap
-        ).astype(jnp.float32)
-        pad = (-flat.shape[0]) % 8
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-        g = flat.reshape(-1, 8)[idx[:, d] >> 3]  # [N, 8] row gather
-        ests.append(jnp.sum(g * lane_oh[:, d], axis=1))
+        flat = jnp.minimum(wtab[d].reshape(-1).astype(jnp.int32), cap)
+        ests.append(T.lane_gather_1col(cfg, flat, idx[:, d], Q * C))
     return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
 
 

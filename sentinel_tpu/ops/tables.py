@@ -61,6 +61,41 @@ def big_gather(
     return MX.gather(table, plan, Hi, Lo, max_int=max_int)
 
 
+def lane_gather_1col(
+    cfg: EngineConfig, table: jax.Array, idx: jax.Array, n: int
+) -> jax.Array:
+    """f32 table[idx] for a ONE-COLUMN table, zeros for ids outside [0, n).
+
+    Direct 1-column gathers are pathological on TPU (~0.9 ms at 128K
+    indices — and padding the table is undone by the compiler narrowing
+    the gather to the used columns); the MXU one-hot gather pays a full
+    index-axis pass per digit plane.  Packing the column as [n/8, 8] and
+    selecting the lane with a DATA-DEPENDENT one-hot keeps the row read
+    8 lanes wide and cannot be narrowed.  Exact: native row gather +
+    multiply by exact 0/1 (same trick as param.estimate_fused)."""
+    ok = (idx >= 0) & (idx < n)
+    safe = jnp.clip(idx, 0, n - 1)
+    if not cfg.use_mxu_tables:
+        return jnp.where(ok, table[safe].astype(jnp.float32), 0.0)
+    t = table.astype(jnp.float32)
+    pad = (-n) % 8
+    if pad:
+        t = jnp.concatenate([t, jnp.zeros((pad,), jnp.float32)])
+    g = t.reshape(-1, 8)[safe >> 3]  # [N, 8] row gather
+    oh = (
+        (safe & 7)[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, 8), 1)
+    ).astype(jnp.float32)
+    return jnp.where(ok, jnp.sum(g * oh, axis=1), 0.0)
+
+
+def lane_gather_1col_int(
+    cfg: EngineConfig, table: jax.Array, idx: jax.Array, n: int
+) -> jax.Array:
+    """lane_gather_1col for small-int tables (slot ids, modes): values are
+    f32-exact (< 2^24), so a plain cast restores them."""
+    return lane_gather_1col(cfg, table, idx, n).astype(jnp.int32)
+
+
 def big_scatter_add(
     cfg: EngineConfig,
     table: jax.Array,

@@ -82,3 +82,39 @@ def test_scatter_or():
     Hi, Lo = M.onehots(jnp.asarray(idx), plan)
     out = np.asarray(M.scatter_or(jnp.asarray(table), plan, Hi, Lo, jnp.asarray(flag)))
     np.testing.assert_array_equal(out, oracle)
+
+
+def test_lane_gather_1col_matches_big_gather():
+    """The lane-packed 1-column gather (pad to 8 lanes + data-dependent
+    select) must match big_gather exactly — including out-of-range ids
+    (zeros), n not a multiple of 8, and large f32 sentinels — on both the
+    mxu and plain backends."""
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.ops import tables as T
+
+    rng = np.random.default_rng(9)
+    for n in (4093, 4096, 16384):
+        idx = rng.integers(-3, n + 5, 777).astype(np.int32)  # incl. OOB
+        for table in (
+            rng.integers(0, (1 << 24) - 1, n).astype(np.int32),
+            np.where(
+                rng.random(n) < 0.5, 2.0e38, rng.random(n) * 100
+            ).astype(np.float32),
+        ):
+            for mxu in (False, True):
+                cfg = small_engine_config(use_mxu_tables=mxu)
+                got = np.asarray(
+                    T.lane_gather_1col(cfg, jnp.asarray(table), jnp.asarray(idx), n)
+                )
+                ok = (idx >= 0) & (idx < n)
+                want = np.where(ok, table[np.clip(idx, 0, n - 1)], 0).astype(
+                    np.float32
+                )
+                np.testing.assert_array_equal(got, want)
+    # int variant restores exact small ints
+    cfg = small_engine_config(use_mxu_tables=True)
+    tab = rng.integers(0, 4096, 1000).astype(np.int32)
+    ids = rng.integers(0, 1000, 256).astype(np.int32)
+    got = np.asarray(T.lane_gather_1col_int(cfg, jnp.asarray(tab), jnp.asarray(ids), 1000))
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, tab[ids])
