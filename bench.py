@@ -359,6 +359,13 @@ def main() -> None:
     verd = np.asarray(out.verdict)
     res_last = np.asarray(acqs[(n_ticks - 1) % n_batches].res)
     tail_blocked = int(((verd == BLOCK_FLOW) & (res_last >= cfg.node_rows)).sum())
+    if on_tpu:
+        # the 'active tail rules' headline must describe ENFORCED rules: if
+        # compile_tail_flow_rules or the ruleset._replace silently stopped
+        # taking effect, fail the benchmark rather than print a dead label
+        assert tail_blocked > 0, (
+            "tail rules present but no tail id blocked in the sampled tick"
+        )
 
     # --- device tick time (slope; tunnel overhead cancels) -----------------
     dev_ms = device_tick_ms(cfg, E_mod, ruleset, acqs, comps) if on_tpu else pipelined_tick_ms

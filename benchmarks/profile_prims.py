@@ -24,7 +24,6 @@ def main():
     from sentinel_tpu.ops import tables as T
     from sentinel_tpu.ops import window as W
     from sentinel_tpu.ops import gsketch as GS
-    from sentinel_tpu.ops import pallas_tables as PT
     from sentinel_tpu.ops.rank import (
         fast_cumsum,
         grouped_exclusive_cumsum,
@@ -80,17 +79,6 @@ def main():
     bench("window add_dense", lambda i: W.add_dense(ws, jnp.int32(100), hist, rt_hist, W.WindowConfig(2, 500)).counts)
     bench("fast_cumsum B", lambda i: fast_cumsum(fvals + i))
     bench("window_event dense", lambda i: W.window_event(ws, jnp.int32(100) + i, W.WindowConfig(2, 500), W.EV_PASS))
-
-    if PT.available():
-        print("=== pallas kernels ===")
-        bench("PT.scatter_add 5p int", lambda i: PT.scatter_add(ids + i, deltas5, rows))
-        bench("PT.scatter_add 1p int", lambda i: PT.scatter_add(ids + i, vals1, rows))
-        bench("PT.gather 2p int24", lambda i: PT.gather(ids + i, table2, rows, max_int=1 << 24))
-        bench("PT.gather 13f HIGHEST", lambda i: PT.gather(ids + i, packed, cfg.max_flow_rules + 1))
-        bench("PT.gather_int", lambda i: PT.gather_int(ids + i, itab, cfg.max_flow_rules + 1))
-        bench(f"PT.grouped_rank 3v S={ks}", lambda i: PT.grouped_rank(ids + i, [fvals, fvals, fvals], ids > 0, ks)[0])
-        bench(f"PT.grouped_rank 1v S=16384", lambda i: PT.grouped_rank(ids + i, [fvals], ids > 0, 16384)[0])
-
 
 if __name__ == "__main__":
     main()

@@ -311,16 +311,18 @@ $("rsave").onclick = async () => {
     const d = await r.json();
     const pushed = d.pushed ?? 1, targets = d.targets ?? 1;
     if (r.ok && pushed > 0) {
+      // textContent assignments need no esc() — the DOM treats the
+      // string as text, and double-escaping would render '&amp;' literally
       $("rout").textContent =
         `published ${rrules.length} ${rtype} rules ` +
-        `(${esc(pushed)}/${esc(targets)} machines)` +
+        `(${pushed}/${targets} machines)` +
         (pushed < targets ? " — SOME MACHINES REJECTED the push" : "");
     } else if (r.ok) {
       // HTTP 200 but no machine accepted: the rules are NOT live
       $("rout").textContent =
-        `NOT published — 0/${esc(targets)} machines accepted the push`;
+        `NOT published — 0/${targets} machines accepted the push`;
     } else {
-      $("rout").textContent = `failed: ${esc(d.error || r.status)}`;
+      $("rout").textContent = `failed: ${d.error || r.status}`;
     }
     if (r.ok && pushed > 0) loadRules();  // re-read: what you see is live
   } catch (e) { $("rout").textContent = String(e); }
@@ -350,9 +352,9 @@ $("assign").onclick = async () => {
     });
     const d = await r.json();
     $("assignout").textContent = r.ok
-      ? `server ${esc(d.server.ip)} token port ${esc(d.server.tokenPort)}, ` +
+      ? `server ${d.server.ip} token port ${d.server.tokenPort}, ` +
         `${d.clients.filter(c => c.ok).length}/${d.clients.length} clients flipped`
-      : `failed: ${esc(d.error || r.status)}`;
+      : `failed: ${d.error || r.status}`;
   } catch (e) { $("assignout").textContent = String(e); }
 };
 

@@ -41,7 +41,11 @@ def _respawned(test_id: str) -> bool:
     r = subprocess.run(
         [
             sys.executable, "-m", "pytest", "-x", "-q",
-            "-p", "no:cacheprovider", test_id,
+            # -o addopts= strips pytest.ini's xdist options (-n 4): each
+            # respawn must be ONE plain in-process session, not a 4-worker
+            # xdist fleet of its own; no:cacheprovider keeps respawns from
+            # racing on .pytest_cache
+            "-p", "no:cacheprovider", "-o", "addopts=", test_id,
         ],
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         env=env,
