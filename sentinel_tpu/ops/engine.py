@@ -236,35 +236,41 @@ def sketch_config(cfg: EngineConfig) -> GS.SketchConfig:
 
 
 def empty_acquire(cfg: EngineConfig, b: Optional[int] = None) -> AcquireBatch:
+    # every leaf gets its OWN buffer — two pytree leaves sharing one device
+    # buffer bakes a deduplicated parameter list into the executable that
+    # compiles from that call, and a later call with a different sharing
+    # pattern fails with a buffer-count mismatch (observed on jaxlib CPU:
+    # 'Execution supplied 57 buffers but compiled program expected 58')
     b = b or cfg.batch_size
     trash = cfg.trash_row
-    z = jnp.zeros((b,), dtype=jnp.int32)
+    z = lambda: jnp.zeros((b,), dtype=jnp.int32)
     return AcquireBatch(
         res=jnp.full((b,), trash, dtype=jnp.int32),
-        count=z,
-        prio=z,
+        count=z(),
+        prio=z(),
         origin_id=jnp.full((b,), -1, dtype=jnp.int32),
         origin_node=jnp.full((b,), trash, dtype=jnp.int32),
         ctx_node=jnp.full((b,), trash, dtype=jnp.int32),
         ctx_name=jnp.full((b,), -1, dtype=jnp.int32),
-        inbound=z,
+        inbound=z(),
         param_hash=jnp.zeros((b, cfg.param_dims), dtype=jnp.int32),
-        pre_verdict=z,
+        pre_verdict=z(),
     )
 
 
 def empty_complete(cfg: EngineConfig, b: Optional[int] = None) -> CompleteBatch:
+    # distinct buffer per leaf — see empty_acquire
     b = b or cfg.complete_batch_size
     trash = cfg.trash_row
-    z = jnp.zeros((b,), dtype=jnp.int32)
+    z = lambda: jnp.zeros((b,), dtype=jnp.int32)
     return CompleteBatch(
         res=jnp.full((b,), trash, dtype=jnp.int32),
         origin_node=jnp.full((b,), trash, dtype=jnp.int32),
         ctx_node=jnp.full((b,), trash, dtype=jnp.int32),
-        inbound=z,
+        inbound=z(),
         rt=jnp.zeros((b,), dtype=jnp.float32),
-        success=z,
-        error=z,
+        success=z(),
+        error=z(),
         param_hash=jnp.zeros((b, cfg.param_dims), dtype=jnp.int32),
     )
 
@@ -2385,6 +2391,9 @@ def compile_ruleset(
                 r.grade == _GQ
                 and r.control_behavior == _CD
                 and r.strategy == _SD
+                # the tail table has no origin dimension: an origin-scoped
+                # rule compiled there would throttle EVERY origin
+                and (r.limit_app or "default") == "default"
                 and cfg.sketch_stats
             ):
                 tail.append((rid, float(r.count)))
@@ -2393,8 +2402,9 @@ def compile_ruleset(
 
                 record_log().warning(
                     "flow rule on tail resource %r needs exact windows "
-                    "(grade/behavior/strategy unsupported in the tail) and "
-                    "will NOT be enforced; free exact rows or simplify it",
+                    "(grade/behavior/strategy/limitApp unsupported in the "
+                    "tail) and will NOT be enforced; free exact rows or "
+                    "simplify it",
                     r.resource,
                 )
         else:

@@ -22,9 +22,10 @@ from __future__ import annotations
 from typing import Sequence, Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
-_NEG = jnp.float32(-3.0e38)
+_NEG = np.float32(-3.0e38)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
 
 
 def fast_cumsum(v: jax.Array) -> jax.Array:

@@ -26,13 +26,14 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.ops import tables as T
 
 #: int32 bit pattern above any valid positive float's bits
-_ABSENT = jnp.int32(0x7F000000)
+_ABSENT = np.int32(0x7F000000)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
 
 
 def min_heads(

@@ -41,14 +41,15 @@ from __future__ import annotations
 from typing import NamedTuple, Sequence, Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 #: segments never span a BLOCK-item boundary (synthetic heads), capping
 #: segment length so digit-plane sums stay exact (see module docstring)
 BLOCK = 256
 
-_INT_MIN = jnp.int32(-(2**31) + 1)
-_INT_MAX = jnp.int32(2**31 - 1)
+_INT_MIN = np.int32(-(2**31) + 1)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
+_INT_MAX = np.int32(2**31 - 1)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
 
 
 class SegCtx(NamedTuple):
@@ -307,7 +308,9 @@ def expand(ctx: SegCtx, seg_vals: jax.Array) -> jax.Array:
 def sort_batch(key_cols: Sequence[jax.Array], payloads: Sequence[jax.Array]):
     """Device-side stable sort fallback for callers without a presorted
     batch: returns (perm, sorted_payloads).  The runtime client presorts
-    on the host (C radix argsort) and skips this."""
+    on the host instead (np.lexsort over the segment keys in
+    runtime/client._run_tick, verdicts mapped back through the inverse
+    permutation) and never calls this."""
     n = key_cols[0].shape[0]
     pos = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
     ops = list(key_cols) + [pos] + [p for p in payloads]
