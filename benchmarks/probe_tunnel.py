@@ -1,0 +1,86 @@
+"""Probe the TPU tunnel's readback characteristics for the client-path
+bench design: latency vs size, overlap across threads, async copy APIs.
+"""
+
+import time
+import threading
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    f = jax.jit(lambda x, t: (x + t).astype(jnp.int8))
+    xs = [f(jnp.zeros((131072,), jnp.int32), i) for i in range(24)]
+    jax.block_until_ready(xs)
+
+    # 1. serial readback latency, int8[128K]
+    t0 = time.perf_counter()
+    for i in range(8):
+        _ = np.asarray(xs[i])
+    dt = (time.perf_counter() - t0) / 8 * 1000
+    print(f"serial np.asarray int8[128K]: {dt:.1f} ms each", flush=True)
+
+    # 2. tiny readback latency
+    small = [f(jnp.zeros((8,), jnp.int32), i) for i in range(8)]
+    jax.block_until_ready(small)
+    t0 = time.perf_counter()
+    for s in small:
+        _ = np.asarray(s)
+    dt = (time.perf_counter() - t0) / 8 * 1000
+    print(f"serial np.asarray int8[8]: {dt:.1f} ms each", flush=True)
+
+    # 3. threaded overlap: 8 arrays, 8 threads
+    def worker(a, out, i):
+        t0 = time.perf_counter()
+        _ = np.asarray(a)
+        out[i] = time.perf_counter() - t0
+
+    for nthreads in (2, 4, 8):
+        arrs = [f(jnp.zeros((131072,), jnp.int32), 100 + i) for i in range(nthreads)]
+        jax.block_until_ready(arrs)
+        outs = [0.0] * nthreads
+        t0 = time.perf_counter()
+        ts = [
+            threading.Thread(target=worker, args=(a, outs, i))
+            for i, a in enumerate(arrs)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        total = (time.perf_counter() - t0) * 1000
+        print(
+            f"threads={nthreads}: total {total:.1f} ms "
+            f"(per-array if serial would be ~{total/nthreads:.1f})",
+            flush=True,
+        )
+
+    # 4. copy_to_host_async then gather
+    arrs = [f(jnp.zeros((131072,), jnp.int32), 200 + i) for i in range(8)]
+    jax.block_until_ready(arrs)
+    t0 = time.perf_counter()
+    for a in arrs:
+        a.copy_to_host_async()
+    mid = (time.perf_counter() - t0) * 1000
+    for a in arrs:
+        _ = np.asarray(a)
+    total = (time.perf_counter() - t0) * 1000
+    print(f"copy_to_host_async x8: launch {mid:.1f} ms, total {total:.1f} ms", flush=True)
+
+    # 5. chained ticks with one readback at the end (device pipelining
+    # sanity): 8 dependent adds then one fetch
+    y = jnp.zeros((131072,), jnp.int32)
+    g = jax.jit(lambda x: x + 1)
+    jax.block_until_ready(g(y))
+    t0 = time.perf_counter()
+    z = y
+    for _ in range(8):
+        z = g(z)
+    _ = np.asarray(z)
+    print(f"8 chained + 1 fetch: {(time.perf_counter()-t0)*1000:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Tunnel bandwidth + batched-fetch probes."""
+
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timed(label, fn, n=3):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1000)
+    print(f"{label}: {sorted(ts)[len(ts)//2]:.1f} ms (median of {n})", flush=True)
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    mk = lambda t, n: jax.jit(lambda t: jnp.full((n,), t, jnp.int8))(t)
+    mk32 = lambda t, n: jax.jit(lambda t: jnp.full((n,), t, jnp.int32))(t)
+
+    for size, label in ((1 << 17, "int8[128K] (128KB)"), ((1 << 20), "int8[1M] (1MB)")):
+        a = mk(1, size)
+        jax.block_until_ready(a)
+        timed(f"fetch {label}", lambda a=a: np.asarray(a))
+
+    a4 = mk32(1, 1 << 20)
+    jax.block_until_ready(a4)
+    timed("fetch int32[1M] (4MB)", lambda: np.asarray(a4))
+
+    # device_get on a LIST — one call, many arrays
+    arrs = [mk(i, 1 << 17) for i in range(16)]
+    jax.block_until_ready(arrs)
+    timed("device_get(list of 16 x 128KB)", lambda: jax.device_get(arrs), n=3)
+
+    # deep async pipeline: 24 arrays, async then fetch
+    arrs = [mk(100 + i, 1 << 17) for i in range(24)]
+    jax.block_until_ready(arrs)
+
+    def deep():
+        for a in arrs:
+            a.copy_to_host_async()
+        for a in arrs:
+            np.asarray(a)
+
+    timed("async x24 then fetch (24 x 128KB)", deep, n=2)
+
+    # int16 vs int8+int32 pair (verdict+wait packing question)
+    v = mk(1, 1 << 17)
+    w = mk32(2, 1 << 17)
+    jax.block_until_ready([v, w])
+
+    def pair():
+        v.copy_to_host_async()
+        w.copy_to_host_async()
+        np.asarray(v)
+        np.asarray(w)
+
+    timed("fetch pair int8[128K]+int32[128K]", pair)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Tunnel transfer cost vs size, fresh arrays only (no host-cache hits)."""
+
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def fresh(t, n, dtype=jnp.int8):
+    return jax.jit(lambda t: jnp.full((n,), t, dtype))(t)
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    seq = 0
+
+    def probe(label, n, dtype, reps=3):
+        nonlocal seq
+        ts = []
+        for _ in range(reps):
+            seq += 1
+            a = fresh(seq, n, dtype)
+            jax.block_until_ready(a)
+            t0 = time.perf_counter()
+            np.asarray(a)
+            ts.append((time.perf_counter() - t0) * 1000)
+        print(f"{label}: {sorted(ts)[len(ts)//2]:.1f} ms median {ts}", flush=True)
+
+    probe("fresh int8[8]", 8, jnp.int8)
+    probe("fresh int8[128K] (128KB)", 1 << 17, jnp.int8)
+    probe("fresh int8[1M] (1MB)", 1 << 20, jnp.int8)
+    probe("fresh int32[1M] (4MB)", 1 << 20, jnp.int32)
+    probe("fresh int32[4M] (16MB)", 1 << 22, jnp.int32)
+
+    # async-overlap effective per-array cost at depth 24, fresh
+    arrs = []
+    for i in range(24):
+        seq_l = 1000 + i
+        arrs.append(fresh(seq_l, 1 << 17, jnp.int8))
+    jax.block_until_ready(arrs)
+    t0 = time.perf_counter()
+    for a in arrs:
+        a.copy_to_host_async()
+    for a in arrs:
+        np.asarray(a)
+    dt = (time.perf_counter() - t0) * 1000
+    print(f"async depth-24 fresh 128KB: {dt:.1f} total, {dt/24:.1f} ms each", flush=True)
+
+
+if __name__ == "__main__":
+    main()

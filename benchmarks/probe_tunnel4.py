@@ -1,0 +1,64 @@
+"""Does copy_to_host_async overlap when issued at DISPATCH time (array
+not yet computed)?  And do threaded fetches overlap with dispatch?"""
+
+import time
+import threading
+from concurrent.futures import ThreadPoolExecutor
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    N = 1 << 17
+    step = jax.jit(lambda s, t: (s + t, (s[:N] + t).astype(jnp.int8)))
+    s0 = jnp.zeros((N,), jnp.int32)
+    s, v = step(s0, 1)
+    jax.block_until_ready(v)
+
+    # A: dispatch 12 chained steps, async-copy each verdict at dispatch,
+    # then resolve in order
+    t0 = time.perf_counter()
+    outs = []
+    s_ = s
+    for t in range(12):
+        s_, v = step(s_, t)
+        v.copy_to_host_async()
+        outs.append(v)
+    for v in outs:
+        np.asarray(v)
+    print(f"A dispatch-time async x12: {(time.perf_counter()-t0)*1000:.1f} ms total", flush=True)
+
+    # B: same but resolve with a 6-thread pool
+    t0 = time.perf_counter()
+    outs = []
+    s_ = s
+    for t in range(12):
+        s_, v = step(s_, 100 + t)
+        outs.append(v)
+    with ThreadPoolExecutor(6) as ex:
+        list(ex.map(np.asarray, outs))
+    print(f"B threadpool-6 fetch x12: {(time.perf_counter()-t0)*1000:.1f} ms total", flush=True)
+
+    # C: interleaved steady-state: dispatch tick t, fetch tick t-4 on pool
+    t0 = time.perf_counter()
+    s_ = s
+    pend = []
+    futs = []
+    with ThreadPoolExecutor(6) as ex:
+        for t in range(24):
+            s_, v = step(s_, 200 + t)
+            pend.append(v)
+            if len(pend) > 4:
+                futs.append(ex.submit(np.asarray, pend.pop(0)))
+        for v in pend:
+            futs.append(ex.submit(np.asarray, v))
+        for f in futs:
+            f.result()
+    dt = (time.perf_counter() - t0) * 1000
+    print(f"C steady-state depth-4 pool-6 x24: {dt:.1f} ms total, {dt/24:.1f}/tick", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,12 @@ class DynamicSentinelProperty(SentinelProperty[T]):
         self._value: Optional[T] = value
         self._lock = threading.RLock()
 
+    @property
+    def value(self) -> Optional[T]:
+        """Current value (read-side peek for dashboards/tests; the
+        reference keeps this package-private but the need is the same)."""
+        return self._value
+
     def add_listener(self, listener: PropertyListener[T]) -> None:
         with self._lock:
             self._listeners.append(listener)

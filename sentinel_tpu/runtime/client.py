@@ -17,8 +17,20 @@ Modes:
   * ``threaded``— a daemon tick loop services futures; entry() blocks.
                   This is the serving configuration.
 
-Bulk path: ``check_batch`` submits N acquires in one call and ticks once —
-the native TPU API used by the cluster token server and the benchmark.
+Bulk paths: ``check_batch`` submits N acquires in one call (per-item
+objects); ``submit_block``/``check_batch_ids`` submit COLUMN ARRAYS of
+resource ids with zero per-item Python — the TPU-native surface used by
+the cluster token server, gateway adapters, and the benchmark.
+
+Fast-path integration (the config defaults to it on TPU — see
+core.config.platform_engine_config): with the segment-compacted engine
+enabled, the tick builder presorts every batch by the engine's segment
+keys (np.lexsort; stable, so per-key arrival order and therefore every
+rank/verdict is bit-identical) and maps verdicts back through the inverse
+permutation; observed live-segment counts auto-grow cfg.seg_u via a
+compile-then-swap resize; with ``pipeline_depth`` > 0 the loop runs up to
+that many ticks ahead of verdict readback so the device→host transfer
+overlaps compute (it drains fully before going idle).
 """
 
 from __future__ import annotations
@@ -73,6 +85,54 @@ class Completion:
     success: int
     error: int
     param_hash: tuple = ()  # THREAD-grade release lanes
+
+
+@dataclass
+class ArrayBlock:
+    """A bulk acquire submission: column arrays, no per-item Python.
+
+    The TPU-native high-throughput surface (gateway adapters, the cluster
+    token server, the benchmark): resource IDS (registry currency) and
+    optional per-item columns.  The tick loop slices blocks into engine
+    batches; ``future`` resolves to (verdicts int8 [n], waits int32 [n])
+    in submission order once every item has been decided."""
+
+    res: np.ndarray  # int32 [n]
+    count: Optional[np.ndarray] = None
+    prio: Optional[np.ndarray] = None
+    origin_id: Optional[np.ndarray] = None
+    origin_node: Optional[np.ndarray] = None
+    ctx_node: Optional[np.ndarray] = None
+    ctx_name: Optional[np.ndarray] = None
+    inbound: Optional[np.ndarray] = None
+    param_hash: Optional[np.ndarray] = None  # int32 [n, param_dims]
+    pre_verdict: Optional[np.ndarray] = None
+    future: Optional[Future] = None
+    # internal progress
+    taken: int = 0  # items already placed into ticks
+    unresolved: int = 0  # items whose verdicts are still pending
+    verdicts: Optional[np.ndarray] = None  # int8 [n] result buffer
+    waits: Optional[np.ndarray] = None  # int32 [n] result buffer
+
+
+@dataclass
+class _PendingTick:
+    """A dispatched engine tick whose outputs haven't been read back.
+
+    The tick loop resolves these up to ``pipeline_depth`` ticks behind
+    dispatch, so the device→host verdict transfer of tick t overlaps the
+    host build + device compute of tick t+1 (on a tunnel-attached TPU the
+    transfer RTT dominates; on host-attached PCIe this costs nothing and
+    depth 0 behaves identically)."""
+
+    acq: List[AcquireRequest]
+    blocks: list  # [(ArrayBlock, src_off, take), ...] at batch offset n
+    fronts: list
+    inv_a: Optional[np.ndarray]
+    out: Any  # TickOutput (device arrays)
+    check_dropped: bool
+    n_obj: int  # object-request count (blocks start here)
+    n_blk: int  # block item count (fronts start at n_obj + n_blk)
 
 
 class Entry:
@@ -224,11 +284,16 @@ class SentinelClient:
         metric_log: bool = False,
         metric_log_dir: Optional[str] = None,
         block_log: bool = False,
+        pipeline_depth: int = 0,
     ):
         from sentinel_tpu.core.config import app_name as cfg_app_name
+        from sentinel_tpu.core.config import platform_engine_config
 
         self.app_name = app_name or cfg_app_name()
-        self.cfg = cfg or EngineConfig()
+        # default config is platform-detected: on TPU the fast path
+        # (MXU tables + fused effects + segment compaction) is ON — the
+        # product hot path IS the benchmarked engine configuration
+        self.cfg = cfg or platform_engine_config()
         self.time = time_source or TimeSource()
         self.mode = mode if not isinstance(self.time, VirtualTimeSource) else "sync"
         self.tick_interval_ms = tick_interval_ms
@@ -266,6 +331,7 @@ class SentinelClient:
         self.cluster = None  # Optional[ClusterStateManager]
         self._cluster_flow_by_res: Dict[str, R.FlowRule] = {}
         self._cluster_param_by_res: Dict[str, R.ParamFlowRule] = {}
+        self._auth_host_rules: Dict[str, list] = {}
         self._param_lanes_by_res: Dict[str, list] = {}
         self._cluster_degraded_active = False
         self._cluster_degraded_until = 0.0
@@ -287,7 +353,43 @@ class SentinelClient:
         self._front_doors: list = []
         self._lock = threading.Lock()  # guards the acquire queue
         self._engine_lock = threading.Lock()  # guards state/tick execution
+        # resolver-pool shared-state guards: block progress accounting and
+        # front-door response rings (single-producer C side)
+        self._blk_lock = threading.Lock()
+        self._respond_lock = threading.Lock()
         self._acquires: List[AcquireRequest] = []
+        # bulk column-array submissions (ArrayBlock) + bulk completions
+        self._acq_blocks: List[ArrayBlock] = []
+        self._comp_blocks: List[tuple] = []
+        # dispatched-but-unread ticks; under sustained load the loop runs
+        # up to pipeline_depth ticks ahead of verdict readback so the
+        # device→host transfer overlaps compute (it always drains to empty
+        # before going idle, so latency at low rate is unchanged).  A small
+        # resolver pool fetches concurrently — transfers overlap each
+        # other AND the next tick's host build (the RTT of a remote/tunnel
+        # transport pipelines; on host-attached PCIe this is near-free)
+        self._pipeline_depth = max(0, int(pipeline_depth))
+        self._pending_ticks: List[_PendingTick] = []
+        self._resolver_pool = None  # created lazily (see _pool)
+        self._resolve_futs: List[Future] = []
+        # serializes whole tick iterations: sync-mode clients call
+        # tick_once from arbitrary request threads, and the pending-tick
+        # bookkeeping above must not interleave.  Reentrant for SYNC-mode
+        # future callbacks (a callback runs on the resolving caller's
+        # thread and may re-enter tick_once).  API contract for THREADED
+        # clients with a resolver pool: done-callbacks must be
+        # non-blocking — submit_block/submit_completion_block are fine,
+        # but a BLOCKING entry()/check_batch_ids inside a callback waits
+        # on a tick only the (currently waiting) tick thread can run and
+        # stalls all traffic until its timeout
+        self._tick_mutex = threading.RLock()
+        # device-resident constant columns keyed by (fill, dtype, length):
+        # a batch column equal to its fill everywhere re-uses one cached
+        # device array instead of re-uploading B values every tick — on a
+        # remote/tunnel transport the upload bandwidth is the product
+        # bottleneck, and most columns (prio, ctx, pre_verdict, counts of
+        # 1) are constant in bulk workloads
+        self._const_cols: Dict[tuple, Any] = {}
         # completions are fire-and-forget (no futures), so they ride the
         # native MPMC event ring: Entry.exit() from any request thread is
         # one C call, and the tick drains straight into numpy arrays
@@ -303,6 +405,23 @@ class SentinelClient:
         self._stop_evt = threading.Event()
         self._started = False
         self.stats = ClientStats(self)
+
+        # segment-compacted path bookkeeping: the tick builder presorts
+        # batches by the engine's segment keys (see _presort_cols) and
+        # tracks observed live-segment counts so seg_u can grow to fit the
+        # real traffic (the seg_fallback=True safety net keeps overflow
+        # ticks exact — just slower — while the resize compiles)
+        self._seg_over_ticks = 0
+        self._seg_obs_peak = 0
+        self._seg_sample_ctr = 0
+        self._seg_sample_ctr_c = 0  # completion side (ticks may lack acquires)
+        self._seg_resizing = False
+        self._build_ms_sum = 0.0
+        self._build_ticks = 0
+        #: items whose EFFECTS a seg_fallback=False engine dropped on
+        #: capacity overflow (verdicts fail closed; see EngineConfig.seg_u)
+        self.seg_dropped_total = 0
+        self._seg_drop_last_log_s = -1
 
         # host-side hot-param value tracking: the device CMS holds hashes
         # only; the command plane's topParams view needs the VALUES, so the
@@ -354,6 +473,18 @@ class SentinelClient:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        # flush deferred readbacks so no caller future is abandoned, then
+        # release the resolver threads (start() re-creates the pool)
+        try:
+            with self._tick_mutex:
+                self._drain_resolves()
+        except Exception:  # pragma: no cover — surfaced via record log
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().warning("resolve flush failed in stop()", exc_info=True)
+        if self._resolver_pool is not None:
+            self._resolver_pool.shutdown(wait=True)
+            self._resolver_pool = None
         if self.metric_timer is not None:
             self.metric_timer.stop()
             self.metric_timer = None
@@ -410,8 +541,30 @@ class SentinelClient:
 
         # rules binding to sketch-tail resources first try PROMOTION into
         # the exact row space (Registry.promote_resource) so they get real
-        # windows; whatever stays in the tail enforces approximately
-        for r in local_flow + self.degrade_rules.get():
+        # windows; whatever stays in the tail enforces approximately.
+        # Priority when the reserve is short: rules the TAIL CANNOT SERVE
+        # go first — the tail tables enforce only QPS/DEFAULT/DIRECT
+        # default-limitApp flow rules (compile_ruleset), so a rate-limiter
+        # / THREAD-grade / origin-scoped / RELATE rule or a circuit
+        # breaker on a tail id is unenforceable unless it wins an exact
+        # row, while a plain QPS rule still has its approximate fallback.
+        def _tail_can_serve(r) -> bool:
+            # must match engine.compile_ruleset's tail-table admission —
+            # including limitApp: the tail table has no origin dimension,
+            # so an origin-scoped rule there would throttle ALL origins
+            return (
+                isinstance(r, R.FlowRule)
+                and r.grade == R.GRADE_QPS
+                and r.control_behavior == R.CONTROL_DEFAULT
+                and r.strategy == R.STRATEGY_DIRECT
+                and (r.limit_app or "default") == "default"
+            )
+
+        candidates = sorted(
+            local_flow + self.degrade_rules.get(),
+            key=_tail_can_serve,  # False (must-promote) sorts first
+        )
+        for r in candidates:
             rid = self.registry.peek_resource_id(r.resource)
             if rid is not None and self.registry.is_sketch_id(rid):
                 self.registry.promote_resource(r.resource)
@@ -420,6 +573,31 @@ class SentinelClient:
         local_param = [r for r in param if not r.cluster_mode]
         cluster_param = [r for r in param if r.cluster_mode]
         self._cluster_param_by_res = {r.resource: r for r in cluster_param}
+
+        # host mirror of the authority gate, used ONLY to order cluster
+        # token consumption after the authority slot (the reference checks
+        # cluster INSIDE FlowSlot, after AuthoritySlot —
+        # FlowRuleChecker.java:64-72): a request the authority gate will
+        # reject must not consume a cluster token.  The device decision
+        # stays authoritative, and the mirror MUST only ever be
+        # host-LENIENT-or-equal — a host-stricter verdict would skip the
+        # token check on traffic the device then passes, silently opening
+        # an unenforced cluster-limit window.  It therefore replicates
+        # compile_authority_rules' selection exactly: invalid rules
+        # (empty origins) skipped, sketch-id / over-capacity resources
+        # skipped, origins capped at KA, LAST rule per resource wins.
+        # (The one remaining divergence — an origin past the intern cap
+        # maps to -1 device-side — is in the lenient direction.)
+        KA = self.cfg.authority_origins_per_resource
+        auth_host: Dict[str, tuple] = {}
+        for r in self.authority_rules.get():
+            if not r.is_valid():
+                continue
+            rid = self.registry.resource_id(r.resource)
+            if rid is None or rid > self.cfg.max_resources:
+                continue
+            auth_host[r.resource] = (frozenset(r.origins()[:KA]), r.strategy)
+        self._auth_host_rules = auth_host
         # per-resource hash LANES: each entry hashes up to param_dims
         # distinct argument indices; every rule reads the lane its
         # param_idx was assigned (ParamFlowChecker.java:78 paramIdx
@@ -440,6 +618,31 @@ class SentinelClient:
             local_flow += [r for r in cluster_flow if r.cluster_fallback_to_local]
             local_param += cluster_param
 
+        # engine specialization: with the client presorting every batch
+        # (see _run_tick), a ruleset of single-lane DIRECT/default-limitApp
+        # flow rules qualifies for the cond-free segmented-scan ranks
+        # (EngineConfig.seg_static_ranks — the engine still verifies the
+        # contract at runtime and fails closed, so a stale flip can never
+        # misrank silently)
+        import dataclasses as _dc
+
+        static_flip = False
+        if self.cfg.seg_effects:
+            want_static = (
+                self.cfg.flow_rules_per_resource == 1
+                and self.cfg.degrade_rules_per_resource == 1
+                and self.cfg.param_rules_per_resource == 1
+                and all(
+                    r.strategy == R.STRATEGY_DIRECT
+                    and (r.limit_app or "default") == "default"
+                    for r in local_flow
+                )
+            )
+            if want_static != self.cfg.seg_static_ranks:
+                self.cfg = _dc.replace(self.cfg, seg_static_ranks=want_static)
+                self.registry.cfg = self.cfg
+                static_flip = True
+
         with self._engine_lock:
             self._rules_dev = E.compile_ruleset(
                 self.cfg,
@@ -452,16 +655,21 @@ class SentinelClient:
                 param_lanes=lane_map,
             )
             feats = self._select_features(local_flow, local_param)
-            changed = feats != self._features
+            changed = static_flip or feats != self._features
             if changed:
                 self._features = feats
                 self._tick = E.make_tick(self.cfg, donate=True, features=feats)
-        # compile the new tick NOW for BOTH batch shapes (outside the
-        # engine lock; _run_tick serializes through its own locking) so the
-        # first post-reload entry doesn't eat the XLA compile inside its
-        # entry_timeout_s window
+        # compile the new tick NOW for BOTH batch shapes so the first
+        # post-reload entry doesn't eat the XLA compile inside its
+        # entry_timeout_s window.  Under _tick_mutex: the warm-up ticks
+        # must not interleave with the serving loop's tick iterations —
+        # two threads first-calling the same jitted tick concurrently
+        # corrupts the dispatch fastpath on this jaxlib (observed as
+        # 'Execution supplied N buffers but compiled program expected
+        # N+1' on subsequent calls)
         if changed and self._started and self.mode == "threaded":
-            self._warm_shapes()
+            with self._tick_mutex:
+                self._warm_shapes()
 
     # -- cluster consultation -----------------------------------------------
 
@@ -490,6 +698,24 @@ class SentinelClient:
                 self._cluster_degraded_active = False
                 self._recompile_rules()
 
+    def _authority_pre_blocks(self, resource: str, origin: str) -> bool:
+        """True when the device authority gate is going to reject this
+        (resource, origin) — consult BEFORE spending a cluster token so
+        the slot order matches the reference (AuthoritySlot before the
+        in-FlowSlot cluster check).  Must stay host-lenient-or-equal vs
+        the device gate; see the mirror construction in
+        _recompile_rules_locked."""
+        ent = self._auth_host_rules.get(resource)
+        if ent is None:
+            return False
+        from sentinel_tpu.core.rules import AUTHORITY_BLACK, AUTHORITY_WHITE
+
+        origins, strategy = ent
+        listed = bool(origin) and origin in origins
+        if strategy == AUTHORITY_WHITE:
+            return not listed
+        return strategy == AUTHORITY_BLACK and listed
+
     def _cluster_check(
         self, resource: str, count: int, prioritized: bool, param_value
     ) -> Tuple[int, int]:
@@ -505,12 +731,16 @@ class SentinelClient:
         response drops them — so the token server being down never opens an
         unenforced window.
 
-        Known divergence from the reference: this check runs before the
-        device-side authority/system gates (the reference's cluster check
-        sits inside FlowSlot, after them), so a request the engine will
-        block anyway still consumes a cluster token.  Cost is bounded by the
-        locally-blocked traffic share; folding the cluster verdict into the
-        tick would need a device round-trip per phase.
+        Slot ordering vs the reference (cluster check inside FlowSlot,
+        after AuthoritySlot/SystemSlot — FlowRuleChecker.java:64-72):
+        AUTHORITY-doomed requests are filtered host-side before this runs
+        (_authority_pre_blocks mirrors the device gate over the same rule
+        data), so they consume no token.  The SYSTEM gate alone still
+        evaluates after token consumption — its verdict needs the device's
+        live window counters, and folding it in would cost a device
+        round-trip per request; the residual divergence is bounded by the
+        system-blocked share of cluster-ruled traffic and only matters in
+        overload (documented).
         """
         from sentinel_tpu.cluster import constants as CC
 
@@ -743,7 +973,12 @@ class SentinelClient:
         if hook_exc is not None:
             code = getattr(hook_exc, "code", 0)
             pre_verdict = code if code > 0 else ERR.BLOCK_FLOW
-        elif self._cluster_flow_by_res or self._cluster_param_by_res:
+        elif (
+            self._cluster_flow_by_res or self._cluster_param_by_res
+        ) and not self._authority_pre_blocks(resource, origin or ""):
+            # authority-doomed requests skip the token service entirely:
+            # slot order matches the reference (cluster check lives inside
+            # FlowSlot, after AuthoritySlot — FlowRuleChecker.java:64-72)
             pre_verdict, cluster_wait = self._cluster_check(
                 resource, count, prioritized, param_value
             )
@@ -971,6 +1206,10 @@ class SentinelClient:
             groups: Dict[Tuple[str, Any], List[int]] = {}
             for i, name in enumerate(resources):
                 if name in self._cluster_flow_by_res or name in self._cluster_param_by_res:
+                    if self._authority_pre_blocks(
+                        name, origins[i] if origins else ""
+                    ):
+                        continue  # engine rejects it; consume no token
                     groups.setdefault((name, params[i] if params else None), []).append(i)
             for (name, pv), idxs in groups.items():
                 item_counts = [counts[i] if counts else 1 for i in idxs]
@@ -1019,6 +1258,141 @@ class SentinelClient:
             out.append((v, w))
         return out
 
+    # -- bulk array API (TPU-native surface) --------------------------------
+
+    def submit_block(
+        self,
+        res: np.ndarray,
+        counts: Optional[np.ndarray] = None,
+        prio: Optional[np.ndarray] = None,
+        origin_id: Optional[np.ndarray] = None,
+        origin_node: Optional[np.ndarray] = None,
+        ctx_node: Optional[np.ndarray] = None,
+        ctx_name: Optional[np.ndarray] = None,
+        inbound: Optional[np.ndarray] = None,
+        param_hash: Optional[np.ndarray] = None,
+        pre_verdict: Optional[np.ndarray] = None,
+    ) -> Optional[Future]:
+        """Bulk acquire: COLUMN ARRAYS of engine resource ids (from
+        ``registry.resource_id``), no per-item Python objects.  Returns a
+        Future of (verdicts int8 [n], waits int32 [n]) in submission
+        order; blocks larger than the batch size span multiple ticks.
+
+        This is the product bulk path — the same batch assembly, host
+        presort, engine tick, and verdict fan-out that serves ``entry()``,
+        minus the per-request object overhead the reference also avoids
+        in its hot loop.
+
+        Done-callbacks on the returned future must be NON-BLOCKING in
+        threaded mode: they may submit more work (submit_block /
+        submit_completion_block), but a blocking entry()/check_batch_ids
+        inside a callback waits on a tick the busy tick thread can't run
+        and stalls traffic until its timeout (see _tick_mutex)."""
+        if not self.enabled:
+            return None
+        res = np.ascontiguousarray(res, dtype=np.int32)
+        n = len(res)
+        # negative ids would wrap in scatter paths — sanitize to trash
+        if (res < 0).any():
+            res = np.where(res < 0, np.int32(self.cfg.trash_row), res)
+
+        def col(x):
+            if x is None:
+                return None
+            x = np.ascontiguousarray(x, dtype=np.int32)
+            assert len(x) == n
+            return x
+
+        blk = ArrayBlock(
+            res=res,
+            count=col(counts),
+            prio=col(prio),
+            origin_id=col(origin_id),
+            origin_node=col(origin_node),
+            ctx_node=col(ctx_node),
+            ctx_name=col(ctx_name),
+            inbound=col(inbound),
+            param_hash=(
+                np.ascontiguousarray(param_hash, dtype=np.int32)
+                if param_hash is not None
+                else None
+            ),
+            pre_verdict=col(pre_verdict),
+            future=Future(),
+            unresolved=n,
+            verdicts=np.zeros(n, np.int8),
+            waits=np.zeros(n, np.int32),
+        )
+        with self._lock:
+            self._acq_blocks.append(blk)
+        if self.mode == "sync":
+            self.tick_once()
+        return blk.future
+
+    def check_batch_ids(
+        self,
+        res: np.ndarray,
+        counts: Optional[np.ndarray] = None,
+        timeout_s: Optional[float] = None,
+        **cols,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Blocking form of submit_block: (verdicts, waits) arrays."""
+        fut = self.submit_block(res, counts=counts, **cols)
+        if fut is None:
+            n = len(res)
+            return np.full(n, ERR.PASS, np.int8), np.zeros(n, np.int32)
+        return fut.result(timeout=timeout_s or self.entry_timeout_s)
+
+    def submit_completion_block(
+        self,
+        res: np.ndarray,
+        rt: np.ndarray,
+        success: Optional[np.ndarray] = None,
+        error: Optional[np.ndarray] = None,
+        inbound: Optional[np.ndarray] = None,
+        origin_node: Optional[np.ndarray] = None,
+        ctx_node: Optional[np.ndarray] = None,
+        param_hash: Optional[np.ndarray] = None,
+    ) -> None:
+        """Bulk exits for block-acquired traffic: column arrays, queued
+        for the next tick (completions are fire-and-forget)."""
+        from sentinel_tpu.native.ring import FLAG_COMPLETION, FLAG_INBOUND
+
+        res = np.ascontiguousarray(res, dtype=np.int32)
+        n = len(res)
+        trash = self.cfg.trash_row
+
+        def col(x, fill, dt=np.int32):
+            if x is None:
+                return np.full(n, fill, dt)
+            x = np.ascontiguousarray(x, dtype=dt)
+            assert len(x) == n
+            return x
+
+        flags = np.full(n, FLAG_COMPLETION, np.int32) | np.where(
+            col(inbound, 0) != 0, FLAG_INBOUND, 0
+        )
+        if param_hash is not None:
+            ph = np.ascontiguousarray(param_hash, dtype=np.int32)
+            aux = [ph[:, k] if k < ph.shape[1] else np.zeros(n, np.int32) for k in range(4)]
+        else:
+            aux = [np.zeros(n, np.int32)] * 4
+        block = (
+            res,
+            col(success, 1),
+            col(origin_node, trash),
+            col(ctx_node, trash),
+            flags,
+            col(rt, 0.0, np.float32),
+            col(error, 0),
+            np.zeros(n, np.int32),
+            *aux,
+        )
+        with self._lock:
+            self._comp_blocks.append(block)
+        if self.mode == "sync":
+            self.tick_once()
+
     def _submit_completion(self, c: Completion) -> None:
         from sentinel_tpu.native.ring import FLAG_COMPLETION, FLAG_INBOUND
 
@@ -1062,11 +1436,33 @@ class SentinelClient:
                 stop_evt.wait(interval - dt)
 
     def tick_once(self, now_ms: Optional[int] = None) -> None:
-        """Drain queues and run engine ticks until empty."""
+        """Drain queues and run engine ticks until empty.
+
+        Under sustained load, verdict readback runs up to pipeline_depth
+        ticks behind dispatch (see _PendingTick); the loop always resolves
+        everything before returning idle.  Whole iterations serialize on
+        _tick_mutex — sync-mode clients call this from request threads."""
+        with self._tick_mutex:
+            self._tick_once_locked(now_ms)
+
+    def _tick_once_locked(self, now_ms: Optional[int]) -> None:
         while True:
+            blocks = []
             with self._lock:
                 acq = self._acquires[: self.cfg.batch_size]
                 self._acquires = self._acquires[self.cfg.batch_size :]
+                # bulk array blocks fill the rest of the batch (API
+                # object requests first — they carry per-request futures
+                # a human caller is actively blocked on)
+                room_blk = self.cfg.batch_size - len(acq)
+                while room_blk > 0 and self._acq_blocks:
+                    blk = self._acq_blocks[0]
+                    take = min(room_blk, len(blk.res) - blk.taken)
+                    blocks.append((blk, blk.taken, take))
+                    blk.taken += take
+                    room_blk -= take
+                    if blk.taken >= len(blk.res):
+                        self._acq_blocks.pop(0)
             # Overflow entries spilled when the ring was FULL, so they
             # postdate everything that was in the ring at spill time; the
             # ring must drain first.  Consuming spill only when the ring
@@ -1096,8 +1492,32 @@ class SentinelClient:
                         )
                     )
                     n_comp += len(spill)
+            # bulk completion blocks join after ring + spill
+            if n_comp < self.cfg.complete_batch_size and self._comp_blocks:
+                with self._lock:
+                    pieces = []
+                    room_c = self.cfg.complete_batch_size - n_comp
+                    while room_c > 0 and self._comp_blocks:
+                        cb = self._comp_blocks[0]
+                        k = len(cb[0])
+                        if k <= room_c:
+                            pieces.append(cb)
+                            self._comp_blocks.pop(0)
+                            room_c -= k
+                        else:
+                            pieces.append(tuple(col[:room_c] for col in cb))
+                            self._comp_blocks[0] = tuple(
+                                col[room_c:] for col in cb
+                            )
+                            room_c = 0
+                if pieces:
+                    comp = tuple(
+                        np.concatenate([comp[j]] + [p[j] for p in pieces])
+                        for j in range(len(comp))
+                    )
+                    n_comp = len(comp[0])
             fronts = []
-            room = self.cfg.batch_size - len(acq)
+            room = self.cfg.batch_size - len(acq) - sum(t for _b, _o, t in blocks)
             # rotate the drain order so a saturated first shard can't
             # starve later shards' rings across ticks
             doors = self._front_doors
@@ -1123,19 +1543,60 @@ class SentinelClient:
                     )
                     fronts.append((door, cols))
                     room -= len(cols[0])
-            if not acq and not n_comp and not fronts and now_ms is None:
+            if not acq and not n_comp and not fronts and not blocks and now_ms is None:
+                # idle: flush any deferred readbacks before returning
+                self._drain_resolves()
                 return
-            self._run_tick(acq, comp if n_comp else None, now_ms, fronts=fronts)
+            pending = self._run_tick(
+                acq, comp if n_comp else None, now_ms, fronts=fronts,
+                blocks=blocks,
+            )
+            self._pending_ticks.append(pending)
             with self._lock:
                 more = (
                     bool(self._acquires)
+                    or bool(self._acq_blocks)
+                    or bool(self._comp_blocks)
                     or bool(self._comp_ring)
                     or bool(self._comp_overflow)
                 )
             if not more:
                 more = any(d.pending() > 0 for d in self._front_doors)
+            depth = self._pipeline_depth if more else 0
+            while len(self._pending_ticks) > depth:
+                p = self._pending_ticks.pop(0)
+                if self._pipeline_depth > 0:
+                    self._resolve_futs.append(
+                        self._pool().submit(self._resolve_tick, p)
+                    )
+                else:
+                    self._resolve_tick(p)
+            if self._resolve_futs:
+                alive = []
+                for f in self._resolve_futs:
+                    if not f.done():
+                        alive.append(f)
+                        continue
+                    exc = f.exception()
+                    if exc is not None:
+                        # a lost resolution strands its tick's futures —
+                        # it must never vanish silently
+                        from sentinel_tpu.utils.record_log import record_log
+
+                        record_log().error(
+                            "tick resolution failed: %r", exc, exc_info=exc
+                        )
+                self._resolve_futs = alive
             if not more:
-                return
+                # wait out in-flight resolutions; their callbacks may
+                # enqueue new work (closed-loop callers) — re-check
+                self._drain_resolves()
+                with self._lock:
+                    more = bool(
+                        self._acquires or self._acq_blocks or self._comp_blocks
+                    )
+                if not more:
+                    return
             now_ms = None  # subsequent drain loops use fresh time
 
     def update_window_shape(
@@ -1230,15 +1691,179 @@ class SentinelClient:
         drained into the same engine batches."""
         self._front_doors.append(door)
 
+    @property
+    def host_build_ms_avg(self) -> float:
+        """Mean host batch-build time per tick (assembly + presort +
+        upload dispatch) since start — the serial host share of serving."""
+        return self._build_ms_sum / self._build_ticks if self._build_ticks else 0.0
+
     def pending_acquires(self) -> int:
         """Depth of the un-ticked acquire queue (load-shedding probe)."""
         with self._lock:
             return len(self._acquires)
 
+    def _dev_col(self, field: str, x: np.ndarray, fill) -> Any:
+        """Upload a batch column — or reuse a cached device-resident
+        constant when the column equals ``fill`` everywhere.  Bulk
+        workloads keep most columns constant (prio, ctx ids, pre_verdict,
+        counts of 1), and on a remote/tunnel transport the per-tick column
+        upload is the product bottleneck; one equality pass per column
+        (~50 µs at 128K) buys skipping the transfer.  Safe because the
+        tick donates only the engine state, never batch inputs.
+
+        Keyed by FIELD, not just (fill, shape): two leaves must never
+        share one device buffer — XLA dedupes identical argument buffers
+        at compile time, and a call whose sharing pattern differs from the
+        compile-time call fails with a buffer-count mismatch."""
+        if (x == fill).all():
+            key = (field, float(fill), x.dtype.str, x.shape)
+            c = self._const_cols.get(key)
+            if c is None:
+                c = jnp.asarray(x)
+                self._const_cols[key] = c
+            return c
+        return jnp.asarray(x)
+
+    # -- segment-capacity adaptation ---------------------------------------
+
+    @staticmethod
+    def _host_seg_count(cols, pad_to: Optional[int] = None) -> int:
+        """Live-segment count the engine will see for these (sorted) key
+        columns — key-change heads plus ops/segment.heads_from_keys'
+        synthetic BLOCK-boundary heads.  ``pad_to``: columns are about to
+        be padded to this length with one equal-key run (trash rows)."""
+        from sentinel_tpu.ops import segment as SG
+
+        n = len(cols[0])
+        if n == 0:
+            return 0
+        change = np.zeros(n - 1, dtype=bool)
+        for c in cols:
+            c = np.asarray(c)
+            change |= c[1:] != c[:-1]
+        pos = np.arange(1, n)
+        segs = 1 + int(np.count_nonzero(change | (pos % SG.BLOCK == 0)))
+        if pad_to is not None and pad_to > n:
+            # padding: one key change at n + block heads inside the run
+            segs += 1 + (pad_to - 1) // SG.BLOCK - n // SG.BLOCK
+        return segs
+
+    def _note_seg_count(self, segs: int, b: int) -> None:
+        """Track observed live-segment counts; grow ``seg_u`` (recompile +
+        hot-swap the tick) when traffic persistently overflows the
+        compacted capacity.  With seg_fallback=True overflow ticks are
+        exact but ride the slower per-item kernels, so the resize is a
+        performance recovery; with seg_fallback=False it stops the
+        fail-closed drops."""
+        from sentinel_tpu.ops import engine_seg as ES
+
+        if segs > self._seg_obs_peak:
+            self._seg_obs_peak = segs
+        cap = ES.seg_capacity(self.cfg, b)
+        if segs <= cap:
+            return
+        self._seg_over_ticks += 1
+        # fail-closed configs resize at the FIRST overflow (drops are
+        # happening); fallback configs wait out a transient burst
+        threshold = 1 if not self.cfg.seg_fallback else 4
+        if self._seg_over_ticks < threshold or self._seg_resizing:
+            return
+        b_full = self.cfg.batch_size
+        new_u = min(
+            b_full, -(-int(self._seg_obs_peak * 1.25 + 128) // 128) * 128
+        )
+        if new_u <= ES.seg_capacity(self.cfg, b_full):
+            return  # the full-shape capacity already covers the peak
+        self._seg_resizing = True
+        if self.mode == "threaded":
+            threading.Thread(
+                target=self._resize_seg_u,
+                args=(new_u,),
+                name="sentinel-seg-resize",
+                daemon=True,
+            ).start()
+        else:
+            self._resize_seg_u(new_u)
+
+    def _resize_seg_u(self, new_u: int) -> None:
+        """Compile a tick with the larger compacted capacity against a
+        throwaway state (serving continues on the old tick), then swap —
+        the update_window_shape compile-first pattern.
+
+        The background compile is safe on host-attached TPU/CPU (XLA is
+        thread-safe); a failure here must never take the serving thread
+        down, so everything is caught and logged — the engine keeps
+        running on the old capacity (exact via seg_fallback)."""
+        import dataclasses
+
+        try:
+            feats = self._features
+            new_cfg = dataclasses.replace(self.cfg, seg_u=int(new_u))
+            new_tick = E.make_tick(new_cfg, donate=True, features=feats)
+            z = jnp.float32(0.0)
+            dummy = E.init_state(new_cfg)
+            for bs in sorted({min(256, new_cfg.batch_size), new_cfg.batch_size}):
+                dummy, _ = new_tick(
+                    dummy,
+                    self._rules_dev,
+                    E.empty_acquire(new_cfg, b=bs),
+                    E.empty_complete(
+                        new_cfg, b=min(bs, new_cfg.complete_batch_size)
+                    ),
+                    jnp.int32(self.time.now_ms()),
+                    z,
+                    z,
+                )
+            jax.block_until_ready(dummy.concurrency)
+            with self._cluster_lock, self._engine_lock:
+                if (
+                    dataclasses.replace(self.cfg, seg_u=new_cfg.seg_u) != new_cfg
+                    or feats != self._features
+                ):
+                    return  # cfg/features moved underneath us; next overflow retries
+                self.cfg = new_cfg
+                self.registry.cfg = new_cfg
+                self._tick = new_tick
+                self._seg_over_ticks = 0
+        except Exception:
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().warning(
+                "seg_u resize to %d failed; serving continues on the old "
+                "capacity", new_u, exc_info=True,
+            )
+        finally:
+            self._seg_resizing = False
+
+    def _record_seg_dropped(self, n: int) -> None:
+        """Surface fail-closed segment-overflow drops: counter + block log
+        (the reference logs every rejection, EagleEyeLogUtil.java:24-36) +
+        rate-limited record-log warning."""
+        from sentinel_tpu.ops import engine_seg as ES
+
+        with self._blk_lock:
+            self.seg_dropped_total += n
+        now = self.time.wall_ms()
+        if self.block_log is not None:
+            self.block_log.log(now, "__seg_overflow__", "SegCapacityDrop", "", n)
+        sec = int(now // 1000)
+        if sec != self._seg_drop_last_log_s:
+            self._seg_drop_last_log_s = sec
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().warning(
+                "segment capacity overflow: %d items FAILED CLOSED this tick "
+                "(total %d) — seg_u=%d is undersized for the live traffic; "
+                "raise seg_u or set seg_fallback=True",
+                n,
+                self.seg_dropped_total,
+                ES.seg_capacity(self.cfg, self.cfg.batch_size),
+            )
+
     def _warm_shapes(self) -> None:
         """Compile the tick for both batch shapes (small + full) with
         no-op batches so serving never waits on XLA."""
-        self._run_tick([], None, self.time.now_ms())
+        self._resolve_tick(self._run_tick([], None, self.time.now_ms()))
         if self.cfg.batch_size > 256:
             filler = AcquireRequest(
                 res=self.cfg.trash_row, count=0, prio=0, origin_id=-1,
@@ -1248,7 +1873,9 @@ class SentinelClient:
             )
             # 257 trash-row entries force the full-shape executable; trash
             # rows are engine no-ops and carry no futures to resolve
-            self._run_tick([filler] * 257, None, self.time.now_ms())
+            self._resolve_tick(
+                self._run_tick([filler] * 257, None, self.time.now_ms())
+            )
 
     def _run_tick(
         self,
@@ -1256,10 +1883,13 @@ class SentinelClient:
         comp,  # Optional[Tuple[np.ndarray, ...]] — drained ring columns
         now_ms: Optional[int],
         fronts=(),  # [(door, (row, count, prio, corr, a0, a1)), ...]
-    ) -> None:
+        blocks=(),  # [(ArrayBlock, src_off, take), ...]
+    ) -> _PendingTick:
         cfg = self.cfg
         M = cfg.param_dims
         trash = cfg.trash_row
+        n_blk = sum(t for _b, _o, t in blocks)
+        t_build0 = _time.perf_counter()
         # concatenate every attached door's drained engine items; responses
         # route back per door by slice
         if fronts:
@@ -1280,18 +1910,41 @@ class SentinelClient:
         def _shape_for(n: int, cap: int) -> int:
             return min(256, cap) if n <= 256 else cap
 
-        B = _shape_for(len(acq) + n_front, cfg.batch_size)
+        B = _shape_for(len(acq) + n_blk + n_front, cfg.batch_size)
         B2 = _shape_for(0 if comp is None else len(comp[0]), cfg.complete_batch_size)
 
+        from sentinel_tpu.ops.engine import _use_fused
+
+        clamp = _use_fused(cfg)
+        # the segment-compacted engine aggregates per key-run: presort
+        # batches by its segment keys (stably — arrival order within equal
+        # keys is preserved, so every rank/verdict is bit-identical; see
+        # ops/segment.py module docstring) and map verdicts back through
+        # the inverse permutation.  np.lexsort at the client's batch sizes
+        # is tens of microseconds — host work that overlaps the previous
+        # device tick anyway.
+        presort = cfg.seg_effects and clamp
+
         a = E.empty_acquire(cfg, b=min(256, cfg.batch_size))
-        if acq or n_front:
+        inv_a = None
+        if acq or n_front or n_blk:
             n = len(acq)
-            def arr(f, fill, dt, front_col=None):
+            def arr(f, fill, dt, front_col=None, blk_default=None):
+                """Column assembly: object requests [0:n], array-block
+                slices [n:n+n_blk] (vectorized), front-door items after."""
                 out = np.full(B, fill, dtype=dt)
                 for i, r in enumerate(acq):
                     out[i] = getattr(r, f)
+                o = n
+                for blk, off, take in blocks:
+                    src = getattr(blk, f)
+                    if src is not None:
+                        out[o : o + take] = src[off : off + take]
+                    elif blk_default is not None and blk_default != fill:
+                        out[o : o + take] = blk_default
+                    o += take
                 if front_col is not None and n_front:
-                    out[n : n + n_front] = front_col
+                    out[n + n_blk : n + n_blk + n_front] = front_col
                 return out
             f_row = front[0] if n_front else None
             f_cnt = front[1] if n_front else None
@@ -1302,66 +1955,115 @@ class SentinelClient:
                 for i, r in enumerate(acq):
                     t = tuple(r.param_hash)[:M]
                     ph[i, : len(t)] = t
+                o = n
+                for blk, off, take in blocks:
+                    if blk.param_hash is not None:
+                        src = blk.param_hash[off : off + take, :M]
+                        ph[o : o + take, : src.shape[1]] = src
+                    o += take
                 if n_front:
                     # native param requests carry pre-hashed lane values
-                    ph[n : n + n_front, 0] = front[4]
+                    ph[n + n_blk : n + n_blk + n_front, 0] = front[4]
                     if M > 1:
-                        ph[n : n + n_front, 1] = front[5]
+                        ph[n + n_blk : n + n_blk + n_front, 1] = front[5]
                 return ph
 
-            from sentinel_tpu.ops.engine import _use_fused
-
-            clamp = _use_fused(cfg)
+            res_np = arr("res", trash, np.int32, f_row)
+            # the fused digit planes carry counts exactly up to
+            # max_batch_count (EngineConfig docs); clamping at the
+            # single batch-build choke point makes that envelope real
+            # for every source (API, async, front door, cluster).  The
+            # clamp tracks the ACTIVE path (engine._use_fused, incl.
+            # the SENTINEL_NO_PALLAS kill switch) — the unfused paths
+            # are exact to 65535 and stay unclamped.
+            cnt_np = arr("count", 0, np.int32, f_cnt, blk_default=1)
+            if clamp:
+                cnt_np = np.minimum(cnt_np, cfg.max_batch_count)
+            prio_np = arr("prio", 0, np.int32, f_prio)
+            oid_np = arr("origin_id", -1, np.int32)
+            onode_np = arr("origin_node", trash, np.int32)
+            cnode_np = arr("ctx_node", trash, np.int32)
+            cname_np = arr("ctx_name", -1, np.int32)
+            inb_np = arr("inbound", 0, np.int32)
+            pre_np = arr("pre_verdict", 0, np.int32)
+            ph_np = _ph_cols()
+            if presort:
+                # key order matches engine_seg.prepare_acquire's segment
+                # keys, res-major (seg ranks also need res nondecreasing);
+                # trash-row padding sorts wherever its id lands — padding
+                # items are engine no-ops at any position
+                order = np.lexsort((cname_np, oid_np, onode_np, cnode_np, res_np))
+                (res_np, cnt_np, prio_np, oid_np, onode_np, cnode_np,
+                 cname_np, inb_np, pre_np) = (
+                    x[order]
+                    for x in (res_np, cnt_np, prio_np, oid_np, onode_np,
+                              cnode_np, cname_np, inb_np, pre_np)
+                )
+                ph_np = ph_np[order]
+                inv_a = np.empty(B, np.int32)
+                inv_a[order] = np.arange(B, dtype=np.int32)
+                # sampled (1-in-8 full-size ticks): a handful of numpy
+                # passes over B — resize detection doesn't need every tick
+                self._seg_sample_ctr += 1
+                if B <= 4096 or (self._seg_sample_ctr & 7) == 0:
+                    self._note_seg_count(
+                        self._host_seg_count(
+                            (res_np, cnode_np, onode_np, oid_np, cname_np)
+                        ),
+                        B,
+                    )
             a = E.AcquireBatch(
-                res=jnp.asarray(arr("res", trash, np.int32, f_row)),
-                # the fused digit planes carry counts exactly up to
-                # max_batch_count (EngineConfig docs); clamping at the
-                # single batch-build choke point makes that envelope real
-                # for every source (API, async, front door, cluster).  The
-                # clamp tracks the ACTIVE path (engine._use_fused, incl.
-                # the SENTINEL_NO_PALLAS kill switch) — the unfused paths
-                # are exact to 65535 and stay unclamped.
-                count=jnp.asarray(
-                    np.minimum(arr("count", 0, np.int32, f_cnt), cfg.max_batch_count)
-                    if clamp
-                    else arr("count", 0, np.int32, f_cnt)
-                ),
-                prio=jnp.asarray(arr("prio", 0, np.int32, f_prio)),
-                origin_id=jnp.asarray(arr("origin_id", -1, np.int32)),
-                origin_node=jnp.asarray(arr("origin_node", trash, np.int32)),
-                ctx_node=jnp.asarray(arr("ctx_node", trash, np.int32)),
-                ctx_name=jnp.asarray(arr("ctx_name", -1, np.int32)),
-                inbound=jnp.asarray(arr("inbound", 0, np.int32)),
-                param_hash=jnp.asarray(_ph_cols()),
-                pre_verdict=jnp.asarray(arr("pre_verdict", 0, np.int32)),
+                res=self._dev_col("a.res", res_np, trash),
+                count=self._dev_col("a.count", cnt_np, 1),
+                prio=self._dev_col("a.prio", prio_np, 0),
+                origin_id=self._dev_col("a.oid", oid_np, -1),
+                origin_node=self._dev_col("a.onode", onode_np, trash),
+                ctx_node=self._dev_col("a.cnode", cnode_np, trash),
+                ctx_name=self._dev_col("a.cname", cname_np, -1),
+                inbound=self._dev_col("a.inb", inb_np, 0),
+                param_hash=self._dev_col("a.ph", ph_np, 0),
+                pre_verdict=self._dev_col("a.pre", pre_np, 0),
             )
         c = E.empty_complete(cfg, b=min(256, cfg.complete_batch_size))
         if comp is not None:
             from sentinel_tpu.native.ring import FLAG_INBOUND
-            from sentinel_tpu.ops.engine import _use_fused
-
-            clamp = _use_fused(cfg)
 
             (res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag,
              *aux_a) = comp
             n = len(res_a)
+            if presort and n > 1:
+                # completions carry no futures — sort in place, no unsort
+                # (all completion effects are order-independent sums/minima)
+                order = np.lexsort((org_a, ctx_a, res_a))
+                res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a = (
+                    x[order]
+                    for x in (res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a)
+                )
+                aux_a = [x[order] for x in aux_a]
+                self._seg_sample_ctr_c += 1
+                if B2 <= 4096 or (self._seg_sample_ctr_c & 7) == 0:
+                    self._note_seg_count(
+                        self._host_seg_count((res_a, ctx_a, org_a), pad_to=B2),
+                        B2,
+                    )
 
-            def pad(a, fill, dt):
+            def pad(name, a, fill, dt):
                 out = np.full(B2, fill, dtype=dt)
                 out[:n] = a
-                return jnp.asarray(out)
+                return self._dev_col(name, out, fill)
 
             ph_np = np.zeros((B2, M), dtype=np.int32)
             for k in range(min(M, len(aux_a))):
                 ph_np[:n, k] = aux_a[k]
             c = E.CompleteBatch(
-                res=pad(res_a, trash, np.int32),
-                origin_node=pad(org_a, trash, np.int32),
-                ctx_node=pad(ctx_a, trash, np.int32),
-                inbound=pad((flags_a & FLAG_INBOUND), 0, np.int32),
-                rt=pad(rt_a, 0.0, np.float32),
+                res=pad("c.res", res_a, trash, np.int32),
+                origin_node=pad("c.onode", org_a, trash, np.int32),
+                ctx_node=pad("c.cnode", ctx_a, trash, np.int32),
+                inbound=pad("c.inb", (flags_a & FLAG_INBOUND), 0, np.int32),
+                rt=pad("c.rt", rt_a, 0.0, np.float32),
                 # same max_batch_count envelope as the acquire side
                 success=pad(
+                    "c.succ",
                     np.minimum(cnt_a, cfg.max_batch_count)
                     if clamp
                     else cnt_a,
@@ -1369,17 +2071,23 @@ class SentinelClient:
                     np.int32,
                 ),
                 error=pad(
+                    "c.err",
                     np.minimum(err_a, cfg.max_batch_count)
                     if clamp
                     else err_a,
                     0,
                     np.int32,
                 ),
-                param_hash=jnp.asarray(ph_np),
+                param_hash=self._dev_col("c.ph", ph_np, 0),
             )
 
         load, cpu = self._sys.sample()
         t = now_ms if now_ms is not None else self.time.now_ms()
+        # running average of host batch-build time (assembly + presort +
+        # column upload dispatch) — the serial host share of a tick; read
+        # via host_build_ms_avg (benchmark decomposition, ops dashboards)
+        self._build_ms_sum += (_time.perf_counter() - t_build0) * 1000.0
+        self._build_ticks += 1
         with self._engine_lock:
             self._state, out = self._tick(
                 self._state,
@@ -1390,21 +2098,99 @@ class SentinelClient:
                 jnp.float32(load),
                 jnp.float32(cpu),
             )
-            verdict = np.asarray(out.verdict)
+        p = _PendingTick(
+            acq=acq,
+            blocks=list(blocks),
+            fronts=list(fronts),
+            inv_a=inv_a,
+            out=out,
+            check_dropped=bool(presort and not cfg.seg_fallback),
+            n_obj=len(acq),
+            n_blk=n_blk,
+        )
+        if self._pipeline_depth:
+            # start the device→host verdict transfer NOW so it overlaps
+            # the next tick's host build + device compute (tunnel RTT /
+            # PCIe latency hiding); resolution happens in _resolve_tick
+            try:
+                out.verdict.copy_to_host_async()
+            except Exception:
+                pass
+        return p
+
+    def _pool(self):
+        """Lazily (re)create the resolver pool — stop() shuts it down."""
+        if self._resolver_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._resolver_pool = ThreadPoolExecutor(
+                max_workers=min(8, self._pipeline_depth + 2),
+                thread_name_prefix="sentinel-resolve",
+            )
+        return self._resolver_pool
+
+    def _drain_resolves(self) -> None:
+        """Flush deferred readbacks: pendings not yet handed to the pool,
+        then every in-flight pool resolution (exceptions surface here)."""
+        while self._pending_ticks:
+            p = self._pending_ticks.pop(0)
+            if self._pipeline_depth > 0:
+                self._resolve_futs.append(self._pool().submit(self._resolve_tick, p))
+            else:
+                self._resolve_tick(p)
+        futs, self._resolve_futs = self._resolve_futs, []
+        for f in futs:
+            f.result()
+
+    def _resolve_tick(self, p: _PendingTick) -> None:
+        """Read back one dispatched tick's outputs and fan verdicts out to
+        futures / array blocks / front doors.  May run on a resolver-pool
+        thread; everything it touches is per-tick (futures, disjoint block
+        slices) or lock-protected (drop counters)."""
+        out = p.out
+        verdict = np.asarray(out.verdict)
+        if p.check_dropped:
+            # fail-closed capacity overflow must be LOUD (an engine
+            # rejecting traffic because seg_u is undersized is an incident,
+            # not a silent counter)
+            dropped = int(np.asarray(out.seg_dropped))
+            if dropped:
+                self._record_seg_dropped(dropped)
+        # the wait column is only nonzero when some verdict is PASS_WAIT
+        # (engine zeroes wait for non-passing items) — skip the 4x-larger
+        # transfer entirely on the common no-pacing tick
+        if bool((verdict == ERR.PASS_WAIT).any()):
             wait = np.asarray(out.wait_ms)
-        for i, r in enumerate(acq):
+        else:
+            wait = np.zeros(verdict.shape[0], np.int32)
+        if p.inv_a is not None:
+            # map sorted-batch verdicts back to submission order
+            verdict = verdict[p.inv_a]
+            wait = wait[p.inv_a]
+        for i, r in enumerate(p.acq):
             if r.future is not None:
                 r.future.set_result((int(verdict[i]), int(wait[i])))
-        if n_front:
-            off = len(acq)
-            for door, cols in fronts:
-                k = len(cols[0])
-                door.respond(
-                    cols[3],
-                    verdict[off : off + k].astype(np.int32),
-                    wait[off : off + k].astype(np.int32),
-                )
-                off += k
+        o = p.n_obj
+        for blk, off, take in p.blocks:
+            blk.verdicts[off : off + take] = verdict[o : o + take]
+            blk.waits[off : off + take] = wait[o : o + take]
+            with self._blk_lock:
+                blk.unresolved -= take
+                fire = blk.unresolved <= 0
+            if fire and blk.future is not None:
+                blk.future.set_result((blk.verdicts, blk.waits))
+            o += take
+        if p.fronts:
+            off = p.n_obj + p.n_blk
+            with self._respond_lock:
+                for door, cols in p.fronts:
+                    k = len(cols[0])
+                    door.respond(
+                        cols[3],
+                        verdict[off : off + k].astype(np.int32),
+                        wait[off : off + k].astype(np.int32),
+                    )
+                    off += k
 
 
 def _mask_min_rt(v: float) -> float:
