@@ -475,33 +475,25 @@ def run_checks_seg(
 
     with_tail = "tail_flow" in features and cfg.sketch_stats
     if with_tail:
+        # UNCONDITIONAL under the feature: "tail_flow" is only compiled in
+        # when sketch-id flow rules exist (client._select_features), so a
+        # lax.cond on any_tail_rules would buy nothing on real workloads
+        # while its boundary copies cost ~0.3-1.4 ms at B=128K (STATUS
+        # cond-boundary measurements).  With no rules loaded the gathers
+        # read UNRULED thresholds and nothing blocks — semantics identical.
         thr_tab = jnp.asarray(rules.tail.thr)
-        any_tail_rules = jnp.any(thr_tab < RT.TAIL_UNRULED / 2)
         tres_u = jnp.where(live, carry.res, -1)
         tail_u = live & (tres_u >= cfg.node_rows)
-
-        def _tail_cols():
-            tcols = P.cms_cell(tres_u, cfg.sketch_depth, cfg.sketch_width)
-            thrs = []
-            for d in range(cfg.sketch_depth):
-                t = T.lane_gather_1col(
-                    cfg, thr_tab[d], tcols[:, d], cfg.sketch_width
-                )
-                thrs.append(jnp.where(tail_u, t, RT.TAIL_UNRULED))
-            thr_u = jnp.max(jnp.stack(thrs, axis=0), axis=0)
-            est_u = GS.estimate_plane_mxu(
-                cfg, state.gs, now_ms, tres_u, W.EV_PASS, E.sketch_config(cfg)
+        tcols = P.cms_cell(tres_u, cfg.sketch_depth, cfg.sketch_width)
+        thrs = []
+        for d in range(cfg.sketch_depth):
+            t = T.lane_gather_1col(
+                cfg, thr_tab[d], tcols[:, d], cfg.sketch_width
             )
-            return thr_u, est_u
-
-        # no tail rules -> skip the gathers (the common case)
-        thr_u, est_u = jax.lax.cond(
-            any_tail_rules,
-            _tail_cols,
-            lambda: (
-                jnp.full((ctx.U,), RT.TAIL_UNRULED, jnp.float32),
-                jnp.zeros((ctx.U,), jnp.float32),
-            ),
+            thrs.append(jnp.where(tail_u, t, RT.TAIL_UNRULED))
+        thr_u = jnp.max(jnp.stack(thrs, axis=0), axis=0)
+        est_u = GS.estimate_plane_mxu(
+            cfg, state.gs, now_ms, tres_u, W.EV_PASS, E.sketch_config(cfg)
         )
         i_tthr = exp.add_f(thr_u)
         i_test = exp.add_f(est_u)
@@ -757,44 +749,41 @@ def run_checks_seg(
         wait_ms = jnp.zeros((b,), jnp.int32)
 
     if with_tail:
-        def _tail_run():
-            thr = jnp.where(
-                eligible & (acq.res >= cfg.node_rows),
-                exp.get_f(i_tthr),
-                RT.TAIL_UNRULED,
-            )
-            est_t = exp.get_f(i_test)
-            ruled = thr < RT.TAIL_UNRULED / 2
-
-            def _seg():
-                head_r = jnp.concatenate(
-                    [jnp.ones((1,), bool), acq.res[1:] != acq.res[:-1]]
-                )
-                (r,) = SG.seg_excl_cumsum(
-                    head_r, jnp.where(ruled, acq.count, 0)[None, :]
-                )
-                return r.astype(jnp.float32)
-
-            def _sort():
-                (r,) = grouped_exclusive_cumsum(acq.res, [cnt], ruled)
-                return r
-
-            if cfg.seg_static_ranks:
-                # unsorted batch under the static contract: block ruled
-                # tail items outright (fail closed, loud) — t_rank would
-                # be garbage
-                t_rank = _seg()
-                return ruled & (
-                    (est_t + t_rank + cnt > thr) | ~carry.res_sorted
-                )
-            t_rank = jax.lax.cond(carry.res_sorted, _seg, _sort)
-            return ruled & (est_t + t_rank + cnt > thr)
-
-        tail_block = jax.lax.cond(
-            any_tail_rules & jnp.any(eligible & (acq.res >= cfg.node_rows)),
-            _tail_run,
-            lambda: zero_block,
+        # unconditional (see the segment-level tail phase above): the rank
+        # scan + compare interior is cheap next to the cond boundary it
+        # replaced, and with no ruled tail items `ruled` is all-False
+        thr = jnp.where(
+            eligible & (acq.res >= cfg.node_rows),
+            exp.get_f(i_tthr),
+            RT.TAIL_UNRULED,
         )
+        est_t = exp.get_f(i_test)
+        ruled = thr < RT.TAIL_UNRULED / 2
+
+        def _tail_seg():
+            head_r = jnp.concatenate(
+                [jnp.ones((1,), bool), acq.res[1:] != acq.res[:-1]]
+            )
+            (r,) = SG.seg_excl_cumsum(
+                head_r, jnp.where(ruled, acq.count, 0)[None, :]
+            )
+            return r.astype(jnp.float32)
+
+        def _tail_sort():
+            (r,) = grouped_exclusive_cumsum(acq.res, [cnt], ruled)
+            return r
+
+        if cfg.seg_static_ranks:
+            # unsorted batch under the static contract: block ruled
+            # tail items outright (fail closed, loud) — t_rank would
+            # be garbage
+            t_rank = _tail_seg()
+            tail_block = ruled & (
+                (est_t + t_rank + cnt > thr) | ~carry.res_sorted
+            )
+        else:
+            t_rank = jax.lax.cond(carry.res_sorted, _tail_seg, _tail_sort)
+            tail_block = ruled & (est_t + t_rank + cnt > thr)
         flow_block = flow_block | (tail_block & eligible)
     eligible = eligible & ~flow_block
 
