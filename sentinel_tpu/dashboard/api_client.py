@@ -16,6 +16,13 @@ from sentinel_tpu.core import rules as R
 from sentinel_tpu.metrics.node import MetricNode
 
 DEFAULT_TIMEOUT_S = 3.0
+#: rule pushes are control-plane ops that BLOCK until enforcement is live
+#: on the machine — a reload that changes the compiled feature set (e.g.
+#: the first authority rule) swaps in a freshly XLA-compiled tick, which
+#: takes tens of seconds on TPU.  The publish honestly waits for it (a
+#: fast ACK would report rules "live" during an unenforced window), so
+#: its timeout is its own, much larger than telemetry's.
+RULE_PUSH_TIMEOUT_S = 180.0
 
 
 class SentinelApiClient:
@@ -42,7 +49,10 @@ class SentinelApiClient:
         with urllib.request.urlopen(req, timeout=self.timeout_s) as rsp:
             return rsp.read().decode("utf-8")
 
-    def _post(self, ip: str, port: int, command: str, **params) -> str:
+    def _post(
+        self, ip: str, port: int, command: str, timeout_s: Optional[float] = None,
+        **params,
+    ) -> str:
         url = f"http://{ip}:{port}/{command}"
         body = urllib.parse.urlencode(
             {k: v for k, v in params.items() if v is not None}
@@ -50,7 +60,9 @@ class SentinelApiClient:
         req = urllib.request.Request(
             url, data=body, method="POST", headers=self._headers()
         )
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as rsp:
+        with urllib.request.urlopen(
+            req, timeout=timeout_s or self.timeout_s
+        ) as rsp:
             return rsp.read().decode("utf-8")
 
     # -- rules ------------------------------------------------------------
@@ -62,7 +74,13 @@ class SentinelApiClient:
 
     def set_rules(self, ip: str, port: int, type_: str, rules: List[Any]) -> bool:
         data = json.dumps(R.rules_to_json_list(rules))
-        return self._post(ip, port, "setRules", type=type_, data=data) == "success"
+        return (
+            self._post(
+                ip, port, "setRules", timeout_s=RULE_PUSH_TIMEOUT_S,
+                type=type_, data=data,
+            )
+            == "success"
+        )
 
     # -- telemetry ---------------------------------------------------------
 

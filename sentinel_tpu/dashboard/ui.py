@@ -4,8 +4,9 @@ The reference ships an AngularJS 1.x SPA with ECharts; this is the same
 idea at minimum viable scale with zero dependencies (vanilla JS + canvas):
 machine discovery table, per-app top resources, live QPS chart polling
 /metric once a second, and a rule MANAGER (list/add/edit/delete for
-flow / degrade / paramFlow rules — the flow_v1.html / degrade.html /
-param_flow.html pages of the reference SPA) publishing the full per-type
+flow / degrade / paramFlow / system / authority rules — the
+flow_v1.html / degrade.html / param_flow.html / system.html /
+authority.html pages of the reference SPA) publishing the full per-type
 list through the same POST /rules machine round-trip the REST API exposes.
 Served by DashboardServer at GET /.
 """
@@ -54,6 +55,8 @@ PAGE = r"""<!doctype html>
   <button class="tab" id="tab-flow">flow</button>
   <button class="tab" id="tab-degrade">degrade</button>
   <button class="tab" id="tab-paramFlow">paramFlow</button>
+  <button class="tab" id="tab-system">system</button>
+  <button class="tab" id="tab-authority">authority</button>
   <button id="rload">reload</button>
   <span class="muted">edits publish the FULL list for the selected type
   (reference rule-manager semantics)</span>
@@ -202,6 +205,22 @@ const RCOLS = {
     ["durationInSec", "durationSec", "n"],
     ["burstCount", "burst", "n"],
   ],
+  // system rules are GLOBAL (no resource column; -1 disables a threshold)
+  // — views/system.html of the reference SPA
+  system: [
+    ["highestSystemLoad", "load", "n"],
+    ["highestCpuUsage", "cpuUsage", "n"],
+    ["qps", "qps", "n"],
+    ["avgRt", "avgRt", "n"],
+    ["maxThread", "maxThread", "n"],
+  ],
+  // views/authority.html: origin allow/deny per resource; limitApp is a
+  // comma-separated origin list
+  authority: [
+    ["resource", "resource", "s"],
+    ["limitApp", "origins (comma-sep)", "s"],
+    ["strategy", "strategy", [[0, "WHITE (allow)"], [1, "BLACK (deny)"]]],
+  ],
 };
 const RDEFAULTS = {
   flow: {resource: "", grade: 1, count: 10, strategy: 0, refResource: "",
@@ -210,6 +229,9 @@ const RDEFAULTS = {
             timeWindow: 10, minRequestAmount: 5, statIntervalMs: 1000},
   paramFlow: {resource: "", paramIdx: 0, grade: 1, count: 10,
               durationInSec: 1, burstCount: 0},
+  system: {highestSystemLoad: -1, highestCpuUsage: -1, qps: -1,
+           avgRt: -1, maxThread: -1},
+  authority: {resource: "", limitApp: "", strategy: 0},
 };
 let rtype = "flow", rrules = [];  // the editable full list for rtype
 let rloadedFrom = "";  // machine rrules was fetched from (save guard)
@@ -280,7 +302,7 @@ function refreshRuleMachines() {
   if (cur && [...sel.options].some(o => o.value === cur)) sel.value = cur;
 }
 
-for (const ty of ["flow", "degrade", "paramFlow"])
+for (const ty of ["flow", "degrade", "paramFlow", "system", "authority"])
   $("tab-" + ty).onclick = () => { rtype = ty; loadRules(); };
 $("rload").onclick = loadRules;
 $("rmach").onchange = loadRules;
@@ -299,7 +321,8 @@ $("rsave").onclick = async () => {
       "overwrite this machine's rules with the other machine's list)";
     return;
   }
-  const bad = rrules.find(r => !r.resource);
+  // system rules are global — every other type is resource-keyed
+  const bad = rtype !== "system" && rrules.find(r => !r.resource);
   if (bad) { $("rout").textContent = "every rule needs a resource"; return; }
   try {
     const r = await fetch(

@@ -328,5 +328,46 @@ def test_rule_manager_crud_round_trip(live_stack, vt):
     rsp = urllib.request.urlopen(
         f"http://127.0.0.1:{dash.port}/", timeout=3
     ).read().decode()
-    for frag in ('id="rsave"', 'id="radd"', "tab-paramFlow", "tab-degrade"):
+    for frag in ('id="rsave"', 'id="radd"', "tab-paramFlow", "tab-degrade",
+                 "tab-system", "tab-authority"):
         assert frag in rsp
+
+
+def test_rule_manager_system_authority_round_trip(live_stack, vt):
+    """system + authority tabs (views/system.html / authority.html):
+    CRUD through the UI's exact fetch paths flips ENGINE enforcement."""
+    client, center, dash = live_stack
+
+    # -- authority: BLACK-list an origin on one resource ------------------
+    _ui_save(dash, center, "authority", [
+        {"resource": "auth-res", "limitApp": "badcaller", "strategy": 1}
+    ])
+    got = _ui_load(dash, center, "authority")
+    assert got[0]["limitApp"] == "badcaller" and got[0]["strategy"] == 1
+    assert client.try_entry("auth-res", origin="goodcaller")
+    assert client.try_entry("auth-res", origin="badcaller") is None
+
+    # edit: flip to WHITE list — now ONLY badcaller may pass
+    got[0]["strategy"] = 0
+    _ui_save(dash, center, "authority", got)
+    assert client.try_entry("auth-res", origin="badcaller")
+    assert client.try_entry("auth-res", origin="goodcaller") is None
+
+    # -- system: global inbound QPS cap -----------------------------------
+    vt.advance(1100)
+    _ui_save(dash, center, "system", [
+        {"highestSystemLoad": -1, "highestCpuUsage": -1, "qps": 2,
+         "avgRt": -1, "maxThread": -1}
+    ])
+    assert _ui_load(dash, center, "system")[0]["qps"] == 2
+    passed = sum(1 for _ in range(5) if client.try_entry("sys-res", inbound=True))
+    assert passed == 2  # global cap enforced on inbound traffic
+
+    # -- delete both: enforcement lifts -----------------------------------
+    _ui_save(dash, center, "system", [])
+    _ui_save(dash, center, "authority", [])
+    assert _ui_load(dash, center, "system") == []
+    vt.advance(1100)
+    assert client.try_entry("auth-res", origin="goodcaller")
+    got = sum(1 for _ in range(4) if client.try_entry("sys-res", inbound=True))
+    assert got == 4
