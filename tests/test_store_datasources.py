@@ -1,0 +1,495 @@
+"""Store datasource bindings (datasource/stores.py, zookeeper.py) against
+fake servers speaking each store's real wire protocol subset.
+
+Engine-free (no jax): these exercise the transport + SPI wiring; the
+datasource→RuleManager→engine plumbing is covered by test_datasource.py /
+test_redis_datasource.py.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from sentinel_tpu.datasource.property import SimplePropertyListener
+from sentinel_tpu.datasource import stores as ST
+from sentinel_tpu.datasource.zookeeper import ZookeeperDataSource
+
+
+def _serve(handler_cls):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]
+
+
+def _collect(ds):
+    got = []
+    evt = threading.Event()
+
+    def on(v):
+        got.append(v)
+        evt.set()
+
+    ds.get_property().add_listener(SimplePropertyListener(on))
+    return got, evt
+
+
+def _wait(evt, got, pred, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if got and pred(got[-1]):
+            return True
+        evt.clear()
+        evt.wait(0.25)
+    return False
+
+
+# --------------------------- nacos ---------------------------------------
+
+
+def test_nacos_long_poll_push():
+    state = {"value": "v1", "changed": threading.Event()}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            assert self.path.startswith("/nacos/v1/cs/configs?")
+            q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+            assert q["dataId"] == ["rules"] and q["group"] == ["G"]
+            body = state["value"].encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            assert self.path == "/nacos/v1/cs/configs/listener"
+            n = int(self.headers["Content-Length"])
+            raw = urllib.parse.parse_qs(self.rfile.read(n).decode())
+            listening = raw["Listening-Configs"][0]
+            data_id, group, _md5 = listening.rstrip("\x01").split("\x02")[:3]
+            # hold until a change or a short timeout (fake long poll)
+            changed = state["changed"].wait(timeout=2.0)
+            self.send_response(200)
+            self.end_headers()
+            if changed:
+                state["changed"].clear()
+                self.wfile.write(
+                    urllib.parse.quote(f"{data_id}\x02{group}\x01").encode()
+                )
+
+    srv, port = _serve(H)
+    ds = ST.NacosDataSource(
+        f"127.0.0.1:{port}", "G", "rules", parser=lambda s: s.upper(),
+        poll_timeout_ms=2000,
+    )
+    try:
+        got, evt = _collect(ds)
+        assert ds.get_property().value == "V1"
+        state["value"] = "v2"
+        state["changed"].set()
+        assert _wait(evt, got, lambda v: v == "V2")
+    finally:
+        ds.close()
+        srv.shutdown()
+
+
+# --------------------------- consul --------------------------------------
+
+
+def test_consul_blocking_query():
+    state = {"value": "c1", "index": 7, "changed": threading.Event()}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            u = urllib.parse.urlparse(self.path)
+            assert u.path == "/v1/kv/sentinel/rules"
+            q = urllib.parse.parse_qs(u.query)
+            if "index" in q and int(q["index"][0]) >= state["index"]:
+                state["changed"].wait(timeout=2.0)
+                state["changed"].clear()
+            body = json.dumps(
+                [{"Value": base64.b64encode(state["value"].encode()).decode()}]
+            ).encode()
+            self.send_response(200)
+            self.send_header("X-Consul-Index", str(state["index"]))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv, port = _serve(H)
+    ds = ST.ConsulDataSource(
+        "127.0.0.1", port, "sentinel/rules", parser=lambda s: s + "!",
+        watch_timeout_s=2,
+    )
+    try:
+        got, evt = _collect(ds)
+        assert ds.get_property().value == "c1!"
+        state["value"] = "c2"
+        state["index"] = 8
+        state["changed"].set()
+        assert _wait(evt, got, lambda v: v == "c2!")
+    finally:
+        ds.close()
+        srv.shutdown()
+
+
+# --------------------------- apollo --------------------------------------
+
+
+def test_apollo_notifications():
+    state = {"value": "a1", "nid": 3, "changed": threading.Event()}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            u = urllib.parse.urlparse(self.path)
+            if u.path == "/configfiles/json/my-app/default/application":
+                body = json.dumps({"flowRules": state["value"]}).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            assert u.path == "/notifications/v2"
+            ns = json.loads(
+                urllib.parse.parse_qs(u.query)["notifications"][0]
+            )
+            if ns[0]["notificationId"] >= state["nid"]:
+                if not state["changed"].wait(timeout=2.0):
+                    self.send_response(304)
+                    self.end_headers()
+                    return
+                state["changed"].clear()
+            body = json.dumps(
+                [{"namespaceName": "application", "notificationId": state["nid"]}]
+            ).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv, port = _serve(H)
+    ds = ST.ApolloDataSource(
+        f"127.0.0.1:{port}", "my-app", "default", "application",
+        "flowRules", "[]", parser=lambda s: ("parsed", s),
+    )
+    try:
+        got, evt = _collect(ds)
+        assert ds.get_property().value == ("parsed", "a1")
+        state["value"] = "a2"
+        state["nid"] = 4
+        state["changed"].set()
+        assert _wait(evt, got, lambda v: v == ("parsed", "a2"))
+    finally:
+        ds.close()
+        srv.shutdown()
+
+
+# --------------------------- eureka --------------------------------------
+
+
+def test_eureka_metadata_poll_and_replica_fallthrough():
+    state = {"value": "e1"}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            assert self.path == "/eureka/apps/APP/inst-1"
+            assert self.headers["Accept"] == "application/json"
+            body = json.dumps(
+                {"instance": {"metadata": {"flowRules": state["value"]}}}
+            ).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv, port = _serve(H)
+    # first URL is dead: the binding must fall through to the live replica
+    ds = ST.EurekaDataSource(
+        "APP", "inst-1",
+        ["http://127.0.0.1:1/eureka", f"http://127.0.0.1:{port}/eureka"],
+        "flowRules", parser=json.loads if False else (lambda s: s),
+        refresh_ms=60_000,
+    )
+    try:
+        assert ds.get_property().value == "e1"
+        state["value"] = "e2"
+        assert ds.refresh() is True  # deterministic poll step
+        assert ds.get_property().value == "e2"
+    finally:
+        ds.close()
+        srv.shutdown()
+
+
+# --------------------------- etcd ----------------------------------------
+
+
+def test_etcd_range_and_watch_stream():
+    state = {"value": "t1", "changed": threading.Event()}
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            req = json.loads(self.rfile.read(n).decode())
+            if self.path == "/v3/kv/range":
+                key = base64.b64decode(req["key"]).decode()
+                assert key == "sentinel.rules"
+                body = json.dumps(
+                    {
+                        "kvs": [
+                            {
+                                "value": base64.b64encode(
+                                    state["value"].encode()
+                                ).decode()
+                            }
+                        ]
+                    }
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            assert self.path == "/v3/watch"
+            assert "create_request" in req
+            self.send_response(200)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(obj):
+                b = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(b):x}\r\n".encode() + b + b"\r\n")
+                self.wfile.flush()
+
+            chunk({"result": {"created": True}})
+            if state["changed"].wait(timeout=4.0):
+                state["changed"].clear()
+                chunk({"result": {"events": [{"type": "PUT"}]}})
+            self.wfile.write(b"0\r\n\r\n")
+
+    srv, port = _serve(H)
+    ds = ST.EtcdDataSource("127.0.0.1", port, "sentinel.rules", parser=str.title)
+    try:
+        got, evt = _collect(ds)
+        assert ds.get_property().value == "T1"
+        state["value"] = "t2 new"
+        state["changed"].set()
+        assert _wait(evt, got, lambda v: v == "T2 New")
+    finally:
+        ds.close()
+        srv.shutdown()
+
+
+# --------------------------- spring cloud config --------------------------
+
+
+def test_spring_cloud_config_poll():
+    state = {"value": "s1"}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            assert self.path == "/my-app/prod"
+            body = json.dumps(
+                {
+                    "propertySources": [
+                        {"source": {"other": "x"}},
+                        {"source": {"sentinel.rules": state["value"]}},
+                    ]
+                }
+            ).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv, port = _serve(H)
+    ds = ST.SpringCloudConfigDataSource(
+        f"127.0.0.1:{port}", "my-app", "prod", "sentinel.rules",
+        parser=lambda s: s, refresh_ms=60_000,
+    )
+    try:
+        assert ds.get_property().value == "s1"
+        state["value"] = "s2"
+        assert ds.refresh() is True
+        assert ds.get_property().value == "s2"
+    finally:
+        ds.close()
+        srv.shutdown()
+
+
+# --------------------------- zookeeper ------------------------------------
+
+
+class FakeZkServer:
+    """Speaks the jute subset ZkClient uses: connect, getData, exists,
+    ping; set_data() fires one-shot data watches like a real ensemble."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.watches = {}  # path -> [conn]
+        self._lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    @staticmethod
+    def _recv_frame(conn):
+        hdr = b""
+        while len(hdr) < 4:
+            c = conn.recv(4 - len(hdr))
+            if not c:
+                raise ConnectionError
+            hdr += c
+        (n,) = struct.unpack(">i", hdr)
+        out = b""
+        while len(out) < n:
+            c = conn.recv(n - len(out))
+            if not c:
+                raise ConnectionError
+            out += c
+        return out
+
+    @staticmethod
+    def _send_frame(conn, payload):
+        conn.sendall(struct.pack(">i", len(payload)) + payload)
+
+    @staticmethod
+    def _stat() -> bytes:
+        return struct.pack(">qqqqiiiqiiq", 0, 0, 0, 0, 1, 0, 0, 0, 0, 0, 0)
+
+    def _serve_conn(self, conn):
+        try:
+            frame = self._recv_frame(conn)  # ConnectRequest
+            _proto, _zxid, timeout, _sid = struct.unpack_from(">iqiq", frame, 0)
+            self._send_frame(
+                conn,
+                struct.pack(">iiq", 0, timeout, 0x1234)
+                + struct.pack(">i", 16)
+                + b"\x00" * 16,
+            )
+            while True:
+                frame = self._recv_frame(conn)
+                xid, op = struct.unpack_from(">ii", frame, 0)
+                if xid == -2:  # ping
+                    self._send_frame(conn, struct.pack(">iqi", -2, 0, 0))
+                    continue
+                (plen,) = struct.unpack_from(">i", frame, 8)
+                path = frame[12 : 12 + plen].decode()
+                watch = frame[12 + plen] == 1
+                with self._lock:
+                    data = self.nodes.get(path)
+                    if watch:
+                        self.watches.setdefault(path, []).append(conn)
+                if op == 4:  # getData
+                    if data is None:
+                        self._send_frame(conn, struct.pack(">iqi", xid, 0, -101))
+                    else:
+                        self._send_frame(
+                            conn,
+                            struct.pack(">iqi", xid, 0, 0)
+                            + struct.pack(">i", len(data))
+                            + data
+                            + self._stat(),
+                        )
+                elif op == 3:  # exists
+                    if data is None:
+                        self._send_frame(conn, struct.pack(">iqi", xid, 0, -101))
+                    else:
+                        self._send_frame(
+                            conn, struct.pack(">iqi", xid, 0, 0) + self._stat()
+                        )
+        except (ConnectionError, OSError):
+            pass
+
+    def set_data(self, path: str, data: bytes):
+        with self._lock:
+            created = path not in self.nodes
+            self.nodes[path] = data
+            conns = self.watches.pop(path, [])
+        evt_type = 1 if created else 3  # NodeCreated / NodeDataChanged
+        b = path.encode()
+        for conn in conns:
+            try:
+                self._send_frame(
+                    conn,
+                    struct.pack(">iqi", -1, 0, 0)
+                    + struct.pack(">ii", evt_type, 3)
+                    + struct.pack(">i", len(b))
+                    + b,
+                )
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        self._srv.close()
+
+
+def test_zookeeper_watch_push():
+    srv = FakeZkServer()
+    srv.nodes["/sentinel/rules"] = b"z1"
+    ds = ZookeeperDataSource(
+        f"127.0.0.1:{srv.port}", "/sentinel/rules", parser=lambda s: s * 2
+    )
+    try:
+        got, evt = _collect(ds)
+        assert ds.get_property().value == "z1z1"
+        srv.set_data("/sentinel/rules", b"z2")
+        assert _wait(evt, got, lambda v: v == "z2z2")
+        # watches are one-shot and re-armed: a second change must land too
+        srv.set_data("/sentinel/rules", b"z3")
+        assert _wait(evt, got, lambda v: v == "z3z3")
+    finally:
+        ds.close()
+        srv.close()
+
+
+def test_zookeeper_absent_node_publishes_on_creation():
+    srv = FakeZkServer()
+    ds = ZookeeperDataSource(
+        f"127.0.0.1:{srv.port}", "/sentinel/late", parser=lambda s: s
+    )
+    try:
+        got, evt = _collect(ds)
+        assert ds.get_property().value is None
+        srv.set_data("/sentinel/late", b"born")
+        assert _wait(evt, got, lambda v: v == "born")
+    finally:
+        ds.close()
+        srv.close()
