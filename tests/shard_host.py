@@ -26,8 +26,11 @@ from sentinel_tpu.runtime.client import SentinelClient  # noqa: E402
 
 def main() -> None:
     rules = json.loads(sys.argv[1]) if len(sys.argv) > 1 else []
+    # optional second arg: EngineConfig overrides (the multihost benchmark
+    # sizes capacity so every routed resource gets a real ruled row)
+    cfg_kw = json.loads(sys.argv[2]) if len(sys.argv) > 2 else {}
     client = SentinelClient(
-        cfg=small_engine_config(), mode="threaded", tick_interval_ms=2.0
+        cfg=small_engine_config(**cfg_kw), mode="threaded", tick_interval_ms=2.0
     )
     client.start()
     client.flow_rules.load(
