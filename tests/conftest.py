@@ -31,6 +31,44 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Heavy equivalence/engine tests run EAGERLY (jax.disable_jit): their cost
+# is XLA-CPU compilation of interpret-mode engine programs, not execution —
+# the seg-vs-fused equivalence test alone took 1080 s jitted vs 96 s eager
+# (measured, identical assertions; integer/float ops are bit-identical
+# either way).  Modules needing real jit semantics (pjit/mesh sharding,
+# subprocess hosts) stay jitted.
+_EAGER_MODULES = {
+    "test_engine_seg",
+    "test_fused",
+    "test_engine_backends",
+    "test_client_fastpath",
+    "test_tpu_equivalence",
+    "test_rank",
+    "test_occupy",
+    "test_segment",
+    "test_sketch",
+    "test_tail_rules",
+    "test_adapters",
+    "test_mxu_table",
+}
+
+
+@pytest.fixture(autouse=True)
+def _eager_heavy(request):
+    # @pytest.mark.jitted opts a test back into compiled execution —
+    # tests that run MANY small ticks are execution-bound, and eager
+    # dispatch costs more there than one compile does
+    if request.node.get_closest_marker("jitted") is not None:
+        yield
+        return
+    mod = getattr(request.node, "module", None)
+    name = mod.__name__.rsplit(".", 1)[-1] if mod else ""
+    if name in _EAGER_MODULES:
+        with jax.disable_jit():
+            yield
+    else:
+        yield
+
 
 @pytest.fixture(autouse=True)
 def _clean_context():
