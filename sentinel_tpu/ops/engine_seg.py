@@ -46,6 +46,7 @@ from sentinel_tpu.ops import param as P
 from sentinel_tpu.ops import rowmin as RM
 from sentinel_tpu.ops import rtq as RQ
 from sentinel_tpu.ops import segment as SG
+from sentinel_tpu.ops import segscan as SC
 from sentinel_tpu.ops import tables as T
 from sentinel_tpu.ops import window as W
 
@@ -116,7 +117,7 @@ def prepare_completions(cfg: EngineConfig, comp, features: frozenset):
     rtm = int(cfg.statistic_max_rt) * 8
     C_rows, split = SG.cum_cols([succ_w, err_w, rt_q], [cm, cm, rtm])
     head = SG.heads_from_keys(comp.res, comp.ctx_node, comp.origin_node)
-    inc_min = SG.block_min_inclusive(
+    inc_min = SC.seg_incl_min_pl(
         head,
         jnp.where(valid & (rt1 > 0), rt1, jnp.float32(_RT_ABSENT)),
         _RT_ABSENT,
@@ -317,26 +318,47 @@ def run_checks_seg(
 
     # ================= segment-level phase =================
     with_auth = "authority" in features
+    with_param = "param" in features
+    with_flow = "flow" in features
+    with_degrade = "degrade" in features
+
+    # all four per-resource slot tables are read at the SAME index — one
+    # shared 8-lane row gather serves them (tables.lane_gather_multi; a
+    # separate lane gather each cost ~0.1 ms apiece at U~16K).  Keyed by
+    # NAME so the gather list and the consumers can never fall out of
+    # order.
+    n_res1 = cfg.max_resources + 1
+    slot_tabs = []
     if with_auth:
-        n = cfg.max_resources + 1
-        # 1-column slot/mode tables ride the lane-packed gather (an MXU
-        # one-hot pass per digit plane costs ~0.1 ms each at U~16K)
-        mode = T.lane_gather_1col_int(
-            cfg, jnp.asarray(rules.auth.mode), res_l, n
+        slot_tabs.append(("auth", jnp.asarray(rules.auth.mode)))
+    if with_param:
+        slot_tabs.append(("param", jnp.asarray(rules.param.res_params)[:, 0]))
+    if with_flow:
+        slot_tabs.append(("flow", jnp.asarray(rules.flow.res_rules)[:, 0]))
+    if with_degrade:
+        slot_tabs.append(("degrade", jnp.asarray(rules.degrade.res_cbs)[:, 0]))
+    slot_vals = {
+        name: g.astype(jnp.int32)
+        for (name, _t), g in zip(
+            slot_tabs,
+            T.lane_gather_multi(cfg, [t for _n, t in slot_tabs], res_l, n_res1)
+            if slot_tabs
+            else [],
         )
+    }
+
+    if with_auth:
+        n = n_res1
+        mode = slot_vals["auth"]
         origins = T.big_gather(cfg, rules.auth.origins, res_l, n)
         listed = (
             (origins == carry.origin_id[:, None]) & (origins != RT.AUTH_EMPTY)
         ).any(axis=1)
         auth_u = ((mode == 1) & ~listed) | ((mode == 2) & listed)
 
-    with_param = "param" in features
     if with_param:
-        # KP == 1 statically (the seg_checks gate) -> 1-column lane gather
-        pslot_u = T.lane_gather_1col_int(
-            cfg, jnp.asarray(rules.param.res_params)[:, 0], res_l,
-            cfg.max_resources + 1,
-        )
+        # KP == 1 statically (the seg_checks gate) -> shared slot gather
+        pslot_u = slot_vals["param"]
         pcms, pcms_epochs, pcms_idx = P.refresh(
             state.pcms, state.pcms_epochs, now_ms, cfg
         )
@@ -372,14 +394,10 @@ def run_checks_seg(
         i_ih = [exp.add(ih_u[:, k]) for k in range(KI)]
         i_it = [exp.add_f(it_u[:, k]) for k in range(KI)]
 
-    with_flow = "flow" in features
     if with_flow:
         f = rules.flow
         sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
-        slot_u = T.lane_gather_1col_int(
-            cfg, jnp.asarray(f.res_rules)[:, 0], res_l,
-            cfg.max_resources + 1,
-        )
+        slot_u = slot_vals["flow"]
         fg = T.small_gather_fields(
             cfg,
             T.pack_fields(
@@ -498,12 +516,8 @@ def run_checks_seg(
         i_tthr = exp.add_f(thr_u)
         i_test = exp.add_f(est_u)
 
-    with_degrade = "degrade" in features
     if with_degrade:
-        dslot_u = T.lane_gather_1col_int(
-            cfg, jnp.asarray(rules.degrade.res_cbs)[:, 0], res_l,
-            cfg.max_resources + 1,
-        )
+        dslot_u = slot_vals["degrade"]
         dgu = T.small_gather_fields(
             cfg, T.pack_fields([rules.degrade.enabled, state.cb_state]), dslot_u
         )
@@ -647,13 +661,13 @@ def run_checks_seg(
             head_k = jnp.concatenate(
                 [jnp.ones((1,), bool), rank_key[1:] != rank_key[:-1]]
             )
-            r = SG.seg_excl_cumsum(
+            r = SC.seg_excl_cumsum_pl(
                 head_k,
                 jnp.stack(
                     [jnp.where(elig_f, acq.count, 0), elig_f.astype(jnp.int32)]
                 ),
             )
-            rc = SG.seg_excl_cumsum_wide(
+            rc = SC.seg_excl_cumsum_wide_pl(
                 head_k, jnp.where(elig_f, cost, 0.0).astype(jnp.int32)
             )
             return r[0].astype(jnp.float32), r[1].astype(jnp.float32), rc
@@ -706,7 +720,7 @@ def run_checks_seg(
                     head_n = jnp.concatenate(
                         [jnp.ones((1,), bool), node_i[1:] != node_i[:-1]]
                     )
-                    (r,) = SG.seg_excl_cumsum(
+                    (r,) = SC.seg_excl_cumsum_pl(
                         head_n, jnp.where(cand, acq.count, 0)[None, :]
                     )
                     return r.astype(jnp.float32)
@@ -764,7 +778,7 @@ def run_checks_seg(
             head_r = jnp.concatenate(
                 [jnp.ones((1,), bool), acq.res[1:] != acq.res[:-1]]
             )
-            (r,) = SG.seg_excl_cumsum(
+            (r,) = SC.seg_excl_cumsum_pl(
                 head_r, jnp.where(ruled, acq.count, 0)[None, :]
             )
             return r.astype(jnp.float32)
@@ -801,7 +815,7 @@ def run_checks_seg(
                 head_s = jnp.concatenate(
                     [jnp.ones((1,), bool), dslot_i[1:] != dslot_i[:-1]]
                 )
-                (r,) = SG.seg_excl_cumsum(head_s, cand.astype(jnp.int32)[None, :])
+                (r,) = SC.seg_excl_cumsum_pl(head_s, cand.astype(jnp.int32)[None, :])
                 return r.astype(jnp.float32)
 
             def _sort():

@@ -96,6 +96,50 @@ def lane_gather_1col_int(
     return lane_gather_1col(cfg, table, idx, n).astype(jnp.int32)
 
 
+def lane_gather_multi(
+    cfg: EngineConfig, tables: Sequence[jax.Array], idx: jax.Array, n: int
+) -> list:
+    """Up to FOUR 1-column tables read at the SAME index with ONE gather.
+
+    Interleaves the k tables two-rows-per-8-lane-row ([n/2, 8]: row r
+    holds tables[0..3] of ids 2r and 2r+1), gathers rows at idx>>1, and
+    selects each table's value with a data-dependent one-hot on
+    (idx&1)*4+col — the same cannot-be-narrowed trick as
+    lane_gather_1col, but k tables share the single row gather instead of
+    paying one each (the check phase reads four per-resource slot tables
+    at the same res index; ~0.1 ms per gather at U~16K adds up).
+    f32-exact values (< 2^24) only."""
+    k = len(tables)
+    assert 1 <= k <= 4
+    ok = (idx >= 0) & (idx < n)
+    safe = jnp.clip(idx, 0, n - 1)
+    if not cfg.use_mxu_tables:
+        return [
+            jnp.where(ok, t[safe].astype(jnp.float32), 0.0) for t in tables
+        ]
+    n2 = n + (n % 2)
+    cols = []
+    for t in tables:
+        t = t.astype(jnp.float32)
+        if n2 != n:
+            t = jnp.concatenate([t, jnp.zeros((1,), jnp.float32)])
+        cols.append(t.reshape(-1, 2))  # [n2/2, 2] (even, odd)
+    while len(cols) < 4:
+        cols.append(jnp.zeros_like(cols[0]))
+    # lane layout: [t0@even, t1@even, t2@even, t3@even, t0@odd, ...]
+    packed = jnp.concatenate(
+        [c[:, 0:1] for c in cols] + [c[:, 1:2] for c in cols], axis=1
+    )  # [n2/2, 8]
+    g = packed[safe >> 1]  # [N, 8] row gather
+    half = (safe & 1)[:, None] * 4
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 8), 1)
+    out = []
+    for c in range(k):
+        oh = ((half + c) == lane_iota).astype(jnp.float32)
+        out.append(jnp.where(ok, jnp.sum(g * oh, axis=1), 0.0))
+    return out
+
+
 def big_scatter_add(
     cfg: EngineConfig,
     table: jax.Array,

@@ -118,3 +118,31 @@ def test_lane_gather_1col_matches_big_gather():
     got = np.asarray(T.lane_gather_1col_int(cfg, jnp.asarray(tab), jnp.asarray(ids), 1000))
     assert got.dtype == np.int32
     np.testing.assert_array_equal(got, tab[ids])
+
+
+def test_lane_gather_multi_matches_oracle():
+    """tables.lane_gather_multi: k tables, one shared row gather — exact
+    vs numpy for odd/even n, k=1..4, out-of-range ids."""
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.config import EngineConfig
+    from sentinel_tpu.ops import tables as T
+
+    rng = np.random.default_rng(31)
+    cfg = EngineConfig(use_mxu_tables=True)
+    for n in (7, 16, 333):
+        for k in (1, 2, 3, 4):
+            tabs = [
+                rng.integers(0, 1 << 20, n).astype(np.int32) for _ in range(k)
+            ]
+            idx = rng.integers(-3, n + 3, 257).astype(np.int32)
+            got = T.lane_gather_multi(
+                cfg, [jnp.asarray(t) for t in tabs], jnp.asarray(idx), n
+            )
+            ok = (idx >= 0) & (idx < n)
+            for c in range(k):
+                want = np.where(ok, tabs[c][np.clip(idx, 0, n - 1)], 0)
+                np.testing.assert_array_equal(
+                    np.asarray(got[c]).astype(np.int64), want,
+                    err_msg=f"n={n} k={k} col={c}",
+                )

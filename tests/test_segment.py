@@ -169,3 +169,83 @@ def test_build_capacity_exceeds_batch():
     c = SG.compact(ctx, jnp.asarray(k1), fill=-1)
     assert c.shape == (128,)
     assert bool(ctx.ok)
+
+
+def test_segscan_pallas_matches_xla_scans():
+    """ops/segscan kernel vs segment.seg_excl_cumsum: exact equality over
+    random segment shapes, values up to the int32 contract, runs spanning
+    many 256-item tiles, and single/multi-row forms."""
+    import jax.numpy as jnp
+
+    from sentinel_tpu.ops import segment as SG
+    from sentinel_tpu.ops import segscan as SC
+
+    rng = np.random.default_rng(17)
+    for n, vmax, V in ((96, 255, 2), (1024, 255, 1), (2048, (1 << 24) - 1, 2),
+                       (700, 4095, 3)):
+        head = rng.random(n) < 0.05
+        head[0] = True
+        v = rng.integers(0, min(vmax, 2**31 // n), (V, n)).astype(np.int32)
+        got = np.asarray(SC.seg_excl_cumsum_pl(jnp.asarray(head), jnp.asarray(v)))
+        want = np.asarray(SG.seg_excl_cumsum(jnp.asarray(head), jnp.asarray(v)))
+        np.testing.assert_array_equal(got, want, err_msg=f"n={n} vmax={vmax}")
+    # one giant run (carry renormalization across many tiles near 2^31)
+    n = 4096
+    head = np.zeros(n, bool)
+    head[0] = True
+    v = np.full((1, n), 500_000, np.int32)  # total ~2.05e9 < 2^31
+    got = np.asarray(SC.seg_excl_cumsum_pl(jnp.asarray(head), jnp.asarray(v)))
+    want = np.asarray(SG.seg_excl_cumsum(jnp.asarray(head), jnp.asarray(v)))
+    np.testing.assert_array_equal(got, want)
+    # 1-D squeeze form + wide variant
+    head = rng.random(512) < 0.1
+    head[0] = True
+    v1 = rng.integers(0, 1 << 20, 512).astype(np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(SC.seg_excl_cumsum_pl(jnp.asarray(head), jnp.asarray(v1))),
+        np.asarray(SG.seg_excl_cumsum(jnp.asarray(head), jnp.asarray(v1))),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(SC.seg_excl_cumsum_wide_pl(jnp.asarray(head), jnp.asarray(v1))),
+        np.asarray(SG.seg_excl_cumsum_wide(jnp.asarray(head), jnp.asarray(v1))),
+    )
+
+
+def test_segscan_min_matches_block_min():
+    import jax.numpy as jnp
+
+    from sentinel_tpu.ops import segment as SG
+    from sentinel_tpu.ops import segscan as SC
+
+    rng = np.random.default_rng(23)
+    for n in (96, 512, 3000):
+        # block-capped heads like heads_from_keys produces
+        head = rng.random(n) < 0.07
+        head[0] = True
+        head[np.arange(n) % SG.BLOCK == 0] = True
+        v = rng.random(n).astype(np.float32) * 100.0
+        got = np.asarray(
+            SC.seg_incl_min_pl(jnp.asarray(head), jnp.asarray(v), 3.0e38)
+        )
+        want = np.asarray(
+            SG.block_min_inclusive(jnp.asarray(head), jnp.asarray(v), 3.0e38)
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"n={n}")
+
+
+def test_segscan_wide_survives_int32_overflowing_totals():
+    """The wide variant exists for batch totals beyond int32 (rate-limiter
+    pacing costs); a first cut wrapped at 2^31 — pin the digit-lane path."""
+    import jax.numpy as jnp
+
+    from sentinel_tpu.ops import segment as SG
+    from sentinel_tpu.ops import segscan as SC
+
+    n = 4096
+    head = np.zeros(n, bool)
+    head[0] = True
+    v = np.full(n, (1 << 24) - 1, np.int32)  # total ~6.9e10 >> 2^31
+    got = np.asarray(SC.seg_excl_cumsum_wide_pl(jnp.asarray(head), jnp.asarray(v)))
+    want = np.asarray(SG.seg_excl_cumsum_wide(jnp.asarray(head), jnp.asarray(v)))
+    np.testing.assert_array_equal(got, want)
+    assert got[-1] > 2**31  # genuinely past the int32 range
