@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 import pytest
 
 from sentinel_tpu.core.config import small_engine_config
@@ -128,247 +127,3 @@ def test_seg_no_fallback_matches_when_capacity_fits(sort_batches):
     for a, b in zip(out1, out2):
         np.testing.assert_array_equal(a, b)
     _assert_state_equal(st1, st2)
-
-
-def test_seg_static_ranks_matches_when_contract_holds():
-    """seg_static_ranks=True compiles only the segmented-scan ranks; with
-    the contract honored (sorted batches, DIRECT/default-limitApp rules)
-    it must be bit-identical to the cond-based engine.
-
-    Fresh-interpreter isolated: see _respawned."""
-    if _respawned(
-        f"{__file__}::test_seg_static_ranks_matches_when_contract_holds"
-    ):
-        return
-    base = dict(
-        batch_size=96,
-        complete_batch_size=96,
-        use_mxu_tables=True,
-        enable_minute_window=True,
-        fused_effects=True,
-        flow_rules_per_resource=1,
-        degrade_rules_per_resource=1,
-        param_rules_per_resource=1,
-    )
-    cfg_a = small_engine_config(
-        **base, seg_effects=True, seg_u=128, seg_fallback=False
-    )
-    cfg_b = small_engine_config(
-        **base, seg_effects=True, seg_u=128, seg_fallback=False,
-        seg_static_ranks=True,
-    )
-    st1, out1 = _tick_once(cfg_a, sort_batches=True)
-    st2, out2 = _tick_once(cfg_b, sort_batches=True)
-    for a, b in zip(out1, out2):
-        np.testing.assert_array_equal(a, b)
-    _assert_state_equal(st1, st2)
-
-
-def test_seg_static_ranks_unsorted_fails_closed():
-    """Breaking the static-rank contract (unsorted batch) must over-block
-    loudly — every flow-ruled item rejected — never misrank silently.
-
-    Fresh-interpreter isolated: see _respawned."""
-    if _respawned(f"{__file__}::test_seg_static_ranks_unsorted_fails_closed"):
-        return
-    from sentinel_tpu.core.errors import PASS, PASS_WAIT
-    from sentinel_tpu.core.rules import FlowRule
-    from sentinel_tpu.ops import engine as E
-    from sentinel_tpu.runtime.registry import Registry
-
-    cfg = small_engine_config(
-        batch_size=64, complete_batch_size=64, use_mxu_tables=True,
-        fused_effects=True, flow_rules_per_resource=1,
-        degrade_rules_per_resource=1, param_rules_per_resource=1,
-        seg_effects=True, seg_u=128, seg_fallback=False,
-        seg_static_ranks=True,
-    )
-    reg = Registry(cfg)
-    for i in range(8):
-        reg.resource_id(f"r{i}")
-    rules = E.compile_ruleset(
-        cfg, reg,
-        flow_rules=[FlowRule(resource=f"r{i}", count=1000.0) for i in range(8)],
-    )
-    state = E.init_state(cfg)
-    rng = np.random.default_rng(3)
-    res = rng.integers(1, 9, cfg.batch_size).astype(np.int32)  # UNSORTED
-    acq = E.empty_acquire(cfg)._replace(
-        res=jnp.asarray(res), count=jnp.ones((cfg.batch_size,), jnp.int32)
-    )
-    state, out = E.tick(
-        state, rules, acq, E.empty_complete(cfg), jnp.int32(900),
-        jnp.float32(0.0), jnp.float32(0.0), cfg=cfg,
-    )
-    v = np.asarray(out.verdict)
-    assert not np.isin(v, [PASS, PASS_WAIT]).any()  # all fail closed
-
-
-def test_seg_no_fallback_overflow_fails_closed():
-    """seg_fallback=False with too-small capacity: overflow items must
-    BLOCK (system rejection — never pass unchecked), kept items keep
-    exact verdicts, and seg_dropped counts only real (non-trash) items.
-
-    Fresh-interpreter isolated: see _respawned."""
-    if _respawned(f"{__file__}::test_seg_no_fallback_overflow_fails_closed"):
-        return
-    from sentinel_tpu.core.errors import BLOCK_SYSTEM
-    from sentinel_tpu.core.rules import FlowRule
-    from sentinel_tpu.ops import engine as E
-    from sentinel_tpu.runtime.registry import Registry
-
-    base = dict(
-        batch_size=64,
-        complete_batch_size=64,
-        use_mxu_tables=True,
-        fused_effects=True,
-        flow_rules_per_resource=1,
-        degrade_rules_per_resource=1,
-        param_rules_per_resource=1,
-    )
-    U = 8
-
-    def run(seg_u, seg_fallback):
-        cfg = small_engine_config(
-            **base, seg_effects=True, seg_u=seg_u, seg_fallback=seg_fallback
-        )
-        reg = Registry(cfg)
-        for i in range(16):
-            reg.resource_id(f"r{i}")
-        rules = E.compile_ruleset(
-            cfg, reg,
-            flow_rules=[FlowRule(resource=f"r{i}", count=50.0) for i in range(16)],
-        )
-        state = E.init_state(cfg)
-        B = cfg.batch_size
-        # sorted batch touching 16 resources -> 16 segments; pad the last
-        # quarter with trash rows (must never count as dropped)
-        ids = np.sort(np.arange(48) % 16 + 1).astype(np.int32)
-        res = np.concatenate([ids, np.full(B - 48, cfg.trash_row, np.int32)])
-        acq = E.empty_acquire(cfg)._replace(
-            res=jnp.asarray(res), count=jnp.ones((B,), jnp.int32)
-        )
-        state, out = E.tick(
-            state, rules, acq, E.empty_complete(cfg), jnp.int32(700),
-            jnp.float32(0.0), jnp.float32(0.0), cfg=cfg,
-        )
-        return np.asarray(out.verdict), int(out.seg_dropped), res
-
-    v_exact, dropped_exact, _ = run(seg_u=32, seg_fallback=True)
-    v_over, dropped_over, res = run(seg_u=U, seg_fallback=False)
-    assert dropped_exact == 0
-    assert dropped_over > 0
-    valid = res != small_engine_config(**base).trash_row
-    # kept items (the first U segments) keep their exact verdicts
-    kept = valid & (np.cumsum(np.concatenate([[True], res[1:] != res[:-1]])) <= U)
-    np.testing.assert_array_equal(v_over[kept], v_exact[kept])
-    # every overflow item fails closed as a system rejection
-    over = valid & ~kept
-    assert over.sum() == dropped_over
-    assert (v_over[over] == BLOCK_SYSTEM).all()
-    # trash padding is neither blocked-counted nor dropped-counted
-    assert (v_over[~valid] == v_exact[~valid]).all()
-
-
-@pytest.mark.parametrize("sort_batches", [True, False])
-def test_seg_flow_check_k1(sort_batches):
-    """flow_rules_per_resource=1 activates the segment-level flow check
-    (check_flow_seg).  sorted batches take the segmented-rank branch;
-    unsorted ones overflow capacity / fail res_sorted and fall back —
-    both must match the plain fused engine bit for bit.
-
-    Fresh-interpreter isolated: see _respawned."""
-    if _respawned(f"{__file__}::test_seg_flow_check_k1[{sort_batches}]"):
-        return
-    base = dict(
-        batch_size=96,
-        complete_batch_size=96,
-        use_mxu_tables=True,
-        enable_minute_window=True,
-        fused_effects=True,
-        flow_rules_per_resource=1,
-        degrade_rules_per_resource=1,
-        param_rules_per_resource=1,
-    )
-    cfg_fused = small_engine_config(**base)
-    cfg_seg = small_engine_config(**base, seg_effects=True)
-    st1, out1 = _tick_once(cfg_fused, sort_batches=sort_batches)
-    st2, out2 = _tick_once(cfg_seg, sort_batches=sort_batches)
-    for a, b in zip(out1, out2):
-        np.testing.assert_array_equal(a, b)
-    _assert_state_equal(st1, st2)
-
-
-def test_seg_tick_sorted_batch_matches_unsorted_semantics():
-    """A batch presorted by resource (stable) must produce the same
-    per-item verdicts as the unsorted batch once un-permuted, and the same
-    final integer state (f32 rt sums may differ in summation order, so
-    they are compared with quantization tolerance).
-
-    Fresh-interpreter isolated: see _respawned."""
-    if _respawned(
-        f"{__file__}::test_seg_tick_sorted_batch_matches_unsorted_semantics"
-    ):
-        return
-    from sentinel_tpu.core.rules import DegradeRule, FlowRule
-    from sentinel_tpu.ops import engine as E
-    from sentinel_tpu.runtime.registry import Registry
-
-    base = dict(
-        batch_size=128,
-        complete_batch_size=128,
-        use_mxu_tables=True,
-        fused_effects=True,
-        enable_minute_window=True,
-    )
-
-    def run(sort: bool, seg: bool):
-        cfg = small_engine_config(**base, seg_effects=seg)
-        reg = Registry(cfg)
-        flow, deg = [], []
-        for i in range(10):
-            name = f"r{i}"
-            reg.resource_id(name)
-            flow.append(FlowRule(resource=name, count=6.0))
-            deg.append(DegradeRule(resource=name, grade=0, count=3.0, time_window=5))
-        rules = E.compile_ruleset(cfg, reg, flow_rules=flow, degrade_rules=deg)
-        state = E.init_state(cfg)
-        rng = np.random.default_rng(11)
-        B = cfg.batch_size
-        verdicts = []
-        for t in range(3):
-            ids = rng.integers(1, 12, B).astype(np.int32)
-            cnt = np.ones(B, np.int32)
-            rt = rng.uniform(0.5, 9.0, B).astype(np.float32)
-            order = np.lexsort((np.arange(B), ids)) if sort else np.arange(B)
-            acq = E.empty_acquire(cfg)._replace(
-                res=jnp.asarray(ids[order]), count=jnp.asarray(cnt[order]),
-                inbound=jnp.ones((B,), jnp.int32),
-            )
-            comp = E.empty_complete(cfg)._replace(
-                res=jnp.asarray(ids[order]),
-                rt=jnp.asarray(rt[order]),
-                success=jnp.ones((B,), jnp.int32),
-            )
-            state, out = E.tick(
-                state, rules, acq, comp, jnp.int32(500 + 400 * t),
-                jnp.float32(0.0), jnp.float32(0.0), cfg=cfg,
-            )
-            v = np.asarray(out.verdict)
-            inv = np.empty(B, np.int64)
-            inv[order] = np.arange(B)
-            verdicts.append(v[inv])  # back to arrival order
-        return jax.tree.map(np.asarray, state), verdicts
-
-    st_u, v_u = run(sort=False, seg=False)
-    st_s, v_s = run(sort=True, seg=True)
-    for a, b in zip(v_u, v_s):
-        np.testing.assert_array_equal(a, b)
-    # integer state identical; f32 rt sums within summation-order noise
-    flat_u = jax.tree_util.tree_flatten_with_path(st_u)[0]
-    flat_s = jax.tree.leaves(st_s)
-    for (p, x), y in zip(flat_u, flat_s):
-        if x.dtype.kind in "iub":
-            np.testing.assert_array_equal(x, y, err_msg=str(p))
-        else:
-            np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-3, err_msg=str(p))
