@@ -1,0 +1,56 @@
+"""sentinel_tpu.analysis — AST-based TPU-hazard linter.
+
+Five passes guard the hot path's correctness discipline structurally
+(fail-open, host-sync, jit-recompile, time-source, unguarded-global);
+see README.md in this directory for the rule set, suppression syntax and
+the baseline-update workflow.
+
+Programmatic surface::
+
+    from sentinel_tpu.analysis import run_repo_analysis
+    findings, new = run_repo_analysis()
+
+CLI::
+
+    python -m sentinel_tpu.analysis            # lint sentinel_tpu/, exit 1 on new findings
+    python -m sentinel_tpu.analysis --json     # machine-readable report
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from sentinel_tpu.analysis.framework import (  # noqa: F401
+    ERROR,
+    WARNING,
+    Finding,
+    ParsedModule,
+    Pass,
+    load_baseline,
+    new_findings,
+    run_passes,
+    save_baseline,
+)
+from sentinel_tpu.analysis.passes import ALL_PASSES  # noqa: F401
+
+#: repo root (the directory containing the sentinel_tpu package)
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def run_repo_analysis(
+    roots: Optional[Sequence[str]] = None,
+    passes: Sequence[Pass] = ALL_PASSES,
+    baseline_path: str = DEFAULT_BASELINE,
+) -> Tuple[List[Finding], List[Finding]]:
+    """(all findings, findings new vs the checked-in baseline)."""
+    if roots is None:
+        roots = [os.path.join(REPO_ROOT, "sentinel_tpu")]
+    findings = run_passes(roots, passes, rel_to=REPO_ROOT)
+    base = load_baseline(baseline_path)
+    return findings, new_findings(findings, base)

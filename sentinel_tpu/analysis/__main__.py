@@ -1,0 +1,96 @@
+"""CLI: ``python -m sentinel_tpu.analysis [paths...]``.
+
+Exit status: 0 — no findings beyond the checked-in baseline;
+1 — new findings (print + fail, the CI contract); 2 — usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from sentinel_tpu.analysis import (
+    ALL_PASSES,
+    DEFAULT_BASELINE,
+    REPO_ROOT,
+    load_baseline,
+    new_findings,
+    run_passes,
+    save_baseline,
+)
+from sentinel_tpu.analysis.framework import format_json, format_text
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sentinel_tpu.analysis",
+        description="AST-based TPU-hazard linter (see sentinel_tpu/analysis/README.md)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the sentinel_tpu package)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON report on stdout")
+    ap.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file (default: sentinel_tpu/analysis/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="treat every finding as new (ignore the baseline)",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to accept the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated pass names to run (default: all five)",
+    )
+    args = ap.parse_args(argv)
+
+    passes = list(ALL_PASSES)
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {p.name for p in ALL_PASSES}
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(sorted(unknown))} "
+                f"(have: {', '.join(p.name for p in ALL_PASSES)})",
+                file=sys.stderr,
+            )
+            return 2
+        passes = [p for p in ALL_PASSES if p.name in wanted]
+
+    roots = args.paths or [os.path.join(REPO_ROOT, "sentinel_tpu")]
+    for r in roots:
+        if not os.path.exists(r):
+            print(f"no such path: {r}", file=sys.stderr)
+            return 2
+
+    findings = run_passes(roots, passes, rel_to=REPO_ROOT)
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(
+            f"baseline updated: {len(findings)} accepted finding(s) -> "
+            f"{args.baseline}"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new = new_findings(findings, baseline)
+
+    out = format_json(findings, new) if args.json else format_text(findings, new)
+    print(out)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

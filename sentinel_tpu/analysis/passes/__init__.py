@@ -1,0 +1,30 @@
+"""The five TPU-hazard passes.
+
+Each pass is independent; ``ALL_PASSES`` is the CI set.  Order matters
+only for report stability.
+"""
+
+from __future__ import annotations
+
+from sentinel_tpu.analysis.passes.fail_open import FailOpenPass
+from sentinel_tpu.analysis.passes.host_sync import HostSyncPass
+from sentinel_tpu.analysis.passes.jit_recompile import JitRecompilePass
+from sentinel_tpu.analysis.passes.time_source import TimeSourcePass
+from sentinel_tpu.analysis.passes.unguarded_global import UnguardedGlobalPass
+
+ALL_PASSES = (
+    FailOpenPass(),
+    HostSyncPass(),
+    JitRecompilePass(),
+    TimeSourcePass(),
+    UnguardedGlobalPass(),
+)
+
+__all__ = [
+    "ALL_PASSES",
+    "FailOpenPass",
+    "HostSyncPass",
+    "JitRecompilePass",
+    "TimeSourcePass",
+    "UnguardedGlobalPass",
+]

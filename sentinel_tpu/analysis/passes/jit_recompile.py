@@ -1,0 +1,138 @@
+"""jit-recompile: patterns that silently recompile or go stale under jit.
+
+Three concrete hazard shapes, each of which has bitten JAX services in
+production (and the jit-only buffer-aliasing class from ADVICE.md lives
+in the same blind spot — CI that runs eagerly never sees any of them):
+
+1. ``jax.jit(f)(x)`` — jitting at the call site builds a NEW callable
+   (and a new compile) every invocation; the cache is on the callable,
+   not the function.
+2. ``jax.jit(...)`` inside a loop — same failure, guaranteed.
+3. A jitted function branching in PYTHON (``if``/``while``) on a traced
+   parameter — either a trace error, or worse: the branch freezes at its
+   trace-time truth value and silently misdecides later calls.  Static
+   configuration parameters (``cfg``/``config``/``features`` and
+   ``functools.partial``-bound names, the make_tick idiom) are exempt.
+4. A jitted function reading a module-level MUTABLE container
+   (dict/list/set) — the value is baked in at trace time; later
+   mutations don't retrigger tracing, so the kernel silently serves
+   stale data.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from sentinel_tpu.analysis import astutil as A
+from sentinel_tpu.analysis.framework import ERROR, Finding, ParsedModule, Pass
+
+#: parameter names treated as static configuration, never traced
+_STATIC_PARAMS = {"cfg", "config", "features", "self", "cls"}
+
+_JIT_NAMES = ("jax.jit", "jax.pjit", "jax.pmap")
+
+
+class JitRecompilePass(Pass):
+    name = "jit-recompile"
+    description = "jit call-site/loop recompiles and trace-stale closures"
+    severity = ERROR
+
+    def run(self, mod: ParsedModule) -> Iterable[Finding]:
+        aliases = A.import_aliases(mod.tree)
+
+        def is_jit(call: ast.Call) -> bool:
+            return A.resolve_call(call, aliases) in _JIT_NAMES
+
+        # 1. jax.jit(f)(...) — immediately-invoked jit
+        invoked_jits: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Call)
+                and is_jit(node.func)
+            ):
+                invoked_jits.add(id(node.func))
+                yield self.finding(
+                    mod,
+                    node,
+                    "jax.jit(...) invoked at its own call site — this "
+                    "compiles on EVERY call; jit once (module level or a "
+                    "cached factory) and reuse the callable",
+                )
+
+        # 2. jax.jit inside a loop body
+        reported_loops: Set[int] = set()
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            for node in ast.walk(loop):
+                if (
+                    isinstance(node, ast.Call)
+                    and is_jit(node)
+                    # the immediately-invoked shape is already reported
+                    and id(node) not in invoked_jits
+                    and id(node) not in reported_loops
+                ):
+                    reported_loops.add(id(node))
+                    yield self.finding(
+                        mod,
+                        node,
+                        "jax.jit(...) inside a loop — each iteration builds "
+                        "a fresh callable and recompiles; hoist the jit out "
+                        "of the loop",
+                    )
+
+        # 3/4. per jitted function: python branches on traced params and
+        # reads of module-level mutables
+        jit_roots = A.jitted_root_names(mod.tree, aliases)
+        defs = A.func_defs(mod.tree)
+        mutables = A.module_mutables(mod.tree)
+        for fname in sorted(jit_roots):
+            fn = defs.get(fname)
+            if fn is None:
+                continue
+            args = fn.args
+            param_names = [
+                a.arg
+                for a in (
+                    list(args.posonlyargs)
+                    + list(args.args)
+                    + list(args.kwonlyargs)
+                )
+            ]
+            # kwonly args with defaults are partial-bound config in the
+            # make_tick idiom; name-based statics always exempt
+            kw_defaulted = {
+                a.arg
+                for a, d in zip(args.kwonlyargs, args.kw_defaults or [])
+                if d is not None
+            }
+            traced = {
+                p
+                for p in param_names
+                if p not in _STATIC_PARAMS and p not in kw_defaulted
+            }
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    for ref in ast.walk(node.test):
+                        if isinstance(ref, ast.Name) and ref.id in traced:
+                            yield self.finding(
+                                mod,
+                                node,
+                                f"python {type(node).__name__.lower()} on "
+                                f"traced parameter '{ref.id}' inside jitted "
+                                f"'{fname}' — the branch freezes at trace "
+                                "time; use jnp.where / lax.cond (or mark "
+                                "the argument static)",
+                            )
+                            break
+                elif isinstance(node, ast.Name) and node.id in mutables:
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"jitted '{fname}' reads module-level mutable "
+                        f"'{node.id}' — its value bakes in at trace time "
+                        "and goes stale on mutation; pass it as an "
+                        "argument or make it immutable",
+                    )

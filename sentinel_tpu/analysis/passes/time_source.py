@@ -1,0 +1,58 @@
+"""time-source: raw clock reads outside utils/time_source.py.
+
+Sentinel's rule (the cached-TimeUtil discipline, TimeUtil.java:25-50):
+every clock read goes through ONE module.  Kernels take ``now_ms`` as an
+explicit input; the host side reads ``TimeSource``/``VirtualTimeSource``
+or the module helpers in utils/time_source.py.  A raw ``time.time()``
+elsewhere (a) escapes virtual time, silently making a test
+wall-clock-dependent, and (b) re-opens the per-call syscall cost the
+cached source exists to amortize.
+
+Flagged: time.time / time.monotonic / time.monotonic_ns / time.time_ns /
+datetime.now / datetime.utcnow, via any import alias.  Not flagged:
+time.perf_counter* (profiling-only, never feeds a decision), time.sleep
+(not a clock READ), and everything inside the allowlisted module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from sentinel_tpu.analysis import astutil as A
+from sentinel_tpu.analysis.framework import ERROR, Finding, ParsedModule, Pass
+
+_BANNED = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+#: the single module allowed to touch the clock
+_ALLOWED_FILES = ("*utils/time_source.py",)
+
+
+class TimeSourcePass(Pass):
+    name = "time-source"
+    description = "raw clock reads must route through utils/time_source"
+    severity = ERROR
+
+    def run(self, mod: ParsedModule) -> Iterable[Finding]:
+        if A.path_matches(mod.path, _ALLOWED_FILES):
+            return
+        aliases = A.import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = A.resolve_call(node, aliases)
+            if name in _BANNED:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"raw clock read {name}() — use the client's TimeSource "
+                    "or a utils.time_source helper (keeps virtual time and "
+                    "the cached-clock discipline intact)",
+                )

@@ -1,0 +1,179 @@
+"""unguarded-global: module-level mutable state written without a lock.
+
+Registries (rule managers, tick caches, extension lists) live as
+module-level dicts/lists and get written from rule-reload threads,
+background resize threads, and the serving loop at once.  CPython's GIL
+makes a single ``d[k] = v`` atomic, but every real registry write is a
+check-then-act (``get`` → compile → ``set``), and unserialized
+check-then-act on the tick cache means two threads compiling the same
+executable — seconds of duplicated XLA work on the serving path — or a
+torn copy-on-write swap.
+
+Flagged: any mutation of a module-level mutable container (subscript
+assign/del, ``global X`` rebind, or a mutating method call — append /
+update / pop / setdefault / ...) from inside a function, unless the
+statement sits under a ``with`` whose context expression mentions a
+lock-ish name (lock / mutex / guard / cond / sem).  Module-level
+initialization code is exempt (import is single-threaded per the import
+lock).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from sentinel_tpu.analysis import astutil as A
+from sentinel_tpu.analysis.framework import ERROR, Finding, ParsedModule, Pass
+
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "remove",
+    "pop",
+    "popitem",
+    "clear",
+    "update",
+    "setdefault",
+    "add",
+    "discard",
+    "appendleft",
+    "__setitem__",
+}
+
+_LOCKISH = ("lock", "mutex", "guard", "cond", "sem")
+
+
+def _lockish(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and any(tok in name.lower() for tok in _LOCKISH):
+            return True
+    return False
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """Walk one function body tracking enclosing with-lock depth."""
+
+    def __init__(self, outer: "UnguardedGlobalPass", mod, mutables, fname):
+        self.outer = outer
+        self.mod = mod
+        self.mutables = mutables
+        self.fname = fname
+        self.lock_depth = 0
+        self.findings: List[Finding] = []
+
+    # nested defs get their own scan via the pass driver; don't descend
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node):  # noqa: N802
+        locked = any(_lockish(item.context_expr) for item in node.items)
+        if locked:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.lock_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def _report(self, node, gname: str, verb: str) -> None:
+        if self.lock_depth:
+            return
+        self.findings.append(
+            self.outer.finding(
+                self.mod,
+                node,
+                f"module-global '{gname}' {verb} in '{self.fname}' without "
+                "the owning lock — registry writes are check-then-act; "
+                "serialize them (with <lock>:) or suppress with a "
+                "single-threaded rationale",
+            )
+        )
+
+    def visit_Assign(self, node):  # noqa: N802
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in self.mutables
+            ):
+                self._report(node, t.value.id, "written")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):  # noqa: N802
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in self.mutables
+            ):
+                self._report(node, t.value.id, "deleted from")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _MUTATORS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in self.mutables
+        ):
+            self._report(node, f.value.id, f"mutated ({f.attr})")
+        self.generic_visit(node)
+
+
+class UnguardedGlobalPass(Pass):
+    name = "unguarded-global"
+    description = "module-level registry writes must hold the owning lock"
+    severity = ERROR
+
+    def run(self, mod: ParsedModule) -> Iterable[Finding]:
+        mutables = A.module_mutables(mod.tree)
+        if not mutables:
+            return
+        # `global X` rebinds count as writes too — find them per function
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared_global: Set[str] = set()
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.Global):
+                    declared_global |= {
+                        n for n in stmt.names if n in mutables
+                    }
+            scanner = _FuncScanner(self, mod, mutables, fn.name)
+            for stmt in fn.body:
+                scanner.visit(stmt)
+            # rebind of a declared-global mutable outside a lock
+            if declared_global:
+                rebind = _RebindScanner(
+                    self, mod, declared_global, fn.name
+                )
+                for stmt in fn.body:
+                    rebind.visit(stmt)
+                scanner.findings.extend(rebind.findings)
+            for f in scanner.findings:
+                yield f
+
+
+class _RebindScanner(_FuncScanner):
+    def visit_Assign(self, node):  # noqa: N802
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in self.mutables:
+                self._report(node, t.id, "rebound (global)")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):  # noqa: N802
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        self.generic_visit(node)

@@ -12,14 +12,16 @@ from __future__ import annotations
 
 import itertools
 import socket
+import struct
 import threading
-import time as _time
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Any, Dict, List, Optional
 
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.utils.time_source import mono_s
 
 #: sentinel returned by _roundtrip for requests that can never be encoded
 #: (oversized params) — a client-side problem, NOT a server failure, so it
@@ -77,7 +79,7 @@ class ClusterTokenClient(TokenService):
         with self._lock:
             if self._sock is not None:
                 return True
-            now = _time.monotonic()
+            now = mono_s()
             if now - self._last_attempt < self.reconnect_interval_s:
                 return False
             self._last_attempt = now
@@ -124,8 +126,8 @@ class ClusterTokenClient(TokenService):
                 for body in frames.feed(data):
                     try:
                         rsp = P.decode_response(body)
-                    except Exception:
-                        continue
+                    except (ValueError, struct.error):
+                        continue  # malformed frame; xid never resolves -> caller times out to STATUS_FAIL
                     f = self._pending.pop(rsp.xid, None)
                     if f is not None and not f.done():
                         f.set_result(rsp)
@@ -148,7 +150,7 @@ class ClusterTokenClient(TokenService):
             return None
         try:
             raw = P.encode_request(req)
-        except Exception:
+        except (ValueError, struct.error):
             return _BAD_REQUEST  # unencodable request; connection is fine
         f: Future = Future()
         self._pending[req.xid] = f
@@ -164,9 +166,9 @@ class ClusterTokenClient(TokenService):
             return None
         try:
             return f.result(timeout=self.timeout_ms / 1000.0)
-        except Exception:
+        except (_FutTimeout, CancelledError):
             self._pending.pop(req.xid, None)
-            return None
+            return None  # -> STATUS_FAIL at the TokenService surface (degrade, never PASS)
 
     # -- TokenService --------------------------------------------------------
 

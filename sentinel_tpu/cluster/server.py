@@ -15,12 +15,13 @@ connections are reaped on a timer (ScanIdleConnectionTask).
 from __future__ import annotations
 
 import asyncio
+import struct
 import threading
-import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.utils.time_source import mono_s
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
 from sentinel_tpu.utils.record_log import record_log
@@ -156,7 +157,7 @@ class ClusterTokenServer:
         # reconnects + re-PINGs, so connectedCount stays truthful
         while True:
             await asyncio.sleep(min(self.idle_seconds, 30))
-            cutoff = _time.monotonic() - self.idle_seconds
+            cutoff = mono_s() - self.idle_seconds
             for cid, last in list(self._last_active.items()):
                 if last < cutoff:
                     w = self._writers.get(cid)
@@ -177,7 +178,7 @@ class ClusterTokenServer:
         self._conn_seq += 1
         cid = self._conn_seq
         frames = P.FrameReader()
-        self._last_active[cid] = _time.monotonic()
+        self._last_active[cid] = mono_s()
         self._writers[cid] = writer
         loop = asyncio.get_running_loop()
         try:
@@ -185,12 +186,16 @@ class ClusterTokenServer:
                 data = await reader.read(4096)
                 if not data:
                     break
-                self._last_active[cid] = _time.monotonic()
+                self._last_active[cid] = mono_s()
                 for body in frames.feed(data):
                     try:
                         req = P.decode_request(body)
-                    except Exception:
-                        continue  # malformed frame — drop (server stays up)
+                    except (ValueError, struct.error, IndexError):
+                        # malformed frame — drop it, server stays up
+                        # (IndexError: _unpack_params indexing a truncated
+                        # param buffer; must not escape to the connection
+                        # handler and kill every pipelined request)
+                        continue
                     if req.type == C.MSG_TYPE_PING:
                         self.connections.register(cid, req.namespace or C.DEFAULT_NAMESPACE)
                         writer.write(
@@ -214,7 +219,7 @@ class ClusterTokenServer:
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
-        except Exception:
+        except Exception:  # stlint: disable=fail-open — connection dies (finally cleans census), peer times out to STATUS_FAIL and degrades
             record_log().exception("token server connection error")
         finally:
             self._last_active.pop(cid, None)
@@ -258,7 +263,7 @@ class ClusterTokenServer:
                 req.xid, req.type, r.status, remaining=r.remaining,
                 wait_ms=r.wait_ms,
             )
-        except Exception:
+        except Exception:  # stlint: disable=fail-open — converted to STATUS_FAIL: an explicit degrade signal, never a PASS
             record_log().exception("token request failed")
             rsp = P.ClusterResponse(req.xid, req.type, C.STATUS_FAIL)
         try:
@@ -313,7 +318,7 @@ class ClusterTokenServer:
                 )
             else:
                 r = TokenResult(C.STATUS_BAD_REQUEST)
-        except Exception:
+        except Exception:  # stlint: disable=fail-open — converted to STATUS_FAIL: an explicit degrade signal, never a PASS
             record_log().exception("token request processing failed")
             r = TokenResult(C.STATUS_FAIL)
         return P.ClusterResponse(

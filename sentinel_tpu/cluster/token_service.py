@@ -264,7 +264,7 @@ class DefaultTokenService(TokenService):
             return self.request_token_async(flow_id, count, prioritized).result(
                 timeout=self.client.entry_timeout_s
             )
-        except Exception:
+        except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
             return TokenResult(C.STATUS_FAIL)
 
     def request_token_async(self, flow_id: int, count: int = 1, prioritized: bool = False):
@@ -301,7 +301,7 @@ class DefaultTokenService(TokenService):
         def _chain(fut):
             try:
                 verdict, wait_ms = fut.result()
-            except Exception:
+            except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
                 done.set_result(TokenResult(C.STATUS_FAIL))
                 return
             if verdict == ERR.PASS:

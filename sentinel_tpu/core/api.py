@@ -14,13 +14,22 @@ from sentinel_tpu.core import rules as R
 _client = None
 _client_lock = threading.Lock()
 _init_funcs: list = []
+# a DEDICATED lock for the registration list: init() calls user init
+# funcs while holding _client_lock, and an init func (or a module import
+# it triggers) may legitimately register more funcs — sharing the
+# non-reentrant client lock would self-deadlock that path
+_init_funcs_lock = threading.Lock()
 
 
 def register_init_func(fn, order: int = 0):
     """Register a one-time init callback run when the process-wide client
     first starts, ordered ascending — the InitFunc SPI + @InitOrder analog
     (init/InitExecutor.java:41-64).  Receives the SentinelClient."""
-    _init_funcs.append((order, len(_init_funcs), fn))
+    # the read-modify-write on the registration SEQUENCE (len() is the
+    # FIFO tiebreak) must be serialized or concurrent registrations can
+    # claim the same tiebreak
+    with _init_funcs_lock:
+        _init_funcs.append((order, len(_init_funcs), fn))
 
 
 def init(**kwargs):
@@ -37,7 +46,12 @@ def init(**kwargs):
             c = SentinelClient(**kwargs)
             c.start()
             try:
-                for _, _, fn in sorted(_init_funcs):
+                with _init_funcs_lock:
+                    funcs = sorted(_init_funcs)
+                # funcs registered DURING init (by an init func itself)
+                # take effect on a later init() — matching the reference's
+                # one-shot InitExecutor semantics
+                for _, _, fn in funcs:
                     fn(c)
             except Exception:
                 # a failing init func must not leave a half-initialized

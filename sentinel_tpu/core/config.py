@@ -18,6 +18,7 @@ window-shape defaults in StatisticNode.java:96-103).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
@@ -35,6 +36,7 @@ _DEFAULTS: Dict[str, str] = {
 }
 
 _overrides: Dict[str, str] = {}
+_overrides_lock = threading.Lock()
 _file_props: Optional[Dict[str, str]] = None
 
 
@@ -81,11 +83,13 @@ def get_int(key: str, default: int = 0) -> int:
 
 
 def set_config(key: str, value: Any) -> None:
-    _overrides[key] = str(value)
+    with _overrides_lock:
+        _overrides[key] = str(value)
 
 
 def reset_overrides() -> None:
-    _overrides.clear()
+    with _overrides_lock:
+        _overrides.clear()
 
 
 def app_name() -> str:

@@ -413,6 +413,13 @@ def compile_authority_rules(
             # cannot be enforced; observability continues via the sketch
             continue
         t.mode[rid] = 1 if rule.strategy == R.AUTHORITY_WHITE else 2
+        # true last-wins: clear the resource's slots before writing, so a
+        # second rule on the same resource REPLACES the first instead of
+        # leaving the device matching the union of both origin lists
+        # (the host mirror in runtime/client.py keeps only the last rule;
+        # a union here made the mirror host-stricter under WHITE, skipping
+        # _cluster_check on traffic the device then passed — ADVICE r5)
+        t.origins[rid, :] = AUTH_EMPTY
         for i, o in enumerate(rule.origins()[:KA]):
             t.origins[rid, i] = registry.origin_id(o)
     return t

@@ -9,9 +9,10 @@ healthy while its last heartbeat is younger than ``stale_after_s``.
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+from sentinel_tpu.utils.time_source import wall_s
 
 
 @dataclass
@@ -22,14 +23,14 @@ class MachineInfo:
     hostname: str = ""
     pid: int = 0
     version: str = ""
-    last_heartbeat: float = field(default_factory=time.time)
+    last_heartbeat: float = field(default_factory=wall_s)
 
     @property
     def key(self) -> str:
         return f"{self.ip}:{self.port}"
 
     def healthy(self, stale_after_s: float = 30.0) -> bool:
-        return (time.time() - self.last_heartbeat) < stale_after_s
+        return (wall_s() - self.last_heartbeat) < stale_after_s
 
     def to_json(self) -> dict:
         return {
@@ -76,7 +77,7 @@ class AppManagement:
 
     def remove_stale(self, older_than_s: float = 600.0) -> int:
         """Drop machines silent for a long time; returns #removed."""
-        cutoff = time.time() - older_than_s
+        cutoff = wall_s() - older_than_s
         removed = 0
         with self._lock:
             for machines in self._apps.values():

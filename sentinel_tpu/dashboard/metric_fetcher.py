@@ -9,12 +9,12 @@ second (with a catch-up window capped at ``max_catchup_ms`` — reference 15 s
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, Optional
 
 from sentinel_tpu.dashboard.api_client import SentinelApiClient
 from sentinel_tpu.dashboard.discovery import AppManagement
 from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+from sentinel_tpu.utils.time_source import wall_ms_now
 
 DEFAULT_INTERVAL_S = 1.0
 DEFAULT_MAX_CATCHUP_MS = 15_000
@@ -57,7 +57,7 @@ class MetricFetcher:
 
     def fetch_once(self, now_ms: Optional[int] = None) -> int:
         """One sweep over all healthy machines; returns #nodes saved."""
-        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        now_ms = wall_ms_now() if now_ms is None else now_ms
         saved = 0
         for app in self.discovery.apps():
             for m in self.discovery.machines(app, only_healthy=True):

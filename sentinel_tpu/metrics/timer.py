@@ -12,10 +12,10 @@ independent of resource count up to the engine capacity.
 from __future__ import annotations
 
 import threading
-import time as _time
 from typing import Optional
 
 from sentinel_tpu.metrics.node import MetricNode
+from sentinel_tpu.utils.time_source import wall_s
 from sentinel_tpu.metrics.writer import MetricWriter
 
 
@@ -73,7 +73,7 @@ class MetricTimerListener:
         while not self._stop.is_set():
             # align to the wall-second boundary so each line covers one
             # whole second (the scheduled-at-fixed-rate 1 s cadence)
-            delay = 1.0 - (_time.time() % 1.0)
+            delay = 1.0 - (wall_s() % 1.0)
             if self._stop.wait(delay + 0.01):
                 break
             try:

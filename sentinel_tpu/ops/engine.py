@@ -28,6 +28,7 @@ time is an explicit input (see SURVEY.md §4.1).
 from __future__ import annotations
 
 import functools
+import threading
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -167,10 +168,15 @@ class CompleteBatch(NamedTuple):
 class TickOutput(NamedTuple):
     verdict: jax.Array  # int8 [B] PASS / BLOCK_* / PASS_WAIT
     wait_ms: jax.Array  # int32 [B] pacing delay for PASS_WAIT
-    # items whose EFFECTS were dropped by segment-capacity overflow (only
-    # ever nonzero with seg_effects=True, seg_fallback=False; verdicts are
-    # still exact).  Callers monitoring this can resize seg_u or re-enable
-    # the fallback.  (Plain-int default: a jnp scalar here would initialize
+    # items hit by segment-capacity overflow (only ever nonzero with
+    # seg_effects=True, seg_fallback=False).  Overflow items FAIL CLOSED:
+    # their verdict is forced to BLOCK (the client surfaces them as
+    # "FAILED CLOSED", test_seg_overflow_drop_surfaced_and_fails_closed
+    # asserts BLOCK_SYSTEM) and only their EFFECTS are dropped-counted
+    # here — verdicts are NOT exact for them.  Operators must treat a
+    # nonzero value as an incident: resize seg_u or re-enable the
+    # fallback; disabling the fallback never trades exactness for
+    # openness.  (Plain-int default: a jnp scalar here would initialize
     # the backend at import time.)
     seg_dropped: object = 0  # int32 scalar on the seg path
 
@@ -2513,6 +2519,7 @@ def migrate_state(
 
 
 _TICK_CACHE: dict = {}
+_TICK_CACHE_LOCK = threading.Lock()
 
 
 def make_tick(
@@ -2532,10 +2539,16 @@ def make_tick(
     authority machinery, and "nodes" off drops the ctx/origin stat fan-out.
     """
     key = (cfg, donate, jit, features)
-    fn = _TICK_CACHE.get(key)
-    if fn is None:
-        fn = functools.partial(tick, cfg=cfg, features=features)
-        if jit:
-            fn = jax.jit(fn, donate_argnums=(0,) if donate else ())
-        _TICK_CACHE[key] = fn
+    # check-then-act under the cache lock: the background seg_u-resize
+    # thread and the serving thread race here on a rule reload, and two
+    # distinct jitted callables for one key would each pay the multi-
+    # second XLA compile (jax.jit itself is lazy, so holding the lock
+    # across it costs microseconds)
+    with _TICK_CACHE_LOCK:
+        fn = _TICK_CACHE.get(key)
+        if fn is None:
+            fn = functools.partial(tick, cfg=cfg, features=features)
+            if jit:
+                fn = jax.jit(fn, donate_argnums=(0,) if donate else ())
+            _TICK_CACHE[key] = fn
     return fn

@@ -101,7 +101,7 @@ def seg_excl_cumsum_pl(head: jax.Array, values: jax.Array) -> jax.Array:
     Np = v.shape[1]
     nT = Np // TB
 
-    out = pl.pallas_call(
+    out = FU.run_pallas(pl.pallas_call(
         _kernel,
         grid=(nT,),
         in_specs=[
@@ -113,11 +113,12 @@ def seg_excl_cumsum_pl(head: jax.Array, values: jax.Array) -> jax.Array:
         ),
         out_shape=jax.ShapeDtypeStruct((V, Np), jnp.int32),
         scratch_shapes=[pltpu.VMEM((8, 128), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=FU.tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=FU.interpret_mode(),
-    )(head.astype(jnp.int32)[None, :], v)
+    ), head.astype(jnp.int32)[None, :], v,
+        key=("seg_excl_cumsum", V, Np))
 
     res = out[:, :n]
     return res[0] if squeeze else res
@@ -188,7 +189,7 @@ def seg_incl_min_pl(head: jax.Array, values: jax.Array, fill: float) -> jax.Arra
         head = jnp.concatenate([head, jnp.ones((pad,), bool)])
     Np = v.shape[1]
 
-    out = pl.pallas_call(
+    out = FU.run_pallas(pl.pallas_call(
         _kernel_min,
         grid=(Np // TB,),
         in_specs=[
@@ -200,11 +201,12 @@ def seg_incl_min_pl(head: jax.Array, values: jax.Array, fill: float) -> jax.Arra
         ),
         out_shape=jax.ShapeDtypeStruct((1, Np), jnp.float32),
         scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=FU.tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=FU.interpret_mode(),
-    )(head.astype(jnp.int32)[None, :], v)
+    ), head.astype(jnp.int32)[None, :], v,
+        key=("seg_incl_min", Np))
     # sentinel BIG never leaks: every segment has >= 1 item, and heads
     # reset the min to that item's value; fill only pads
     return out[0, :n]

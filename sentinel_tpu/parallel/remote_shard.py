@@ -20,12 +20,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.utils.time_source import mono_s
 from sentinel_tpu.utils.record_log import record_log
 
 
@@ -80,7 +80,14 @@ class RemoteShard:
             if not chunk:
                 raise OSError("peer closed")
             body += chunk
-        return P.decode_response(body)
+        try:
+            return P.decode_response(body)
+        except (ValueError, struct.error, IndexError) as e:
+            # a frame that parses as a length but not as a response means
+            # the stream is desynced — surface it as transport trouble so
+            # the caller's OSError path closes the socket and degrades
+            # instead of the admission path crashing
+            raise OSError(f"undecodable response frame: {e}") from e
 
     # -- shard surface -------------------------------------------------------
 
@@ -186,29 +193,41 @@ class RemoteShard:
     def _rpc_pipeline(self, wires) -> List[Optional[P.ClusterResponse]]:
         """Windowed request/response exchange: up to WINDOW frames on the
         wire before the first read (the server answers in order per
-        connection).  On transport failure, answered spans KEEP their
-        responses; one reconnect retries only the unanswered ones — a
-        chunk is never replayed after its answer arrived (replay would
-        double-count admission on the shard)."""
+        connection).
+
+        At-most-once on failure: answered chunks keep their responses,
+        and any chunk WRITTEN to a socket that subsequently failed is
+        treated as possibly-processed-with-the-response-lost — it is
+        NEVER re-sent (the shard may already have admitted it; replaying
+        would double-count admission, and WINDOW=8 pipelining would widen
+        that to up to 8 chunks / 1024 items per failure).  Those spans
+        come back as None and the caller degrades them (local fallback
+        rules, else fail-open pass-through, exactly like an unreachable
+        shard).  Only chunks never written to a socket ride the single
+        reconnect attempt."""
         m = len(wires)
         rsps: List[Optional[P.ClusterResponse]] = [None] * m
         pending = [i for i in range(m) if wires[i] is not None]
         if not pending:
             return rsps
         with self._lock:
-            if time.monotonic() < self._down_until:
+            if mono_s() < self._down_until:
                 return rsps
             for attempt in (0, 1):  # one reconnect, like the netty client
+                # chunks written to THIS attempt's socket; on failure they
+                # are forfeited (degraded), not retried — see docstring
+                inflight: List[int] = []
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
                     s = self._sock
                     queue = list(pending)
-                    inflight: List[int] = []
                     while queue and len(inflight) < self.WINDOW:
                         i = queue.pop(0)
-                        s.sendall(wires[i])
+                        # count as written BEFORE sendall: a mid-write
+                        # failure may still deliver a parseable frame
                         inflight.append(i)
+                        s.sendall(wires[i])
                     while inflight:
                         rsp = self._read_response(s)
                         i = inflight.pop(0)
@@ -216,18 +235,36 @@ class RemoteShard:
                         pending.remove(i)
                         if queue:
                             j = queue.pop(0)
-                            s.sendall(wires[j])
                             inflight.append(j)
+                            s.sendall(wires[j])
                     return rsps
                 except OSError:
                     self._close()
-                    if attempt == 1:
+                    for i in inflight:
+                        # possibly processed shard-side, response lost —
+                        # degrade this span instead of re-admitting it
+                        pending.remove(i)
+                    if inflight:
+                        record_log().warning(
+                            "shard %s:%d failed with %d chunk(s) in flight "
+                            "— degrading those spans (no replay)",
+                            self.host,
+                            self.port,
+                            len(inflight),
+                        )
+                    if attempt == 1 or not pending:
                         # cool-down anchored at FAILURE time: connect
                         # timeouts can burn seconds inside the attempts,
                         # and an entry-time anchor would already be in
-                        # the past, silently disabling the cool-down
+                        # the past, silently disabling the cool-down.
+                        # Also armed when a mid-exchange failure forfeited
+                        # every remaining chunk — a shard that dies after
+                        # accepting the connection is as unhealthy as one
+                        # that refused it, and without the cool-down every
+                        # subsequent batch would re-pay the connect+write+
+                        # fail latency and forfeit another window
                         self._down_until = (
-                            time.monotonic() + self.retry_interval_s
+                            mono_s() + self.retry_interval_s
                         )
                         record_log().warning(
                             "shard %s:%d unreachable — degrading for %.1fs",
@@ -235,6 +272,7 @@ class RemoteShard:
                             self.port,
                             self.retry_interval_s,
                         )
+                        break
         return rsps
 
     def entry(self, resource: str, count: int = 1, prioritized: bool = False, **kw):

@@ -57,7 +57,7 @@ from sentinel_tpu.runtime import context as CTX
 from sentinel_tpu.runtime.registry import Registry
 from sentinel_tpu.metrics import extension as MEXT
 from sentinel_tpu.utils.system_status import SystemStatusSampler
-from sentinel_tpu.utils.time_source import TimeSource, VirtualTimeSource
+from sentinel_tpu.utils.time_source import TimeSource, VirtualTimeSource, mono_s
 
 
 @dataclass
@@ -478,7 +478,7 @@ class SentinelClient:
         try:
             with self._tick_mutex:
                 self._drain_resolves()
-        except Exception:  # pragma: no cover — surfaced via record log
+        except Exception:  # pragma: no cover — surfaced via record log  # stlint: disable=fail-open — shutdown path: flush is best-effort, no admission decision rides on it
             from sentinel_tpu.utils.record_log import record_log
 
             record_log().warning("resolve flush failed in stop()", exc_info=True)
@@ -586,8 +586,14 @@ class SentinelClient:
         # compile_authority_rules' selection exactly: invalid rules
         # (empty origins) skipped, sketch-id / over-capacity resources
         # skipped, origins capped at KA, LAST rule per resource wins.
-        # (The one remaining divergence — an origin past the intern cap
-        # maps to -1 device-side — is in the lenient direction.)
+        # A rule origin past the intern cap is stored as -1 device-side,
+        # where it matches every UN-INTERNED request origin: under WHITE
+        # the device then passes traffic whose origin string the mirror
+        # would reject, and under BLACK it blocks traffic the mirror would
+        # pass — in both cases the mirror must never be the stricter side,
+        # so any rule carrying a failed-intern origin drops out of the
+        # mirror entirely (never pre-blocks; the device stays
+        # authoritative).  ADVICE r5 medium, case (2).
         KA = self.cfg.authority_origins_per_resource
         auth_host: Dict[str, tuple] = {}
         for r in self.authority_rules.get():
@@ -596,7 +602,15 @@ class SentinelClient:
             rid = self.registry.resource_id(r.resource)
             if rid is None or rid > self.cfg.max_resources:
                 continue
-            auth_host[r.resource] = (frozenset(r.origins()[:KA]), r.strategy)
+            origins = r.origins()[:KA]
+            if any(self.registry.origin_id(o) == -1 for o in origins):
+                # failed intern -> device matches -1 wildcard; mirror
+                # cannot replicate that, so it must not pre-block AND a
+                # later rule must not resurrect a stale entry: last-wins
+                # means this rule's outcome for the resource is "no mirror"
+                auth_host.pop(r.resource, None)
+                continue
+            auth_host[r.resource] = (frozenset(origins), r.strategy)
         self._auth_host_rules = auth_host
         # per-resource hash LANES: each entry hashes up to param_dims
         # distinct argument indices; every rule reads the lane its
@@ -686,7 +700,7 @@ class SentinelClient:
         pair can't commit a stale ruleset for the winning state."""
         with self._cluster_lock:
             self._cluster_degraded_until = (
-                _time.monotonic() + self.cluster_retry_interval_s
+                mono_s() + self.cluster_retry_interval_s
             )
             if not self._cluster_degraded_active:
                 self._cluster_degraded_active = True
@@ -749,7 +763,7 @@ class SentinelClient:
         if frule is None and prule is None:
             return 0, 0
         degraded = self._cluster_degraded_active
-        if degraded and _time.monotonic() < self._cluster_degraded_until:
+        if degraded and mono_s() < self._cluster_degraded_until:
             return 0, 0  # cooling down; local fallback rules enforce
         svc = self.cluster.token_service() if self.cluster is not None else None
         if svc is None:
@@ -761,7 +775,7 @@ class SentinelClient:
         if frule is not None:
             try:
                 r = svc.request_token(frule.cluster_flow_id, count, prioritized)
-            except Exception:
+            except Exception:  # stlint: disable=fail-open — degrade-to-LOCAL: fallback rules recompile into the engine, enforcement continues (fallbackToLocalOrPass)
                 # any service failure degrades, never escapes to the caller
                 # (reference wraps acquisition → fallbackToLocalOrPass)
                 if frule.cluster_fallback_to_local:
@@ -788,7 +802,7 @@ class SentinelClient:
         if prule is not None and param_value is not None:
             try:
                 r = svc.request_param_token(prule.cluster_flow_id, count, [param_value])
-            except Exception:
+            except Exception:  # stlint: disable=fail-open — degrade-to-LOCAL: fallback rules recompile into the engine, enforcement continues
                 self._enter_cluster_degraded()
                 return 0, wait_total
             if r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
@@ -822,7 +836,7 @@ class SentinelClient:
         if frule is None and prule is None:
             return verdicts, waits
         degraded = self._cluster_degraded_active
-        if degraded and _time.monotonic() < self._cluster_degraded_until:
+        if degraded and mono_s() < self._cluster_degraded_until:
             return verdicts, waits
         svc = self.cluster.token_service() if self.cluster is not None else None
         if svc is None:
@@ -834,7 +848,7 @@ class SentinelClient:
             total = sum(item_counts)
             try:
                 r = svc.request_token_batch(frule.cluster_flow_id, total)
-            except Exception:
+            except Exception:  # stlint: disable=fail-open — r=None routes to the degrade-to-LOCAL branch below
                 r = None
             if r is None or r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
                 if frule.cluster_fallback_to_local:
@@ -861,7 +875,7 @@ class SentinelClient:
                     r = svc.request_param_token(
                         prule.cluster_flow_id, total, [param_value]
                     )
-                except Exception:
+                except Exception:  # stlint: disable=fail-open — r=None routes to the degrade-to-LOCAL branch below
                     r = None
                 if r is None or r.status in (CC.STATUS_FAIL, CC.STATUS_TOO_MANY_REQUEST):
                     self._enter_cluster_degraded()
@@ -1424,14 +1438,14 @@ class SentinelClient:
         # event that stop() actually set, not the fresh one.
         interval = self.tick_interval_ms / 1000.0
         while not stop_evt.is_set():
-            t0 = _time.monotonic()
+            t0 = mono_s()
             try:
                 self.tick_once()
-            except Exception:  # pragma: no cover - keep the loop alive
+            except Exception:  # pragma: no cover - keep the loop alive  # stlint: disable=fail-open — a dead tick loop strands EVERY pending future; failure is printed, next tick retries
                 import traceback
 
                 traceback.print_exc()
-            dt = _time.monotonic() - t0
+            dt = mono_s() - t0
             if dt < interval:
                 stop_evt.wait(interval - dt)
 
@@ -1814,7 +1828,7 @@ class SentinelClient:
                     z,
                     z,
                 )
-            jax.block_until_ready(dummy.concurrency)
+            jax.block_until_ready(dummy.concurrency)  # stlint: disable=host-sync — blocks on a THROWAWAY warmup state; threaded mode runs this off-loop
             with self._cluster_lock, self._engine_lock:
                 if (
                     dataclasses.replace(self.cfg, seg_u=new_cfg.seg_u) != new_cfg
@@ -1825,7 +1839,7 @@ class SentinelClient:
                 self.registry.cfg = new_cfg
                 self._tick = new_tick
                 self._seg_over_ticks = 0
-        except Exception:
+        except Exception:  # stlint: disable=fail-open — background compile: on failure serving continues on the old capacity (exact via seg_fallback), logged
             from sentinel_tpu.utils.record_log import record_log
 
             record_log().warning(
@@ -2114,7 +2128,7 @@ class SentinelClient:
             # PCIe latency hiding); resolution happens in _resolve_tick
             try:
                 out.verdict.copy_to_host_async()
-            except Exception:
+            except Exception:  # stlint: disable=fail-open — prefetch hint only; _resolve_tick still reads the verdict synchronously
                 pass
         return p
 
@@ -2148,19 +2162,20 @@ class SentinelClient:
         thread; everything it touches is per-tick (futures, disjoint block
         slices) or lock-protected (drop counters)."""
         out = p.out
+        # stlint: disable-next-line=host-sync — THE designed readback point (see class docstring)
         verdict = np.asarray(out.verdict)
         if p.check_dropped:
             # fail-closed capacity overflow must be LOUD (an engine
             # rejecting traffic because seg_u is undersized is an incident,
             # not a silent counter)
-            dropped = int(np.asarray(out.seg_dropped))
+            dropped = int(np.asarray(out.seg_dropped))  # stlint: disable=host-sync — readback point
             if dropped:
                 self._record_seg_dropped(dropped)
         # the wait column is only nonzero when some verdict is PASS_WAIT
         # (engine zeroes wait for non-passing items) — skip the 4x-larger
         # transfer entirely on the common no-pacing tick
         if bool((verdict == ERR.PASS_WAIT).any()):
-            wait = np.asarray(out.wait_ms)
+            wait = np.asarray(out.wait_ms)  # stlint: disable=host-sync — readback point
         else:
             wait = np.zeros(verdict.shape[0], np.int32)
         if p.inv_a is not None:

@@ -9,7 +9,8 @@ tick inputs, never read inside jit.
 from __future__ import annotations
 
 import os
-import time
+
+from sentinel_tpu.utils.time_source import mono_s
 from typing import Tuple
 
 
@@ -34,7 +35,7 @@ class SystemStatusSampler:
 
     def sample(self) -> Tuple[float, float]:
         """(load_average_1min, process+system cpu usage in [0,1])."""
-        now = time.monotonic()
+        now = mono_s()
         if now - self._last_sample < self._min_interval:
             return self._load, self._cpu
         self._last_sample = now

@@ -17,6 +17,29 @@ from __future__ import annotations
 
 import time
 
+# -- module helpers ----------------------------------------------------------
+# The ONLY sanctioned raw-clock reads outside a TimeSource instance (the
+# stlint time-source pass enforces this structurally).  Deadline/cool-down
+# bookkeeping that deliberately tracks REAL elapsed time even under a
+# VirtualTimeSource (reconnect back-offs, degrade cool-downs, profiling)
+# routes through these, so every clock read in the tree stays greppable
+# from one module and a future cached/virtualized variant needs one edit.
+
+
+def mono_s() -> float:
+    """Monotonic seconds — deadline and back-off arithmetic."""
+    return time.monotonic()
+
+
+def wall_s() -> float:
+    """Wall-clock seconds — heartbeat stamps, second-boundary alignment."""
+    return time.time()
+
+
+def wall_ms_now() -> int:
+    """Wall-clock milliseconds — metric/dashboard timestamps."""
+    return int(time.time() * 1000)
+
 
 class TimeSource:
     """Real wall clock, ms since construction."""

@@ -412,3 +412,86 @@ def test_authority_blocked_request_consumes_no_cluster_token(client_factory):
     with pytest.raises(ERR.AuthorityException):
         app.entry("res-77", origin="stranger")
     assert svc.calls == 2
+
+
+def test_authority_mirror_two_rules_last_wins(client_factory):
+    """ADVICE r5 medium, case (1): two authority rules on one resource —
+    compile_authority_rules must apply TRUE last-wins (zero the origin
+    slots before each write) so the device matches exactly the rule the
+    host mirror keeps, not the union of both rules' origins."""
+    from sentinel_tpu.core.rule_tensors import AUTH_EMPTY, compile_authority_rules
+
+    app = client_factory()
+    rules = [
+        R.AuthorityRule(resource="res-au", limit_app="alpha,beta",
+                        strategy=R.AUTHORITY_WHITE),
+        R.AuthorityRule(resource="res-au", limit_app="gamma",
+                        strategy=R.AUTHORITY_WHITE),
+    ]
+    rid = app.registry.resource_id("res-au")
+    for o in ("alpha", "beta", "gamma"):
+        app.registry.origin_id(o)
+    t = compile_authority_rules(rules, app.cfg, app.registry)
+    live = sorted(int(x) for x in t.origins[rid] if x != AUTH_EMPTY)
+    assert live == [app.registry.origin_id("gamma")], (
+        "first rule's origins must be cleared, not unioned"
+    )
+
+    # behavioral check through the engine: alpha (only in the OVERWRITTEN
+    # rule) must now be rejected, gamma passes — and the mirror agrees,
+    # so neither side opens a device-pass/mirror-block divergence
+    app.authority_rules.load(rules)
+    with pytest.raises(ERR.AuthorityException):
+        app.entry("res-au", origin="alpha")
+    app.entry("res-au", origin="gamma").exit()
+    assert app._authority_pre_blocks("res-au", "alpha") is True
+    assert app._authority_pre_blocks("res-au", "gamma") is False
+
+
+def test_authority_mirror_unintered_origin_never_preblocks(client_factory):
+    """ADVICE r5 medium, case (2): a rule origin past the intern cap is
+    stored as -1 device-side, where it matches every un-interned request
+    origin (device-LENIENT under WHITE).  The host mirror must therefore
+    never pre-block for such a rule — otherwise a WHITE request the
+    device passes would skip _cluster_check, opening an unenforced
+    cluster-limit window."""
+    app = client_factory()
+    # exhaust the origin intern space so the NEXT origin fails to intern
+    app.registry.MAX_ORIGINS = len(app.registry._origin_names) + 1
+    app.registry.origin_id("filler-origin")
+    assert app.registry.origin_id("vip-app") == -1  # past the cap
+
+    app.authority_rules.load(
+        [R.AuthorityRule(resource="res-au2", limit_app="vip-app",
+                         strategy=R.AUTHORITY_WHITE)]
+    )
+    # device side: request origin "someone-else" is also un-interned (-1),
+    # matches the rule's -1 slot -> device passes; the mirror must agree
+    assert app._authority_pre_blocks("res-au2", "someone-else") is False
+    assert app._authority_pre_blocks("res-au2", "vip-app") is False
+    app.entry("res-au2", origin="someone-else").exit()
+
+    # and the cluster token service still gets consulted for that traffic
+    from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+
+    class CountingService(TokenService):
+        def __init__(self):
+            self.calls = 0
+
+        def request_token(self, flow_id, count=1, prioritized=False):
+            self.calls += 1
+            return TokenResult(C.STATUS_OK)
+
+    svc = CountingService()
+
+    class Mgr:
+        def token_service(self):
+            return svc
+
+    app.set_cluster(Mgr())
+    app.flow_rules.load([R.FlowRule(resource="res-au2", count=100.0,
+                                    cluster_mode=True, cluster_flow_id=909)])
+    app.entry("res-au2", origin="someone-else").exit()
+    assert svc.calls == 1, (
+        "mirror pre-blocked device-passing traffic: cluster limit unenforced"
+    )

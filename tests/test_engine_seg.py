@@ -14,6 +14,13 @@ import pytest
 from sentinel_tpu.core.config import small_engine_config
 from tests.test_fused import _tick_once
 
+# Full-tick multi-config equivalence: minutes per test on a 1-core host
+# (eager pallas interpret kernels compile per distinct kernel plan, and
+# the _respawned isolation pays a fresh interpreter + jax import each).
+# Excluded from the tier-1 gate (-m 'not slow'); run explicitly before
+# touching the seg engine:  pytest tests/test_engine_seg.py -m ''
+pytestmark = pytest.mark.slow
+
 _BASELINE_CACHE: dict = {}
 
 

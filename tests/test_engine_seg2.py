@@ -20,6 +20,9 @@ from tests.test_engine_seg import (  # noqa: F401 — shared harness
     _assert_state_equal,
 )
 
+# Same tier-1 exclusion (and reason) as test_engine_seg.py.
+pytestmark = pytest.mark.slow
+
 def test_seg_static_ranks_matches_when_contract_holds():
     """seg_static_ranks=True compiles only the segmented-scan ranks; with
     the contract honored (sorted batches, DIRECT/default-limitApp rules)

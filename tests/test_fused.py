@@ -175,6 +175,7 @@ def _tick_once(cfg, seed=0, sort_batches=False):
     return jax.tree.map(np.asarray, state), outs
 
 
+@pytest.mark.slow  # full-tick equivalence: ~minutes on a 1-core host; see test_engine_seg.py note
 @pytest.mark.parametrize("sketch", [False, True])
 def test_fused_tick_matches_mxu_path(sketch):
     """Full ticks through the fused-effects path must be bit-identical to

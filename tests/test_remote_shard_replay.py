@@ -1,0 +1,137 @@
+"""RemoteShard._rpc_pipeline at-most-once semantics (ADVICE r5 low #3).
+
+A chunk written to a socket that subsequently fails may already have been
+admitted shard-side with its response lost; re-sending it on reconnect
+would double-count admission (and WINDOW=8 pipelining widens the exposure
+to 8 chunks per failure).  These tests drive the pipeline over scripted
+in-memory sockets — no subprocesses, no real network (the wire-level
+shard behavior lives in test_multihost.py).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.parallel.remote_shard import RemoteShard
+
+
+class _ScriptedSocket:
+    """In-memory 'server': every frame sent is decoded and (for the first
+    ``answer_n`` requests) answered PASS; recv raises OSError once the
+    scripted answers run out."""
+
+    def __init__(self, answer_n: int):
+        self.answer_n = answer_n
+        self.requests: List[P.ClusterRequest] = []
+        self._out = b""
+
+    def sendall(self, raw: bytes) -> None:
+        (n,) = struct.unpack(">H", raw[:2])
+        req = P.decode_request(raw[2 : 2 + n])
+        self.requests.append(req)
+        if len(self.requests) <= self.answer_n:
+            k = len(req.params) // 5  # RES_CHECK wire: 5-tuples per item
+            self._out += P.encode_response(
+                P.ClusterResponse(
+                    req.xid,
+                    C.MSG_TYPE_RES_CHECK,
+                    C.STATUS_OK,
+                    items=[(ERR.PASS, 0)] * k,
+                )
+            )
+
+    def recv(self, n: int) -> bytes:
+        if not self._out:
+            raise OSError("scripted failure")
+        chunk, self._out = self._out[:n], self._out[n:]
+        return chunk
+
+    def settimeout(self, t) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class _RecordingFallback:
+    def __init__(self):
+        self.batches: List[List[str]] = []
+
+    def check_batch(self, resources, **kw):
+        self.batches.append(list(resources))
+        return [(ERR.PASS, 0)] * len(resources)
+
+
+def _shard(sockets, fallback=None) -> RemoteShard:
+    s = RemoteShard("scripted", 0, fallback=fallback, retry_interval_s=60.0)
+    s.CHUNK = 4  # small chunks -> several frames per batch
+    it = iter(sockets)
+
+    def connect():
+        nxt = next(it)
+        if isinstance(nxt, Exception):
+            raise nxt
+        return nxt
+
+    s._connect = connect
+    return s
+
+
+def test_written_chunks_are_degraded_not_replayed():
+    """3 chunks pipelined; the server answers one then dies.  The two
+    written-but-unanswered chunks must degrade to the fallback and must
+    NOT be re-sent anywhere — not to the dead socket, not to a fresh
+    connection."""
+    sock = _ScriptedSocket(answer_n=1)
+    fb = _RecordingFallback()
+    shard = _shard([sock], fallback=fb)
+    names = [f"r{i}" for i in range(12)]
+
+    out = shard.check_batch(names)
+
+    assert len(out) == 12 and all(v == ERR.PASS for v, _ in out)
+    # the server saw each chunk exactly once — no replay of the two
+    # possibly-admitted chunks
+    assert len(sock.requests) == 3
+    # and exactly those two spans (r4..r7, r8..r11) degraded locally
+    assert fb.batches == [names[4:8], names[8:12]]
+    # a mid-exchange death that forfeits every remaining chunk arms the
+    # cool-down like an unreachable shard: the next batch fast-degrades
+    # instead of re-paying connect+write+fail and forfeiting again
+    assert shard._down_until > 0.0
+    out2 = shard.check_batch(names[:4])
+    assert len(sock.requests) == 3  # cool-down: wire untouched
+    assert fb.batches[-1] == names[:4]  # degraded locally
+
+
+def test_unwritten_chunks_still_ride_the_reconnect():
+    """A connect failure writes nothing, so every chunk is safe to retry:
+    the single reconnect must serve the whole batch remotely."""
+    good = _ScriptedSocket(answer_n=99)
+    fb = _RecordingFallback()
+    shard = _shard([OSError("connect refused"), good], fallback=fb)
+    names = [f"r{i}" for i in range(8)]
+
+    out = shard.check_batch(names)
+
+    assert len(out) == 8 and all(v == ERR.PASS for v, _ in out)
+    assert len(good.requests) == 2  # both chunks served remotely
+    assert fb.batches == []  # nothing degraded
+
+
+def test_mid_window_failure_without_fallback_fails_open_per_design():
+    """fallback=None: forfeited spans take the documented pass-through
+    degrade (the reference's fallbackToLocalOrPass default), while the
+    answered span keeps its remote verdicts."""
+    sock = _ScriptedSocket(answer_n=1)
+    shard = _shard([sock], fallback=None)
+    names = [f"r{i}" for i in range(8)]
+
+    out = shard.check_batch(names)
+
+    assert len(out) == 8 and all(v == ERR.PASS for v, _ in out)
+    assert len(sock.requests) == 2  # chunk 0 answered, chunk 1 forfeited
