@@ -1,19 +1,25 @@
-"""sentinel_tpu.analysis — AST-based TPU-hazard linter.
+"""sentinel_tpu.analysis — the two-tier TPU-hazard analyzer.
 
-Five passes guard the hot path's correctness discipline structurally
-(fail-open, host-sync, jit-recompile, time-source, unguarded-global);
-see README.md in this directory for the rule set, suppression syntax and
-the baseline-update workflow.
+Tier 1 (this package's ``passes/``): five AST passes over source files
+(fail-open, host-sync, jit-recompile, time-source, unguarded-global).
+Tier 2 (``analysis/jaxpr/``): five semantic passes over the traced
+engine/ops entry points (transfer-guard, dtype-overflow, const-hoist,
+recompile-fingerprint, flops-bytes-budget).  See README.md in this
+directory for the full rule catalog, suppression anchoring, and the
+fingerprint/budget/baseline workflows.
 
 Programmatic surface::
 
     from sentinel_tpu.analysis import run_repo_analysis
-    findings, new = run_repo_analysis()
+    findings, new = run_repo_analysis()          # AST tier
+    from sentinel_tpu.analysis.jaxpr import run_jaxpr_analysis
+    findings = run_jaxpr_analysis()              # jaxpr tier
 
 CLI::
 
-    python -m sentinel_tpu.analysis            # lint sentinel_tpu/, exit 1 on new findings
+    python -m sentinel_tpu.analysis            # BOTH tiers, exit 1 on new findings
     python -m sentinel_tpu.analysis --json     # machine-readable report
+    python -m sentinel_tpu.analysis --sarif    # GitHub-annotation-ready report
 """
 
 from __future__ import annotations
@@ -41,6 +47,16 @@ REPO_ROOT = os.path.dirname(
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json"
 )
+
+
+def rule_catalog() -> dict:
+    """rule id -> one-line description, across BOTH tiers (importing the
+    jaxpr pass classes is cheap; tracing only happens when they run)."""
+    from sentinel_tpu.analysis.jaxpr.passes import ALL_JAXPR_PASSES
+
+    return {
+        p.name: p.description for p in tuple(ALL_PASSES) + tuple(ALL_JAXPR_PASSES)
+    }
 
 
 def run_repo_analysis(

@@ -1,5 +1,12 @@
 """CLI: ``python -m sentinel_tpu.analysis [paths...]``.
 
+Runs BOTH analyzer tiers by default:
+
+* tier 1 — the AST linter over source files (cheap, per-file);
+* tier 2 — the jaxpr semantic analyzer over the traced engine/ops entry
+  points (traces on CPU; repo-global, so it is skipped when explicit
+  paths are given — pass ``--tier jaxpr`` to force it).
+
 Exit status: 0 — no findings beyond the checked-in baseline;
 1 — new findings (print + fail, the CI contract); 2 — usage error.
 """
@@ -19,20 +26,48 @@ from sentinel_tpu.analysis import (
     run_passes,
     save_baseline,
 )
-from sentinel_tpu.analysis.framework import format_json, format_text
+from sentinel_tpu.analysis.framework import (
+    format_json,
+    format_sarif,
+    format_text,
+)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sentinel_tpu.analysis",
-        description="AST-based TPU-hazard linter (see sentinel_tpu/analysis/README.md)",
+        description=(
+            "TPU-hazard analyzer: AST linter + jaxpr semantic tier "
+            "(see sentinel_tpu/analysis/README.md)"
+        ),
     )
     ap.add_argument(
         "paths",
         nargs="*",
-        help="files/directories to lint (default: the sentinel_tpu package)",
+        help=(
+            "files/directories for the AST tier (default: the sentinel_tpu "
+            "package).  Explicit paths imply --tier ast: the jaxpr tier is "
+            "repo-global, not per-file."
+        ),
     )
     ap.add_argument("--json", action="store_true", help="JSON report on stdout")
+    ap.add_argument(
+        "--sarif",
+        action="store_true",
+        help=(
+            "SARIF 2.1.0 report on stdout (GitHub code scanning renders "
+            "NEW findings as inline PR annotations)"
+        ),
+    )
+    ap.add_argument(
+        "--tier",
+        choices=("ast", "jaxpr", "both"),
+        default=None,
+        help=(
+            "which analyzer tier(s) to run (default: both without explicit "
+            "paths, ast with them)"
+        ),
+    )
     ap.add_argument(
         "--baseline",
         default=DEFAULT_BASELINE,
@@ -49,24 +84,91 @@ def main(argv=None) -> int:
         help="rewrite the baseline to accept the current findings and exit 0",
     )
     ap.add_argument(
+        "--update-fingerprints",
+        action="store_true",
+        help=(
+            "re-trace the entry points and rewrite the golden jaxpr "
+            "signatures (sentinel_tpu/analysis/jaxpr/fingerprints.json); "
+            "commit the diff when the traced-program change is intended"
+        ),
+    )
+    ap.add_argument(
+        "--update-budgets",
+        action="store_true",
+        help=(
+            "re-baseline the per-entry flops/bytes ceilings "
+            "(sentinel_tpu/analysis/jaxpr/budgets.json) at measured+25%%"
+        ),
+    )
+    ap.add_argument(
         "--rules",
         default="",
-        help="comma-separated pass names to run (default: all five)",
+        help="comma-separated pass names to run (default: all, both tiers)",
     )
     args = ap.parse_args(argv)
 
-    passes = list(ALL_PASSES)
+    if args.json and args.sarif:
+        print("--json and --sarif are mutually exclusive", file=sys.stderr)
+        return 2
+
+    # -- golden updates (tier-2 maintenance verbs) --------------------------
+    if args.update_fingerprints or args.update_budgets:
+        from sentinel_tpu.analysis import jaxpr as J
+
+        if args.update_fingerprints:
+            n = J.update_fingerprints()
+            print(f"fingerprints updated: {n} entry point(s) -> {J.FINGERPRINTS_PATH}")
+        if args.update_budgets:
+            n = J.update_budgets()
+            print(f"budgets updated: {n} entry point(s) -> {J.BUDGETS_PATH}")
+        return 0
+
+    tier = args.tier or ("ast" if args.paths else "both")
+
+    # -- pass selection (both tiers share the --rules namespace) ------------
+    ast_passes = list(ALL_PASSES)
+    jaxpr_passes = None  # None = all (resolved lazily: importing them is free,
+    # but building the entry list costs a trace)
     if args.rules:
+        from sentinel_tpu.analysis.jaxpr.passes import ALL_JAXPR_PASSES
+
         wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
-        unknown = wanted - {p.name for p in ALL_PASSES}
+        known = {p.name for p in ALL_PASSES} | {p.name for p in ALL_JAXPR_PASSES}
+        unknown = wanted - known
         if unknown:
             print(
                 f"unknown rule(s): {', '.join(sorted(unknown))} "
-                f"(have: {', '.join(p.name for p in ALL_PASSES)})",
+                f"(have: {', '.join(sorted(known))})",
                 file=sys.stderr,
             )
             return 2
-        passes = [p for p in ALL_PASSES if p.name in wanted]
+        ast_passes = [p for p in ALL_PASSES if p.name in wanted]
+        jaxpr_passes = [p for p in ALL_JAXPR_PASSES if p.name in wanted]
+        # a --rules list naming only one tier's passes narrows the run to
+        # that tier (running the other with zero passes is wasted tracing)
+        if not jaxpr_passes and tier == "both":
+            tier = "ast"
+        if not ast_passes and tier == "both":
+            tier = "jaxpr"
+        # ...and a selection that leaves the effective tier with ZERO
+        # passes must not masquerade as a clean run (exit 0 with nothing
+        # executed): `--rules const-hoist some_file.py` pins the tier to
+        # ast (explicit paths) while naming only jaxpr rules — usage error
+        if tier == "ast" and not ast_passes:
+            print(
+                f"--rules {args.rules}: no AST-tier pass selected, but the "
+                "run is pinned to the ast tier (explicit paths or --tier "
+                "ast); jaxpr rules need `--tier jaxpr` without paths",
+                file=sys.stderr,
+            )
+            return 2
+        if tier == "jaxpr" and not jaxpr_passes:
+            print(
+                f"--rules {args.rules}: no jaxpr-tier pass selected, but "
+                "--tier jaxpr was requested",
+                file=sys.stderr,
+            )
+            return 2
 
     roots = args.paths or [os.path.join(REPO_ROOT, "sentinel_tpu")]
     for r in roots:
@@ -74,20 +176,62 @@ def main(argv=None) -> int:
             print(f"no such path: {r}", file=sys.stderr)
             return 2
 
-    findings = run_passes(roots, passes, rel_to=REPO_ROOT)
+    findings = []
+    if tier in ("ast", "both"):
+        findings.extend(run_passes(roots, ast_passes, rel_to=REPO_ROOT))
+    if tier in ("jaxpr", "both"):
+        from sentinel_tpu.analysis.jaxpr import run_jaxpr_analysis
+
+        findings.extend(run_jaxpr_analysis(passes=jaxpr_passes))
 
     if args.update_baseline:
-        save_baseline(args.baseline, findings)
+        # a SCOPED update (explicit paths / one tier / a --rules subset)
+        # re-measures only part of the repo; baseline entries outside that
+        # scope were not re-measured and must survive the rewrite, or the
+        # next full run reports previously-accepted debt as NEW
+        wanted_rules = (
+            {r.strip() for r in args.rules.split(",") if r.strip()}
+            if args.rules
+            else None
+        )
+        rel_roots = [
+            os.path.relpath(r, REPO_ROOT).replace(os.sep, "/") for r in roots
+        ]
+
+        def _in_scope(key: str) -> bool:
+            rule, _, path = key.partition(":")
+            if wanted_rules is not None and rule not in wanted_rules:
+                return False
+            if path.startswith("jaxpr://"):
+                return tier in ("jaxpr", "both")
+            if tier == "jaxpr":
+                return False
+            return any(
+                rr in (".", "") or path == rr or path.startswith(rr + "/")
+                for rr in rel_roots
+            )
+
+        existing = load_baseline(args.baseline)
+        keep = {k: v for k, v in existing.items() if not _in_scope(k)}
+        save_baseline(args.baseline, findings, keep=keep)
         print(
-            f"baseline updated: {len(findings)} accepted finding(s) -> "
-            f"{args.baseline}"
+            f"baseline updated: {len(findings)} accepted finding(s) "
+            f"(+{len(keep)} out-of-scope entr{'y' if len(keep) == 1 else 'ies'} "
+            f"preserved) -> {args.baseline}"
         )
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new = new_findings(findings, baseline)
 
-    out = format_json(findings, new) if args.json else format_text(findings, new)
+    if args.sarif:
+        from sentinel_tpu.analysis import rule_catalog
+
+        out = format_sarif(findings, new, rule_catalog())
+    elif args.json:
+        out = format_json(findings, new)
+    else:
+        out = format_text(findings, new)
     print(out)
     return 1 if new else 0
 

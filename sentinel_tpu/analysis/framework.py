@@ -60,11 +60,20 @@ class Finding:
     col: int
     message: str
     severity: str = ERROR
+    #: last source line the finding's anchor statement spans.  Suppression
+    #: comments anywhere in [line, end_line] apply — a trailing comment on
+    #: the closing paren of a multi-line call, or on the decorator above a
+    #: flagged def, suppresses the finding even though the AST node's
+    #: lineno points elsewhere.  0 means "just `line`".
+    end_line: int = 0
 
     def key(self) -> Tuple[str, str]:
         """Baseline bucket — line numbers drift across edits, so the
         baseline matches on (rule, path) counts, not exact positions."""
         return (self.rule, self.path)
+
+    def span(self) -> Tuple[int, int]:
+        return (self.line, max(self.line, self.end_line))
 
 
 @dataclass
@@ -80,11 +89,18 @@ class ParsedModule:
     #: rules suppressed for the entire file ('*' = all)
     file_disables: Set[str] = field(default_factory=set)
 
-    def suppressed(self, rule: str, line: int) -> bool:
+    def suppressed(self, rule: str, line: int, end_line: int = 0) -> bool:
+        """True when ``rule`` is disabled on any line of the anchor span
+        [line, max(line, end_line)] — multi-line statements accept the
+        directive on any of their physical lines (the trailing comment
+        naturally lands on the closing paren, not the first line)."""
         if "*" in self.file_disables or rule in self.file_disables:
             return True
-        at = self.line_disables.get(line, ())
-        return "*" in at or rule in at
+        for ln in range(line, max(line, end_line) + 1):
+            at = self.line_disables.get(ln, ())
+            if "*" in at or rule in at:
+                return True
+        return False
 
 
 class Pass:
@@ -106,14 +122,40 @@ class Pass:
         message: str,
         severity: Optional[str] = None,
     ) -> Finding:
+        line, end_line = anchor_span(node)
         return Finding(
             rule=self.name,
             path=mod.path,
-            line=getattr(node, "lineno", 1),
+            line=line,
             col=getattr(node, "col_offset", 0),
             message=message,
             severity=severity or self.severity,
+            end_line=end_line,
         )
+
+
+def anchor_span(node: ast.AST) -> Tuple[int, int]:
+    """Physical-line span a suppression directive may sit on for a finding
+    anchored at ``node``.
+
+    - plain statements/expressions: every line of the node (a trailing
+      ``# stlint: disable=`` on the closing paren of a multi-line call
+      counts);
+    - compound statements (def/if/with/try/for/...): the HEADER only —
+      a directive inside the body belongs to the body statement it sits
+      on, not to the whole block;
+    - decorated defs: decorator lines are part of the header (the
+      decorator is usually what the finding is about, e.g. ``@jax.jit``).
+    """
+    start = getattr(node, "lineno", 1)
+    end = getattr(node, "end_lineno", None) or start
+    body = getattr(node, "body", None)
+    if isinstance(body, list) and body and hasattr(body[0], "lineno"):
+        end = max(start, body[0].lineno - 1)
+    decorators = getattr(node, "decorator_list", None) or ()
+    for d in decorators:
+        start = min(start, getattr(d, "lineno", start))
+    return start, end
 
 
 # -- suppression comments ----------------------------------------------------
@@ -219,7 +261,7 @@ def run_passes(
                 continue
             for p in passes:
                 for f in p.run(mod):
-                    if not mod.suppressed(f.rule, f.line):
+                    if not mod.suppressed(f.rule, *f.span()):
                         findings.append(f)
     findings.sort(
         key=lambda f: (_SEV_ORDER.get(f.severity, 9), f.path, f.line, f.rule)
@@ -248,7 +290,21 @@ def load_baseline(path: str) -> Dict[str, int]:
     return {str(k): int(v) for k, v in counts.items()}
 
 
-def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+def save_baseline(
+    path: str,
+    findings: Iterable[Finding],
+    keep: Optional[Dict[str, int]] = None,
+) -> None:
+    """Write the baseline from ``findings``, preserving ``keep`` entries.
+
+    ``keep`` carries accepted counts OUTSIDE the current run's scope — a
+    scoped run (explicit paths, one tier, a --rules subset) must not
+    silently delete debt it never re-measured (the CLI computes the
+    out-of-scope set; see __main__)."""
+    accepted = baseline_counts(findings)
+    for k, v in (keep or {}).items():
+        if k not in accepted:
+            accepted[k] = v
     data = {
         "comment": (
             "Accepted pre-existing findings per 'rule:path'.  Regenerate "
@@ -256,7 +312,7 @@ def save_baseline(path: str, findings: Iterable[Finding]) -> None:
             "commit the diff ONLY after reviewing why each new entry "
             "cannot be fixed or suppressed inline with a rationale."
         ),
-        "accepted": dict(sorted(baseline_counts(findings).items())),
+        "accepted": dict(sorted(accepted.items())),
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2, sort_keys=False)
@@ -298,6 +354,81 @@ def format_text(findings: Sequence[Finding], new: Sequence[Finding]) -> str:
         f"-- {len(findings)} finding(s), {len(new)} new vs baseline"
     )
     return "\n".join(lines)
+
+
+def format_sarif(
+    findings: Sequence[Finding],
+    new: Sequence[Finding],
+    rule_descriptions: Optional[Dict[str, str]] = None,
+) -> str:
+    """SARIF 2.1.0 report — GitHub code scanning renders these as inline
+    PR annotations.  Only NEW findings (beyond the baseline) are emitted:
+    accepted debt must not re-annotate every PR that touches the file."""
+    rule_descriptions = rule_descriptions or {}
+    rules_seen: List[str] = []
+    for f in new:
+        if f.rule not in rules_seen:
+            rules_seen.append(f.rule)
+
+    def _location(f: Finding) -> Dict:
+        # repo-relative file paths resolve against SRCROOT; jaxpr-tier
+        # whole-program findings carry a jaxpr:// pseudo-path, which is a
+        # valid ABSOLUTE URI (scheme + path) and per SARIF 2.1.0 must NOT
+        # combine with uriBaseId (that applies to relative references only)
+        art = {"uri": f.path}
+        if "://" not in f.path:
+            art["uriBaseId"] = "SRCROOT"
+        return {
+            "physicalLocation": {
+                "artifactLocation": art,
+                "region": {
+                    "startLine": max(f.line, 1),
+                    "startColumn": f.col + 1,
+                },
+            }
+        }
+
+    sarif = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "stlint",
+                        # informationUri must be an absolute URI per the
+                        # SARIF schema, so the repo-relative README path
+                        # lives in the rule help text instead
+                        "rules": [
+                            {
+                                "id": r,
+                                "shortDescription": {
+                                    "text": rule_descriptions.get(r, r)
+                                },
+                                "help": {
+                                    "text": "see sentinel_tpu/analysis/README.md"
+                                },
+                            }
+                            for r in rules_seen
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error" if f.severity == ERROR else "warning",
+                        "message": {"text": f.message},
+                        "locations": [_location(f)],
+                    }
+                    for f in new
+                ],
+            }
+        ],
+    }
+    return json.dumps(sarif, indent=2)
 
 
 def format_json(findings: Sequence[Finding], new: Sequence[Finding]) -> str:

@@ -1,0 +1,120 @@
+"""sentinel_tpu.analysis.jaxpr — the semantic (tier-2) analyzer.
+
+Tier 1 (the AST linter, `sentinel_tpu.analysis.passes`) reads source;
+this tier traces the REAL engine/ops entry points to ClosedJaxprs under
+canonical configs on CPU and runs five passes over the equations:
+
+* ``transfer-guard``       — no callback/infeed/placement primitives
+  inside tick programs (host round-trips cap throughput at callback
+  latency);
+* ``dtype-overflow``       — i32 timestamp lineage must not be scaled
+  or accumulated past int32 wrap (taint analysis with net scale
+  factors);
+* ``const-hoist``          — no module-level device-array consts hoisted
+  into jaxprs (the rowmin/rank/segment "numpy scalar, NOT jnp" hazard,
+  enforced structurally instead of by comment);
+* ``recompile-fingerprint``— golden hashes of each entry's traced
+  program; silent program drift fails CI;
+* ``flops-bytes-budget``   — XLA cost_analysis ceilings per entry.
+
+Programmatic surface::
+
+    from sentinel_tpu.analysis.jaxpr import run_jaxpr_analysis
+    findings = run_jaxpr_analysis()
+
+Importing this package is cheap; tracing happens on first use and is
+cached per process.  See sentinel_tpu/analysis/README.md for rule IDs,
+the fingerprint/budget update workflow, and suppression rules.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from sentinel_tpu.analysis.framework import Finding
+from sentinel_tpu.analysis.jaxpr.framework import (  # noqa: F401
+    BUDGETS_PATH,
+    FINGERPRINTS_PATH,
+    JaxprPass,
+    TracedEntry,
+    entry_signature,
+    load_golden,
+    run_jaxpr_passes,
+    save_golden,
+)
+
+
+def jaxpr_passes():
+    from sentinel_tpu.analysis.jaxpr.passes import ALL_JAXPR_PASSES
+
+    return ALL_JAXPR_PASSES
+
+
+def run_jaxpr_analysis(
+    passes: Optional[Sequence[JaxprPass]] = None,
+    entries: Optional[Sequence[TracedEntry]] = None,
+) -> List[Finding]:
+    """Trace the canonical entry points (cached per process) and run the
+    jaxpr passes; returns findings (tier-1 ``# stlint:`` suppressions on
+    source-anchored findings already honored)."""
+    from sentinel_tpu.analysis import REPO_ROOT
+    from sentinel_tpu.analysis.jaxpr.entrypoints import trace_entries
+
+    if entries is None:
+        entries = trace_entries()
+    if passes is None:
+        passes = jaxpr_passes()
+    return run_jaxpr_passes(entries, passes, REPO_ROOT)
+
+
+def update_fingerprints(path: str = FINGERPRINTS_PATH) -> int:
+    """Regenerate the golden program signatures; returns entry count."""
+    import jax
+
+    from sentinel_tpu.analysis.jaxpr.entrypoints import trace_entries
+
+    entries = trace_entries()
+    data = {
+        "comment": (
+            "Golden jaxpr signatures per entry point.  Regenerate with "
+            "`python -m sentinel_tpu.analysis --update-fingerprints` and "
+            "commit ONLY when the traced-program change is the point of "
+            "the PR (see analysis/README.md)."
+        ),
+        "jax_version": jax.__version__,
+        "entries": {e.name: entry_signature(e) for e in entries},
+    }
+    save_golden(path, data)
+    return len(entries)
+
+
+def update_budgets(path: str = BUDGETS_PATH) -> int:
+    """Re-baseline the cost ceilings at measured*(1+HEADROOM); returns
+    the number of budgeted entries."""
+    import jax
+
+    from sentinel_tpu.analysis.jaxpr.entrypoints import trace_entries
+    from sentinel_tpu.analysis.jaxpr.passes.cost_budget import HEADROOM
+
+    entries = [e for e in trace_entries() if e.cost_eligible and e.cost]
+    data = {
+        "comment": (
+            "XLA cost_analysis ceilings per entry point, written at "
+            f"measured*{1 + HEADROOM:g} by --update-budgets.  A PR that "
+            "breaches a ceiling either optimizes or re-baselines WITH a "
+            "justification in the PR description."
+        ),
+        "jax_version": jax.__version__,
+        "headroom": HEADROOM,
+        "entries": {
+            e.name: {
+                "flops": round(e.cost["flops"] * (1 + HEADROOM)),
+                "bytes": round(e.cost["bytes"] * (1 + HEADROOM)),
+                "measured_flops": round(e.cost["flops"]),
+                "measured_bytes": round(e.cost["bytes"]),
+            }
+            for e in entries
+        },
+    }
+    save_golden(path, data)
+    return len(entries)

@@ -1,0 +1,30 @@
+"""The five jaxpr-tier hazard passes.
+
+Each runs over :class:`~sentinel_tpu.analysis.jaxpr.framework.TracedEntry`
+objects built by entrypoints.py; ``ALL_JAXPR_PASSES`` is the CI set.
+"""
+
+from __future__ import annotations
+
+from sentinel_tpu.analysis.jaxpr.passes.const_hoist import ConstHoistPass
+from sentinel_tpu.analysis.jaxpr.passes.cost_budget import CostBudgetPass
+from sentinel_tpu.analysis.jaxpr.passes.dtype_overflow import DtypeOverflowPass
+from sentinel_tpu.analysis.jaxpr.passes.fingerprint import FingerprintPass
+from sentinel_tpu.analysis.jaxpr.passes.transfer_guard import TransferGuardPass
+
+ALL_JAXPR_PASSES = (
+    TransferGuardPass(),
+    DtypeOverflowPass(),
+    ConstHoistPass(),
+    FingerprintPass(),
+    CostBudgetPass(),
+)
+
+__all__ = [
+    "ALL_JAXPR_PASSES",
+    "ConstHoistPass",
+    "CostBudgetPass",
+    "DtypeOverflowPass",
+    "FingerprintPass",
+    "TransferGuardPass",
+]

@@ -1,0 +1,88 @@
+"""recompile-fingerprint: the traced program must not change silently.
+
+Golden hashes of each entry point's jaxpr SIGNATURE (normalized
+equation stream + in/out avals, see framework.entry_signature) are
+checked in at `sentinel_tpu/analysis/jaxpr/fingerprints.json`.  A diff
+that changes what `tick` traces to — a weak-type drift flipping an aval
+from `i32[]` to `i32[]*` (one extra executable specialization per call
+site), an accidental static-arg explosion, a new branch that doubles
+the compiled program — fails CI HERE, at PR time, instead of surfacing
+as a mystery recompile storm in the next BENCH round.
+
+The contract is "change deliberately": when the program diff IS the
+point of the PR, regenerate with
+
+    python -m sentinel_tpu.analysis --update-fingerprints
+
+and commit the new hashes; the git diff of fingerprints.json is the
+reviewable record that the compiled program changed.
+
+Hashes are tracer-version-sensitive (a jax upgrade can legitimately
+re-shape jaxprs); the golden file records the jax version it was built
+under, and a mismatch is named in the finding so the reviewer knows
+whether to suspect the diff or the toolchain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+from sentinel_tpu.analysis.framework import ERROR, Finding
+from sentinel_tpu.analysis.jaxpr.framework import (
+    FINGERPRINTS_PATH,
+    JaxprPass,
+    TracedEntry,
+    entry_signature,
+    load_golden,
+)
+
+
+class FingerprintPass(JaxprPass):
+    name = "recompile-fingerprint"
+    description = "traced program signatures must match the checked-in goldens"
+    severity = ERROR
+
+    def __init__(self, golden_path: str = FINGERPRINTS_PATH):
+        self.golden_path = golden_path
+        self._golden: Optional[Dict[str, Any]] = None
+
+    def _load(self) -> Dict[str, Any]:
+        if self._golden is None:
+            self._golden = load_golden(self.golden_path)
+        return self._golden
+
+    def run(self, entry: TracedEntry) -> Iterable[Finding]:
+        import jax
+
+        golden = self._load()
+        entries = golden.get("entries", {})
+        want = entries.get(entry.name)
+        got = entry_signature(entry)
+        if want is None:
+            yield self.finding(
+                entry,
+                "no golden fingerprint checked in for this entry point — "
+                "run `python -m sentinel_tpu.analysis --update-fingerprints` "
+                "and commit fingerprints.json",
+            )
+            return
+        if want.get("hash") == got["hash"]:
+            return
+        ver_note = ""
+        golden_ver = golden.get("jax_version")
+        if golden_ver and golden_ver != jax.__version__:
+            ver_note = (
+                f" (NOTE: goldens were built under jax {golden_ver}, this is "
+                f"{jax.__version__} — the tracer itself may have moved; "
+                "regenerate and review)"
+            )
+        yield self.finding(
+            entry,
+            f"traced program changed: signature {want.get('hash')} -> "
+            f"{got['hash']} ({want.get('eqns')} -> {got['eqns']} eqns, "
+            f"{want.get('invars')} -> {got['invars']} invars){ver_note}.  "
+            "If this program change is intended, regenerate with "
+            "--update-fingerprints and commit the diff; otherwise the PR "
+            "re-shapes the compiled admission path unintentionally "
+            "(weak-type drift / static-arg change / new traced branch)",
+        )

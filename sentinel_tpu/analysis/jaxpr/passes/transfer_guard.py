@@ -1,0 +1,58 @@
+"""transfer-guard: no host round-trips inside a traced tick program.
+
+The admission path's whole performance model is "one dispatch, zero
+host↔device syncs per tick" (SURVEY.md §4.1): timestamps and system load
+enter as explicit tensor inputs, verdicts leave as tensors, and the one
+designed readback point lives OUTSIDE the jitted program
+(`_resolve_tick`).  A `pure_callback`/`io_callback` smuggled into tick
+code — usually via an innocent-looking helper that calls back to Python
+— serializes every batch on a host trip and silently caps throughput at
+callback latency.  The AST tier can't see these when the callback enters
+through a library wrapper; the jaxpr names the primitive directly.
+
+Flagged primitives: the callback family (`pure_callback`, `io_callback`,
+`debug_callback`, anything containing "callback"), `infeed`/`outfeed`,
+and `device_put` (a placement op inside a traced program — the operand
+should have been an input or a trace-time constant).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from sentinel_tpu.analysis.framework import ERROR, Finding
+from sentinel_tpu.analysis.jaxpr.framework import (
+    JaxprPass,
+    TracedEntry,
+    eqn_source,
+    walk_eqns,
+)
+
+_EXACT = frozenset({"infeed", "outfeed", "device_put", "copy_to_host_async"})
+
+
+def _repo_root() -> str:
+    from sentinel_tpu.analysis import REPO_ROOT
+
+    return REPO_ROOT
+
+
+class TransferGuardPass(JaxprPass):
+    name = "transfer-guard"
+    description = "no callback/infeed/placement primitives inside tick jaxprs"
+    severity = ERROR
+
+    def run(self, entry: TracedEntry) -> Iterable[Finding]:
+        root = _repo_root()
+        for eqn in walk_eqns(entry.closed_jaxpr):
+            pname = eqn.primitive.name
+            if "callback" in pname or pname in _EXACT:
+                yield self.finding(
+                    entry,
+                    f"primitive '{pname}' in the traced program — the tick "
+                    "must stay free of host round-trips; pass data as "
+                    "explicit inputs (timestamps, sys load) or move the "
+                    "readback outside the jitted program (_resolve_tick is "
+                    "THE designed sync point)",
+                    source=eqn_source(eqn, root),
+                )

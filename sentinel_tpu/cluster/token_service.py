@@ -42,6 +42,18 @@ from sentinel_tpu.core import rules as R
 from sentinel_tpu.utils.host_window import HostWindow
 
 
+#: engine stages the cluster token decision path exercises: flow checks
+#: (with occupy-ahead for prioritized SHOULD_WAIT grants) and hot-param
+#: token checks.  The decision client's resources are interned flowIds —
+#: no ctx/origin node fan-out, no circuit breakers, no authority/system
+#: rules ever bind to them, so a dedicated decision engine compiled with
+#: exactly this set serves token verdicts with the minimal tick.  The
+#: jaxpr analyzer (sentinel_tpu/analysis/jaxpr) traces `ops.engine.tick`
+#: under this feature set as its `tick/cluster-token` entry point, so
+#: CI pins the compiled token-decision program alongside the local ones.
+DECISION_FEATURES = frozenset({"flow", "occupy", "param"})
+
+
 @dataclass
 class TokenResult:
     status: int

@@ -25,7 +25,7 @@ import jax
 import numpy as np
 import jax.numpy as jnp
 
-_NEG = np.float32(-3.0e38)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
+_NEG = np.float32(-3.0e38)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist.  Enforced structurally by the jaxpr analyzer's const-hoist pass (sentinel_tpu/analysis/jaxpr)
 
 
 def fast_cumsum(v: jax.Array) -> jax.Array:

@@ -33,7 +33,7 @@ from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.ops import tables as T
 
 #: int32 bit pattern above any valid positive float's bits
-_ABSENT = np.int32(0x7F000000)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
+_ABSENT = np.int32(0x7F000000)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist.  Enforced structurally by the jaxpr analyzer's const-hoist pass (sentinel_tpu/analysis/jaxpr)
 
 
 def min_heads(

@@ -48,8 +48,8 @@ import jax.numpy as jnp
 #: segment length so digit-plane sums stay exact (see module docstring)
 BLOCK = 256
 
-_INT_MIN = np.int32(-(2**31) + 1)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
-_INT_MAX = np.int32(2**31 - 1)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist
+_INT_MIN = np.int32(-(2**31) + 1)  # numpy scalar, NOT jnp: a module-level device array becomes a hoisted jaxpr const (extra executable parameter) and this jaxlib's dispatch fastpath drops consts when sibling cfg-variant executables coexist.  Enforced structurally by the jaxpr analyzer's const-hoist pass (sentinel_tpu/analysis/jaxpr)
+_INT_MAX = np.int32(2**31 - 1)  # numpy scalar, NOT jnp: same hazard class; see _INT_MIN above and analysis/jaxpr/passes/const_hoist.py
 
 
 class SegCtx(NamedTuple):

@@ -60,7 +60,9 @@ def _mod(source: str, path: str = "sentinel_tpu/runtime/client.py") -> ParsedMod
 
 
 def _run(p, mod):
-    return [f for f in p.run(mod) if not mod.suppressed(f.rule, f.line)]
+    # mirrors the runner's filter (framework.run_passes): the suppression
+    # check covers the finding's whole anchor span, not just line 1 of it
+    return [f for f in p.run(mod) if not mod.suppressed(f.rule, *f.span())]
 
 
 # ---------------------------------------------------------------------------
@@ -377,6 +379,74 @@ def test_unguarded_global_catches_global_rebind():
     assert len(got) == 1 and "rebound" in got[0].message
 
 
+def test_unguarded_global_lockset_mismatch_reports_both_sites():
+    """Lock PRESENCE is not enough: writes under _LOCK_A and _LOCK_B
+    both 'hold a lock' but serialize against nothing.  Every site of the
+    disjoint lockset is reported, each naming the other."""
+    mod = _mod(
+        """
+        import threading
+
+        _CACHE = {}
+        _LOCK_A = threading.Lock()
+        _LOCK_B = threading.Lock()
+
+        def put(k, v):
+            with _LOCK_A:
+                _CACHE[k] = v
+
+        def evict(k):
+            with _LOCK_B:
+                _CACHE.pop(k, None)
+        """
+    )
+    got = _run(UnguardedGlobalPass(), mod)
+    assert len(got) == 2
+    assert all("disjoint locksets" in f.message for f in got)
+    # each site names the other's lock
+    assert "_LOCK_B" in got[0].message and "_LOCK_A" in got[1].message
+
+
+def test_unguarded_global_consistent_lock_and_nesting_are_clean():
+    mod = _mod(
+        """
+        import threading
+
+        _CACHE = {}
+        _LOCK = threading.Lock()
+        _OTHER = threading.Lock()
+
+        def put(k, v):
+            with _LOCK:
+                _CACHE[k] = v
+
+        def evict(k):
+            with _OTHER:
+                with _LOCK:          # nested: _LOCK still held
+                    _CACHE.pop(k, None)
+        """
+    )
+    assert _run(UnguardedGlobalPass(), mod) == []
+
+
+def test_unguarded_global_single_guarded_site_never_mismatches():
+    """One guarded site has nothing to be inconsistent WITH — the
+    lockset check needs two sites."""
+    mod = _mod(
+        """
+        import threading
+
+        _CACHE = {}
+        _only_lock = threading.Lock()
+
+        def put(k, v):
+            with _only_lock:
+                _CACHE[k] = v
+        """
+    )
+    assert _run(UnguardedGlobalPass(), mod) == []
+
+
 # ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
@@ -413,6 +483,102 @@ def test_suppression_shares_comment_with_noqa():
         """
     )
     assert _run(TimeSourcePass(), mod) == []
+
+
+def test_suppression_anchors_on_multiline_statement_tail():
+    """A trailing directive naturally lands on the CLOSING line of a
+    multi-line statement; the finding anchors on the first line.  The
+    anchor span must cover the whole statement."""
+    mod = _mod(
+        """
+        import time
+
+        def f():
+            return time.time(
+            )  # stlint: disable=time-source — fixture: multi-line call
+        """
+    )
+    assert _run(TimeSourcePass(), mod) == []
+    # ... and a directive on a line BELOW the statement does nothing
+    unrelated = _mod(
+        """
+        import time
+
+        def f():
+            t = time.time()
+            # stlint: disable=time-source
+            return t
+        """
+    )
+    assert len(_run(TimeSourcePass(), unrelated)) == 1
+
+
+def test_suppression_anchors_on_decorator_and_def_line():
+    """For findings anchored at a decorated def, the directive works on
+    the decorator line (where the @jax.jit that makes it hazardous
+    lives) AND on the def line — both are the statement's header."""
+    from sentinel_tpu.analysis.framework import Pass
+
+    class DefPass(Pass):
+        name = "def-probe"
+
+        def run(self, mod):
+            import ast as _ast
+
+            for node in _ast.walk(mod.tree):
+                if isinstance(node, _ast.FunctionDef):
+                    yield self.finding(mod, node, "probe")
+
+    on_decorator = _mod(
+        """
+        import functools
+
+        @functools.cache  # stlint: disable=def-probe — fixture
+        def f():
+            return 1
+        """
+    )
+    assert _run(DefPass(), on_decorator) == []
+
+    on_def = _mod(
+        """
+        import functools
+
+        @functools.cache
+        def f():  # stlint: disable=def-probe — fixture
+            return 1
+        """
+    )
+    assert _run(DefPass(), on_def) == []
+
+    in_body = _mod(
+        """
+        import functools
+
+        @functools.cache
+        def f():
+            return 1  # stlint: disable=def-probe — body lines are NOT the header
+        """
+    )
+    assert len(_run(DefPass(), in_body)) == 1
+
+
+def test_suppression_span_does_not_leak_across_statements():
+    """The span of statement N must not swallow a directive intended
+    for statement N+1 sharing the same line region."""
+    mod = _mod(
+        """
+        import time
+
+        def f():
+            a = time.time()
+            # stlint: disable-next-line=time-source — only the SECOND read
+            b = time.time()
+            return a + b
+        """
+    )
+    got = _run(TimeSourcePass(), mod)
+    assert len(got) == 1 and got[0].line == 5
 
 
 # ---------------------------------------------------------------------------
@@ -462,6 +628,150 @@ def test_cli_exit_codes(tmp_path):
         timeout=300,
     )
     assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_cli_sarif_output(tmp_path):
+    """--sarif: valid SARIF 2.1.0 with NEW findings as results (the
+    GitHub code-scanning inline-annotation contract); exit code still 1."""
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+    bad = tmp_path / "sentinel_tpu" / "runtime"
+    bad.mkdir(parents=True)
+    snippet = bad / "client.py"
+    snippet.write_text("import time\n\ndef f():\n    return time.time()\n")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "sentinel_tpu.analysis", str(snippet), "--sarif"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=120,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    sarif = json.loads(r.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "stlint"
+    results = run["results"]
+    assert len(results) == 1
+    assert results[0]["ruleId"] == "time-source"
+    assert results[0]["level"] == "error"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 4
+    # the rule metadata block names every rule that fired
+    assert [ru["id"] for ru in run["tool"]["driver"]["rules"]] == ["time-source"]
+
+    # --sarif and --json are mutually exclusive (usage error)
+    r2 = subprocess.run(
+        [
+            sys.executable, "-m", "sentinel_tpu.analysis", str(snippet),
+            "--sarif", "--json",
+        ],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r2.returncode == 2
+
+
+def test_unguarded_global_call_rooted_lock_still_counts():
+    """A lock reached through a call has no stable dotted name but must
+    still count as a held lock (pre-lockset behavior) — not a false
+    'without the owning lock' error."""
+    mod = _mod(
+        """
+        _CACHE = {}
+
+        def put(reg, k, v):
+            with reg().lock:
+                _CACHE[k] = v
+        """
+    )
+    assert _run(UnguardedGlobalPass(), mod) == []
+
+
+def test_cli_zero_pass_selection_is_usage_error(tmp_path):
+    """--rules naming only the OTHER tier's passes must exit 2, not
+    masquerade as a clean run with zero passes executed."""
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+    snippet = tmp_path / "probe.py"
+    snippet.write_text("import time\n\ndef f():\n    return time.time()\n")
+    # explicit path pins tier=ast; const-hoist is jaxpr-tier only
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "sentinel_tpu.analysis",
+            str(snippet), "--rules", "const-hoist",
+        ],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "no AST-tier pass selected" in r.stderr
+
+
+def test_scoped_update_baseline_preserves_out_of_scope_debt(tmp_path):
+    """--update-baseline on a SCOPED run (explicit path) re-measures only
+    that scope; accepted entries elsewhere must survive the rewrite or
+    the next full run reports old debt as NEW."""
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+    tree = tmp_path / "sentinel_tpu" / "runtime"
+    tree.mkdir(parents=True)
+    a = tree / "a.py"
+    b = tree / "b.py"
+    a.write_text("import time\n\ndef f():\n    return time.time()\n")
+    b.write_text("import time\n\ndef g():\n    return time.time()\n")
+    base = tmp_path / "baseline.json"
+
+    # accept both files' debt
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "sentinel_tpu.analysis", str(a), str(b),
+            "--baseline", str(base), "--update-baseline",
+        ],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    accepted = json.loads(base.read_text())["accepted"]
+    assert len(accepted) == 2
+
+    # re-update scoped to a.py only: b.py's entry must be preserved
+    r2 = subprocess.run(
+        [
+            sys.executable, "-m", "sentinel_tpu.analysis", str(a),
+            "--baseline", str(base), "--update-baseline",
+        ],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert json.loads(base.read_text())["accepted"] == accepted
+
+    # the full (two-path) run still sees nothing new
+    r3 = subprocess.run(
+        [
+            sys.executable, "-m", "sentinel_tpu.analysis", str(a), str(b),
+            "--baseline", str(base),
+        ],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r3.returncode == 0, r3.stdout + r3.stderr
+
+
+def test_rule_catalog_spans_both_tiers():
+    """The CLI's SARIF rule metadata and the README catalog are driven
+    by rule_catalog(); it must name the AST rules AND the jaxpr rules
+    (importing the tier-2 pass classes must NOT trigger a trace)."""
+    from sentinel_tpu.analysis import rule_catalog
+
+    cat = rule_catalog()
+    assert {
+        "fail-open",
+        "host-sync",
+        "jit-recompile",
+        "time-source",
+        "unguarded-global",
+        "transfer-guard",
+        "dtype-overflow",
+        "const-hoist",
+        "recompile-fingerprint",
+        "flops-bytes-budget",
+    } <= set(cat)
+    assert all(desc for desc in cat.values())
 
 
 def test_cli_update_baseline_roundtrip(tmp_path):
