@@ -449,6 +449,16 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
     # servers; here the loop is driven manually)
     c._warm_shapes()
 
+    # per-stage decomposition of req_p99_ms via the obs span tracer
+    # (assemble / presort / dispatch / device / readback / resolve): the
+    # tracer is enabled only for the measured run so warmup ticks don't
+    # pollute the percentiles.  Overhead is ~6 clock reads + ring stores
+    # per tick — noise against a >10 ms device tick.
+    from sentinel_tpu import obs
+
+    obs.TRACER.reset()
+    obs.enable()
+
     import threading
 
     feed_lock = threading.Lock()
@@ -487,6 +497,10 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
     while state["done"] < n_blocks:
         c.tick_once()
     wall = time.perf_counter() - t0
+    obs.disable()
+    # {stage: {count, p50_ms, p99_ms, ...}} — decomposes req_p99_ms into
+    # where each millisecond goes (BENCH_r0N consumers read this directly)
+    stage_breakdown = obs.summarize(obs.TRACER.snapshot(), prefix="tick.")
 
     # transport decomposition: per-tick bytes actually uploaded (constant
     # columns ride the device-resident cache) + verdict readback — through
@@ -511,6 +525,7 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
         "req_p99_ms": round(float(lat_ms[int(len(lat_ms) * 0.99)]), 1),
         "pipeline_depth": depth,
         "host_build_ms_avg": round(c.host_build_ms_avg, 2),
+        "stage_breakdown_ms": stage_breakdown,
         "transport_mb_per_tick": round(up_mb + down_mb, 2),
         "transport_bound_note": (
             "measured through the TPU tunnel (~10 MB/s effective): batch "

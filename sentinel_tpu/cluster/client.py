@@ -21,7 +21,19 @@ from typing import Any, Dict, List, Optional
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.obs import trace as OT
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.utils.time_source import mono_s
+
+_H_RPC = _OBS.histogram(
+    "sentinel_cluster_rpc_ms",
+    "token-server request/response round-trip (successful responses only; "
+    "failures count in sentinel_cluster_rpc_failures_total)",
+)
+_C_RPC_FAIL = _OBS.counter(
+    "sentinel_cluster_rpc_failures_total",
+    "token-server round-trips that degraded (transport failure or timeout)",
+)
 
 #: sentinel returned by _roundtrip for requests that can never be encoded
 #: (oversized params) — a client-side problem, NOT a server failure, so it
@@ -147,11 +159,13 @@ class ClusterTokenClient(TokenService):
 
     def _roundtrip(self, req: P.ClusterRequest) -> Optional[P.ClusterResponse]:
         if not self._ensure_connected():
+            _C_RPC_FAIL.inc()
             return None
         try:
             raw = P.encode_request(req)
         except (ValueError, struct.error):
             return _BAD_REQUEST  # unencodable request; connection is fine
+        _t = OT.t0()
         f: Future = Future()
         self._pending[req.xid] = f
         try:
@@ -163,12 +177,30 @@ class ClusterTokenClient(TokenService):
         except OSError:
             self._pending.pop(req.xid, None)
             self._teardown()
+            _C_RPC_FAIL.inc()
+            if _t:
+                # failures skip the latency histogram (a timeout-ceiling
+                # sample would corrupt the success-path percentiles; the
+                # failure RATE lives in _C_RPC_FAIL) — the span keeps the
+                # duration for trace-level diagnosis
+                OT.stage("cluster.rpc", _t, attrs={"type": req.type, "ok": False})
             return None
         try:
-            return f.result(timeout=self.timeout_ms / 1000.0)
+            rsp = f.result(timeout=self.timeout_ms / 1000.0)
         except (_FutTimeout, CancelledError):
             self._pending.pop(req.xid, None)
+            _C_RPC_FAIL.inc()
+            if _t:
+                OT.stage("cluster.rpc", _t, attrs={"type": req.type, "ok": False})
             return None  # -> STATUS_FAIL at the TokenService surface (degrade, never PASS)
+        if rsp is None:
+            _C_RPC_FAIL.inc()  # connection died mid-wait (_teardown resolved us)
+        if _t:
+            OT.stage(
+                "cluster.rpc", _t, _H_RPC if rsp is not None else None,
+                attrs={"type": req.type, "ok": rsp is not None},
+            )
+        return rsp
 
     # -- TokenService --------------------------------------------------------
 

@@ -39,7 +39,21 @@ from sentinel_tpu.cluster.rules import (
 )
 from sentinel_tpu.core import errors as ERR
 from sentinel_tpu.core import rules as R
+from sentinel_tpu.obs import trace as OT
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.utils.host_window import HostWindow
+
+_H_DECISION = _OBS.histogram(
+    "sentinel_token_decision_ms",
+    "engine-backed token decision latency (request to verdict)",
+)
+_C_DECISIONS = _OBS.counter(
+    "sentinel_token_decisions_total", "token verdicts served by this process"
+)
+_C_SHED = _OBS.counter(
+    "sentinel_token_shed_total",
+    "token requests shed before the engine (namespace guard or backpressure)",
+)
 
 
 #: engine stages the cluster token decision path exercises: flow checks
@@ -294,6 +308,7 @@ class DefaultTokenService(TokenService):
             return done
         ns = self.flow_rules.namespace_of(flow_id) or C.DEFAULT_NAMESPACE
         if not self.limiter.try_pass(ns, self.client.time.now_ms()):
+            _C_SHED.inc()
             done.set_result(TokenResult(C.STATUS_TOO_MANY_REQUEST))
             return done
         # backpressure: with the thread-free TCP path nothing else bounds
@@ -301,16 +316,30 @@ class DefaultTokenService(TokenService):
         # few engine batches (the reference's namespace guard plays this
         # role only when configured tightly)
         if self.client.pending_acquires() > 4 * self.client.cfg.batch_size:
+            _C_SHED.inc()
             done.set_result(TokenResult(C.STATUS_TOO_MANY_REQUEST))
             return done
         f = self.client.submit_acquire(
             flow_resource(flow_id), count=count, prioritized=prioritized
         )
         if f is None:
+            _C_DECISIONS.inc()  # fast-path verdict is still a served decision
             done.set_result(TokenResult(C.STATUS_OK))
             return done
+        # cross-thread span: begun here, ended on the resolver/tick thread
+        # that fires the engine future (the explicit begin/end API's job)
+        _span = OT.TRACER.begin("token.decision", flow_id=flow_id)
 
         def _chain(fut):
+            _C_DECISIONS.inc()
+            if _span is not None:
+                OT.stage_ns(
+                    "token.decision",
+                    _span.t0_ns,
+                    OT.now_ns() - _span.t0_ns,
+                    _H_DECISION,
+                    attrs=_span.attrs,
+                )
             try:
                 verdict, wait_ms = fut.result()
             except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
@@ -337,8 +366,11 @@ class DefaultTokenService(TokenService):
             return TokenResult(C.STATUS_BAD_REQUEST)
         ns = self.flow_rules.namespace_of(flow_id) or C.DEFAULT_NAMESPACE
         if not self.limiter.try_pass(ns, self.client.time.now_ms()):
+            _C_SHED.inc()
             return TokenResult(C.STATUS_TOO_MANY_REQUEST)
-        results = self.client.check_batch([flow_resource(flow_id)] * units)
+        with OT.TRACER.span("token.decision_batch", flow_id=flow_id, units=units):
+            results = self.client.check_batch([flow_resource(flow_id)] * units)
+        _C_DECISIONS.inc(units)
         granted = sum(1 for v, _ in results if v in (ERR.PASS, ERR.PASS_WAIT))
         wait = max((w for v, w in results if v == ERR.PASS_WAIT), default=0)
         if granted == 0:
@@ -353,13 +385,16 @@ class DefaultTokenService(TokenService):
             return TokenResult(C.STATUS_BAD_REQUEST)
         ns = self.param_rules.namespace_of(flow_id) or C.DEFAULT_NAMESPACE
         if not self.limiter.try_pass(ns, self.client.time.now_ms()):
+            _C_SHED.inc()
             return TokenResult(C.STATUS_TOO_MANY_REQUEST)
         name = param_resource(flow_id)
-        results = self.client.check_batch(
-            [name] * len(params),
-            counts=[count] * len(params),
-            params=list(params),
-        )
+        with OT.TRACER.span("token.decision_param", flow_id=flow_id):
+            results = self.client.check_batch(
+                [name] * len(params),
+                counts=[count] * len(params),
+                params=list(params),
+            )
+        _C_DECISIONS.inc(len(params))
         if all(v == ERR.PASS for v, _ in results):
             return TokenResult(C.STATUS_OK)
         return TokenResult(C.STATUS_BLOCKED)

@@ -98,6 +98,17 @@ class SentinelApiClient:
                 continue
         return out
 
+    def fetch_prometheus(self, ip: str, port: int) -> str:
+        """``GET /metrics`` — the machine's obs-registry exposition
+        (Prometheus text format); raw text so the dashboard can re-serve
+        or parse it."""
+        return self._get(ip, port, "metrics")
+
+    def fetch_traces(self, ip: str, port: int) -> dict:
+        """``GET /api/traces`` — the machine's span ring as Chrome-trace
+        JSON (Perfetto-loadable; ``obs.load_spans`` parses it)."""
+        return json.loads(self._get(ip, port, "api/traces"))
+
     def fetch_json_tree(self, ip: str, port: int) -> dict:
         return json.loads(self._get(ip, port, "jsonTree"))
 

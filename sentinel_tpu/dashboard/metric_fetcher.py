@@ -84,6 +84,22 @@ class MetricFetcher:
                     self._last_fetched_ms[m.key] = end
         return saved
 
+    def scrape_prometheus(self, app: Optional[str] = None) -> Dict[str, str]:
+        """One sweep of every healthy machine's ``GET /metrics`` — the
+        obs-plane exposition (tick-stage histograms, pipeline occupancy,
+        degrade state) keyed by machine, alongside the metric-log poll.
+        Unreachable machines are skipped (counted in ``fetch_fail``)."""
+        out: Dict[str, str] = {}
+        apps = [app] if app is not None else self.discovery.apps()
+        for a in apps:
+            for m in self.discovery.machines(a, only_healthy=True):
+                try:
+                    out[m.key] = self.api.fetch_prometheus(m.ip, m.port)
+                    self.fetch_ok += 1
+                except OSError:
+                    self.fetch_fail += 1
+        return out
+
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:

@@ -10,7 +10,24 @@ when no extension is registered the hot path pays one truthiness check.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
+from sentinel_tpu.utils.time_source import mono_s
+
+#: swallowed extension exceptions, visible on /metrics — a throwing user
+#: extension must never corrupt engine accounting, but it must not fail
+#: SILENTLY either (the counter keeps climbing even while logs are
+#: rate-limited)
+_C_EXT_ERRORS = _OBS.counter(
+    "sentinel_extension_errors_total",
+    "metric-extension callbacks that raised and were swallowed",
+)
+
+#: seconds between record-log warnings per (extension class, hook) — a
+#: hot-path extension failing on EVERY pass would otherwise write the
+#: log at traffic rate
+_WARN_INTERVAL_S = 10.0
 
 
 class MetricExtension:
@@ -41,6 +58,9 @@ class MetricExtension:
 
 _lock = threading.Lock()
 _extensions: List[MetricExtension] = []
+# (ext class name, hook) -> (last warning stamp, failures since that log);
+# all writes under _lock (the module's one owning lock)
+_warn_state: Dict[Tuple[str, str], Tuple[float, int]] = {}
 
 
 def register_extension(ext: MetricExtension) -> None:
@@ -69,7 +89,14 @@ def get_extensions() -> List[MetricExtension]:
 
 def safe_dispatch(hook: str, *args) -> None:
     """Invoke one hook on every registered extension, isolating failures —
-    a throwing user extension must never corrupt engine accounting."""
+    a throwing user extension must never corrupt engine accounting.
+
+    Every swallowed exception increments
+    ``sentinel_extension_errors_total``; the record-log warning is
+    rate-limited to one per (extension class, hook) per
+    ``_WARN_INTERVAL_S`` and carries the count of failures the limiter
+    suppressed since the previous log, so a persistently-failing
+    extension stays VISIBLE without writing the log at traffic rate."""
     exts = _extensions
     if not exts:
         return
@@ -77,6 +104,23 @@ def safe_dispatch(hook: str, *args) -> None:
         try:
             getattr(x, hook)(*args)
         except Exception:  # noqa: BLE001
-            from sentinel_tpu.utils.record_log import record_log
+            _C_EXT_ERRORS.inc()
+            key = (type(x).__name__, hook)
+            now = mono_s()
+            with _lock:
+                last, suppressed = _warn_state.get(key, (-1e18, 0))
+                if now - last >= _WARN_INTERVAL_S:
+                    _warn_state[key] = (now, 0)
+                    do_log, since = True, suppressed
+                else:
+                    _warn_state[key] = (last, suppressed + 1)
+                    do_log, since = False, 0
+            if do_log:
+                from sentinel_tpu.utils.record_log import record_log
 
-            record_log().exception("metric extension %s.%s failed", type(x).__name__, hook)
+                record_log().exception(
+                    "metric extension %s.%s failed (+%d more failures "
+                    "suppressed in the last %.0fs; total on "
+                    "sentinel_extension_errors_total)",
+                    key[0], hook, since, _WARN_INTERVAL_S,
+                )

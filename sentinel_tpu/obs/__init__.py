@@ -1,0 +1,81 @@
+"""sentinel_tpu.obs — the observability plane.
+
+Two always-importable, dependency-light pieces:
+
+* ``obs.trace``    — lock-light fixed-capacity span tracer (ring buffer,
+  Chrome-trace/Perfetto export, optional jax.profiler passthrough);
+* ``obs.registry`` — counters / gauges / power-of-two latency histograms
+  with Prometheus text exposition.
+
+Instrumented subsystems (runtime tick stages, engine compile events,
+cluster RPC + degrade transitions, remote-shard chunks) record through
+the process-global ``TRACER`` and ``REGISTRY``; the command center
+serves them at ``GET /metrics`` and ``GET /api/traces``; the CLI
+(``python -m sentinel_tpu.obs``) dumps and summarizes trace rings.
+
+Tracing defaults OFF: call ``obs.enable()`` (or set ``SENTINEL_TRACE=1``)
+to start recording.  Disabled-mode cost at every instrumented call site
+is a single flag check — no allocation, no formatting, no clock read.
+"""
+
+from __future__ import annotations
+
+from sentinel_tpu.obs.registry import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+)
+from sentinel_tpu.obs.trace import (
+    TRACER,
+    SpanTracer,
+    event,
+    load_spans,
+    now_ns,
+    stage,
+    stage_ns,
+    summarize,
+    t0,
+)
+
+
+def enable(jax_annotations: bool = False) -> None:
+    """Turn span recording on (optionally mirroring spans into
+    ``jax.profiler.TraceAnnotation`` so they land in XLA device traces)."""
+    TRACER.enable(jax_annotations=jax_annotations)
+
+
+def disable() -> None:
+    TRACER.disable()
+
+
+def enabled() -> bool:
+    return TRACER.enabled
+
+
+def span(name: str, trace: int = 0, **attrs):
+    """Context-manager span on the default tracer (no-op when disabled)."""
+    return TRACER.span(name, trace, **attrs)
+
+
+__all__ = [
+    "REGISTRY",
+    "TRACER",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "SpanTracer",
+    "enable",
+    "disable",
+    "enabled",
+    "event",
+    "load_spans",
+    "now_ns",
+    "span",
+    "stage",
+    "stage_ns",
+    "summarize",
+    "t0",
+]

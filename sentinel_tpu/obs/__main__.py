@@ -1,0 +1,164 @@
+"""CLI: dump / summarize a span-trace ring.
+
+Usage:
+
+    python -m sentinel_tpu.obs --summary [trace.json]
+    python -m sentinel_tpu.obs --chrome out.json [trace.json]
+    python -m sentinel_tpu.obs --json [trace.json]
+
+With a ``trace.json`` argument (a Chrome-trace file from ``GET
+/api/traces`` or ``SpanTracer.dump``) the CLI reads it; with no input it
+performs a SELF-CAPTURE: runs a small ``SentinelClient`` on the
+fast-path engine configuration with ``pipeline_depth > 0`` (CPU,
+interpret-mode kernels, eager — semantics only) with tracing enabled,
+then reports from the live ring.  ``--summary`` prints per-stage
+count / p50 / p99 / mean for every traced stage — the six tick stages
+(``tick.assemble``/``presort``/``dispatch``/``device``/``readback``/
+``resolve``) decompose where each millisecond of a decision goes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from sentinel_tpu.obs import trace as OT
+
+#: the six pipelined tick stages every capture should surface
+TICK_STAGES = (
+    "tick.assemble",
+    "tick.presort",
+    "tick.dispatch",
+    "tick.device",
+    "tick.readback",
+    "tick.resolve",
+)
+
+
+def _self_capture(n_blocks: int = 4, block: int = 64) -> List[dict]:
+    """Run a tiny SentinelClient workload with tracing on; return spans.
+
+    Forces the CPU backend (this is a semantics/shape capture, not a
+    performance run) and eager kernels — the same harness the fast-path
+    tests use — so the capture works identically on a laptop and on a
+    TPU host.  pipeline_depth > 0 exercises the resolver pool, so device
+    /readback/resolve spans come from resolver threads while assemble/
+    presort/dispatch come from the submitting thread — the cross-thread
+    trace-id correlation the explicit begin/end API exists for.
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    cfg = small_engine_config(
+        use_mxu_tables=True,
+        fused_effects=True,
+        seg_effects=True,
+        flow_rules_per_resource=1,
+        degrade_rules_per_resource=1,
+        param_rules_per_resource=1,
+    )
+    was_enabled = OT.TRACER.enabled
+    OT.TRACER.enable()
+    try:
+        with jax.disable_jit():
+            c = SentinelClient(cfg=cfg, mode="sync", pipeline_depth=2)
+            c.start()
+            try:
+                names = [f"cli-res-{i}" for i in range(8)]
+                ids = np.asarray([c.registry.resource_id(n) for n in names], np.int32)
+                c.flow_rules.load([FlowRule(resource=n, count=1000.0) for n in names])
+                rng = np.random.default_rng(0)
+                for _ in range(n_blocks):
+                    res = ids[rng.integers(0, len(ids), block)].astype(np.int32)
+                    fut = c.submit_block(res)
+                    c.submit_completion_block(
+                        res, np.abs(rng.normal(2.0, 1.0, block)).astype(np.float32)
+                    )
+                    if fut is not None:
+                        fut.result(timeout=60.0)
+            finally:
+                c.stop()
+    finally:
+        if not was_enabled:
+            OT.TRACER.disable()
+    return OT.TRACER.snapshot()
+
+
+def _print_summary(spans: List[dict], out=sys.stdout) -> None:
+    summ = OT.summarize(spans)
+    if not summ:
+        print("no spans recorded", file=out)
+        return
+    w = max(len(n) for n in summ) + 2
+    print(
+        f"{'stage'.ljust(w)}{'count':>8}{'p50 ms':>12}{'p99 ms':>12}"
+        f"{'mean ms':>12}{'total ms':>12}",
+        file=out,
+    )
+    for name, s in summ.items():
+        print(
+            f"{name.ljust(w)}{s['count']:>8}{s['p50_ms']:>12.3f}"
+            f"{s['p99_ms']:>12.3f}{s['mean_ms']:>12.3f}{s['total_ms']:>12.3f}",
+            file=out,
+        )
+    missing = [n for n in TICK_STAGES if n not in summ]
+    if missing:
+        print(f"(tick stages absent from this trace: {', '.join(missing)})", file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sentinel_tpu.obs",
+        description="dump / summarize a sentinel-tpu span trace",
+    )
+    ap.add_argument(
+        "input",
+        nargs="?",
+        help="chrome-trace JSON (from /api/traces or SpanTracer.dump); "
+        "omitted => self-capture a SentinelClient run",
+    )
+    ap.add_argument(
+        "--summary", action="store_true", help="per-stage count/p50/p99 table"
+    )
+    ap.add_argument("--chrome", metavar="OUT", help="write Chrome-trace JSON to OUT")
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json", help="summary as JSON"
+    )
+    ap.add_argument(
+        "--blocks", type=int, default=4, help="self-capture: blocks to submit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.input:
+        spans = OT.load_spans(args.input)
+    else:
+        spans = _self_capture(n_blocks=max(1, args.blocks))
+
+    did = False
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(OT.TRACER.chrome_trace(spans), f)
+        print(f"wrote {args.chrome} ({len(spans)} spans)")
+        did = True
+    if args.as_json:
+        print(json.dumps(OT.summarize(spans), indent=2))
+        did = True
+    if args.summary or not did:
+        _print_summary(spans)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

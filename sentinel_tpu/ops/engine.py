@@ -36,6 +36,8 @@ import jax.numpy as jnp
 
 from sentinel_tpu.core import rule_tensors as RT
 from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.obs import trace as OT
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.core.errors import (
     BLOCK_AUTHORITY,
     BLOCK_DEGRADE,
@@ -2381,7 +2383,39 @@ def compile_ruleset(
     exhausted, promotion failed) compile into the tail threshold tables;
     other grades/behaviors on tail resources cannot be enforced and log a
     warning."""
+    # materialize BEFORE anything reads them: callers may pass one-shot
+    # iterables, and a drained generator here would silently compile an
+    # empty (fail-open) ruleset
     flow_rules = list(flow_rules)
+    degrade_rules = list(degrade_rules)
+    param_rules = list(param_rules)
+    _span = OT.TRACER.begin(
+        "engine.compile_ruleset",
+        flow=len(flow_rules),
+        degrade=len(degrade_rules),
+        param=len(param_rules),
+    )
+    # span ends in finally: a rule push that raises mid-compile (device
+    # OOM, malformed rule) is exactly the slow event worth seeing traced
+    try:
+        return _compile_ruleset(
+            cfg, registry, flow_rules, degrade_rules, param_rules,
+            authority_rules, system_rules, param_lanes,
+        )
+    finally:
+        OT.TRACER.end(_span)
+
+
+def _compile_ruleset(
+    cfg: EngineConfig,
+    registry,
+    flow_rules,
+    degrade_rules,
+    param_rules,
+    authority_rules,
+    system_rules,
+    param_lanes,
+) -> RuleSet:
     tail = []
     exact_flow = []
     for r in flow_rules:
@@ -2417,9 +2451,9 @@ def compile_ruleset(
             exact_flow.append(r)
     rs = RuleSet(
         flow=RT.compile_flow_rules(exact_flow, cfg, registry),
-        degrade=RT.compile_degrade_rules(list(degrade_rules), cfg, registry),
+        degrade=RT.compile_degrade_rules(degrade_rules, cfg, registry),
         param=RT.compile_param_rules(
-            list(param_rules), cfg, registry, lanes=param_lanes
+            param_rules, cfg, registry, lanes=param_lanes
         ),
         auth=RT.compile_authority_rules(list(authority_rules), cfg, registry),
         system=RT.compile_system_rules(list(system_rules), cfg),
@@ -2521,6 +2555,13 @@ def migrate_state(
 _TICK_CACHE: dict = {}
 _TICK_CACHE_LOCK = threading.Lock()
 
+#: distinct compiled-tick builds this process created (each is a future
+#: XLA compile; a climbing count in steady state means config churn)
+_C_TICK_BUILDS = _OBS.counter(
+    "sentinel_engine_tick_builds_total",
+    "distinct (config, features) tick callables built (each = one XLA compile)",
+)
+
 
 def make_tick(
     cfg: EngineConfig,
@@ -2551,4 +2592,11 @@ def make_tick(
             if jit:
                 fn = jax.jit(fn, donate_argnums=(0,) if donate else ())
             _TICK_CACHE[key] = fn
+            # a fresh tick build is a hot-swap/recompile PRECURSOR worth
+            # seeing in traces: the XLA compile itself lands at first call
+            _C_TICK_BUILDS.inc()
+            OT.event(
+                "engine.make_tick",
+                attrs={"features": ",".join(sorted(features)), "seg_u": cfg.seg_u},
+            )
     return fn

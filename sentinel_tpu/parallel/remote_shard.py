@@ -25,8 +25,22 @@ from typing import Any, List, Optional, Sequence, Tuple
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.obs import trace as OT
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.utils.time_source import mono_s
 from sentinel_tpu.utils.record_log import record_log
+
+_H_CHUNK = _OBS.histogram(
+    "sentinel_shard_chunk_ms",
+    "remote-shard chunk write-to-response latency (pipelined window)",
+)
+_C_CHUNKS = _OBS.counter(
+    "sentinel_shard_chunks_total", "remote-shard RES_CHECK chunks answered"
+)
+_C_CHUNKS_DEGRADED = _OBS.counter(
+    "sentinel_shard_chunks_degraded_total",
+    "remote-shard chunks that fell back locally (unreachable / forfeited / unencodable)",
+)
 
 
 class RemoteShard:
@@ -132,6 +146,7 @@ class RemoteShard:
             ):
                 out.extend((int(v), int(w)) for v, w in rsp.items)
             else:
+                _C_CHUNKS_DEGRADED.inc()
                 # degrade THIS span: local fallback rules, else fail-open
                 if self.fallback is not None:
                     out.extend(
@@ -217,6 +232,7 @@ class RemoteShard:
                 # chunks written to THIS attempt's socket; on failure they
                 # are forfeited (degraded), not retried — see docstring
                 inflight: List[int] = []
+                t_sent: dict = {}  # chunk idx -> send stamp (tracing only)
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
@@ -227,15 +243,31 @@ class RemoteShard:
                         # count as written BEFORE sendall: a mid-write
                         # failure may still deliver a parseable frame
                         inflight.append(i)
+                        _t = OT.t0()
+                        if _t:
+                            t_sent[i] = _t
                         s.sendall(wires[i])
                     while inflight:
                         rsp = self._read_response(s)
                         i = inflight.pop(0)
                         rsps[i] = rsp
+                        _C_CHUNKS.inc()
+                        _t = t_sent.pop(i, 0)
+                        if _t:
+                            # write→response of one pipelined chunk: the
+                            # send-ahead WINDOW means later chunks' spans
+                            # include queueing behind earlier ones
+                            OT.stage(
+                                "shard.chunk", _t, _H_CHUNK,
+                                attrs={"chunk": i, "inflight": len(inflight)},
+                            )
                         pending.remove(i)
                         if queue:
                             j = queue.pop(0)
                             inflight.append(j)
+                            _t = OT.t0()
+                            if _t:
+                                t_sent[j] = _t
                             s.sendall(wires[j])
                     return rsps
                 except OSError:

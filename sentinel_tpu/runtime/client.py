@@ -53,11 +53,66 @@ from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.core.rule_tensors import hash_param
 from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import window as W
+from sentinel_tpu.obs import trace as OT
+from sentinel_tpu.obs.registry import REGISTRY as OBS
 from sentinel_tpu.runtime import context as CTX
 from sentinel_tpu.runtime.registry import Registry
 from sentinel_tpu.metrics import extension as MEXT
 from sentinel_tpu.utils.system_status import SystemStatusSampler
 from sentinel_tpu.utils.time_source import TimeSource, VirtualTimeSource, mono_s
+
+# -- observability plane (obs/): per-stage tick histograms, pipeline
+# gauges, and incident counters.  Stage HISTOGRAMS update only while
+# tracing is enabled (OT.t0() truthiness is the hot path's single flag
+# check); pipeline gauges (one float store) and incident counters (seg
+# drops, degrade transitions — rare) update unconditionally so the
+# always-on /metrics surface is trustworthy even untraced.
+_H_ASSEMBLE = OBS.histogram(
+    "sentinel_tick_assemble_ms", "host batch assembly (columns + uploads) per tick"
+)
+_H_PRESORT = OBS.histogram(
+    "sentinel_tick_presort_ms", "host segment-key presort (np.lexsort + permute) per tick"
+)
+_H_DISPATCH = OBS.histogram(
+    "sentinel_tick_dispatch_ms", "engine tick dispatch (async jit call) per tick"
+)
+_H_DEVICE = OBS.histogram(
+    "sentinel_tick_device_ms",
+    "dispatch to verdicts-host-visible per tick (device compute + transfer; "
+    "includes pipeline queue wait)",
+)
+_H_READBACK = OBS.histogram(
+    "sentinel_tick_readback_ms", "verdict/wait/drop-count device-to-host reads per tick"
+)
+_H_RESOLVE = OBS.histogram(
+    "sentinel_tick_resolve_ms", "verdict fan-out (futures, blocks, front doors) per tick"
+)
+_G_OCCUPANCY = OBS.gauge(
+    "sentinel_pipeline_occupancy", "dispatched-but-unresolved engine ticks"
+)
+_G_RESOLVER_Q = OBS.gauge(
+    "sentinel_resolver_queue_depth", "in-flight resolver-pool readbacks"
+)
+_C_SEG_DROPPED = OBS.counter(
+    "sentinel_seg_dropped_total",
+    "items whose effects a seg_fallback=False engine dropped on capacity overflow",
+)
+_G_DEGRADED = OBS.gauge(
+    "sentinel_cluster_degraded", "1 while cluster enforcement is degraded to local rules"
+)
+_C_DEGRADE_ENTER = OBS.counter(
+    "sentinel_cluster_degrade_transitions_total",
+    "cluster degrade state transitions",
+    labels={"transition": "enter"},
+)
+_C_DEGRADE_EXIT = OBS.counter(
+    "sentinel_cluster_degrade_transitions_total",
+    "cluster degrade state transitions",
+    labels={"transition": "exit"},
+)
+_C_SEG_RESIZE = OBS.counter(
+    "sentinel_seg_resizes_total", "seg_u capacity grow-and-hot-swap events"
+)
 
 
 @dataclass
@@ -133,6 +188,8 @@ class _PendingTick:
     check_dropped: bool
     n_obj: int  # object-request count (blocks start here)
     n_blk: int  # block item count (fronts start at n_obj + n_blk)
+    tick_id: int = 0  # obs trace correlation id (0 = tracing disabled)
+    dispatched_ns: int = 0  # obs: dispatch-complete stamp for the device span
 
 
 class Entry:
@@ -531,7 +588,8 @@ class SentinelClient:
         # engine — except while degraded, when fallback-enabled cluster rules
         # are compiled in as local rules (fallbackToLocalOrPass semantics)
         with self._cluster_lock:
-            self._recompile_rules_locked()
+            with OT.TRACER.span("client.recompile_rules"):
+                self._recompile_rules_locked()
 
     def _recompile_rules_locked(self) -> None:
         flow = self.flow_rules.get()
@@ -704,12 +762,18 @@ class SentinelClient:
             )
             if not self._cluster_degraded_active:
                 self._cluster_degraded_active = True
+                _C_DEGRADE_ENTER.inc()
+                _G_DEGRADED.set(1)
+                OT.event("cluster.degrade.enter")
                 self._recompile_rules()
 
     def _exit_cluster_degraded(self) -> None:
         with self._cluster_lock:
             if self._cluster_degraded_active:
                 self._cluster_degraded_active = False
+                _C_DEGRADE_EXIT.inc()
+                _G_DEGRADED.set(0)
+                OT.event("cluster.degrade.exit")
                 self._recompile_rules()
 
     def _authority_pre_blocks(self, resource: str, origin: str) -> bool:
@@ -1566,6 +1630,10 @@ class SentinelClient:
                 blocks=blocks,
             )
             self._pending_ticks.append(pending)
+            # unconditional: the gauges are on the always-on /metrics
+            # surface (one float store each — cheaper than the flag test
+            # dance would be worth)
+            _G_OCCUPANCY.set(len(self._pending_ticks))
             with self._lock:
                 more = (
                     bool(self._acquires)
@@ -1601,6 +1669,7 @@ class SentinelClient:
                             "tick resolution failed: %r", exc, exc_info=exc
                         )
                 self._resolve_futs = alive
+            _G_RESOLVER_Q.set(len(self._resolve_futs))
             if not more:
                 # wait out in-flight resolutions; their callbacks may
                 # enqueue new work (closed-loop callers) — re-check
@@ -1642,37 +1711,42 @@ class SentinelClient:
         new_cfg = dataclasses.replace(self.cfg, **changes)
         if new_cfg == self.cfg:
             return
-        new_tick = E.make_tick(new_cfg, donate=True, features=self._features)
-        # pre-compile BOTH batch shapes against a throwaway state while the
-        # old engine keeps serving: XLA compiles take seconds, and a window
-        # whose budget migrated would legitimately EXPIRE during that gap —
-        # compiling first makes the actual swap a few ms of migration math
-        z = jnp.float32(0.0)
-        dummy = E.init_state(new_cfg)
-        for bs in {min(256, new_cfg.batch_size), new_cfg.batch_size}:
-            dummy, _ = new_tick(
-                dummy,
-                self._rules_dev,
-                E.empty_acquire(new_cfg, b=bs),
-                E.empty_complete(
-                    new_cfg, b=min(bs, new_cfg.complete_batch_size)
-                ),
-                jnp.int32(self.time.now_ms()),
-                z,
-                z,
-            )
-        jax.block_until_ready(dummy.concurrency)
-        with self._engine_lock:
-            old_cfg = self.cfg
-            self._state = E.migrate_state(
-                self._state, old_cfg, new_cfg, self.time.now_ms()
-            )
-            self.cfg = new_cfg
-            self.registry.cfg = new_cfg
-            self._tick = new_tick
-        # ruleset tensors are capacity-shaped, not window-shaped — the
-        # recompile only keeps future rule edits keyed to the active cfg
-        self._recompile_rules()
+        _h = OT.TRACER.begin("client.window_reshape", **changes)
+        try:
+            new_tick = E.make_tick(new_cfg, donate=True, features=self._features)
+            # pre-compile BOTH batch shapes against a throwaway state while
+            # the old engine keeps serving: XLA compiles take seconds, and a
+            # window whose budget migrated would legitimately EXPIRE during
+            # that gap — compiling first makes the actual swap a few ms of
+            # migration math
+            z = jnp.float32(0.0)
+            dummy = E.init_state(new_cfg)
+            for bs in {min(256, new_cfg.batch_size), new_cfg.batch_size}:
+                dummy, _ = new_tick(
+                    dummy,
+                    self._rules_dev,
+                    E.empty_acquire(new_cfg, b=bs),
+                    E.empty_complete(
+                        new_cfg, b=min(bs, new_cfg.complete_batch_size)
+                    ),
+                    jnp.int32(self.time.now_ms()),
+                    z,
+                    z,
+                )
+            jax.block_until_ready(dummy.concurrency)
+            with self._engine_lock:
+                old_cfg = self.cfg
+                self._state = E.migrate_state(
+                    self._state, old_cfg, new_cfg, self.time.now_ms()
+                )
+                self.cfg = new_cfg
+                self.registry.cfg = new_cfg
+                self._tick = new_tick
+            # ruleset tensors are capacity-shaped, not window-shaped — the
+            # recompile only keeps future rule edits keyed to the active cfg
+            self._recompile_rules()
+        finally:
+            OT.TRACER.end(_h)
 
     def register_window_property(self, prop) -> None:
         """Subscribe window shape to a SentinelProperty pushing dicts like
@@ -1810,6 +1884,8 @@ class SentinelClient:
         running on the old capacity (exact via seg_fallback)."""
         import dataclasses
 
+        _C_SEG_RESIZE.inc()
+        _h = OT.TRACER.begin("engine.seg_resize", seg_u=int(new_u))
         try:
             feats = self._features
             new_cfg = dataclasses.replace(self.cfg, seg_u=int(new_u))
@@ -1847,6 +1923,7 @@ class SentinelClient:
                 "capacity", new_u, exc_info=True,
             )
         finally:
+            OT.TRACER.end(_h)
             self._seg_resizing = False
 
     def _record_seg_dropped(self, n: int) -> None:
@@ -1855,6 +1932,7 @@ class SentinelClient:
         rate-limited record-log warning."""
         from sentinel_tpu.ops import engine_seg as ES
 
+        _C_SEG_DROPPED.inc(n)
         with self._blk_lock:
             self.seg_dropped_total += n
         now = self.time.wall_ms()
@@ -1904,6 +1982,16 @@ class SentinelClient:
         trash = cfg.trash_row
         n_blk = sum(t for _b, _o, t in blocks)
         t_build0 = _time.perf_counter()
+        # process-unique trace id correlating this tick's spans across the
+        # submitting thread and the resolver pool (per-client counters
+        # would collide in multi-client processes sharing the ring)
+        tick_id = OT.TRACER.next_trace_id()
+        # stage brackets (obs/trace.py): _t_asm truthiness is the single
+        # flag check; presort time is accumulated separately so the
+        # assemble span reports pure column work
+        _t_asm = OT.t0()
+        _tp0 = 0
+        _ns_presort = 0
         # concatenate every attached door's drained engine items; responses
         # route back per door by slice
         if fronts:
@@ -2002,6 +2090,7 @@ class SentinelClient:
             pre_np = arr("pre_verdict", 0, np.int32)
             ph_np = _ph_cols()
             if presort:
+                _tp = OT.t0()
                 # key order matches engine_seg.prepare_acquire's segment
                 # keys, res-major (seg ranks also need res nondecreasing);
                 # trash-row padding sorts wherever its id lands — padding
@@ -2016,6 +2105,9 @@ class SentinelClient:
                 ph_np = ph_np[order]
                 inv_a = np.empty(B, np.int32)
                 inv_a[order] = np.arange(B, dtype=np.int32)
+                if _tp:
+                    _tp0 = _tp0 or _tp
+                    _ns_presort += OT.now_ns() - _tp
                 # sampled (1-in-8 full-size ticks): a handful of numpy
                 # passes over B — resize detection doesn't need every tick
                 self._seg_sample_ctr += 1
@@ -2046,6 +2138,7 @@ class SentinelClient:
              *aux_a) = comp
             n = len(res_a)
             if presort and n > 1:
+                _tp = OT.t0()
                 # completions carry no futures — sort in place, no unsort
                 # (all completion effects are order-independent sums/minima)
                 order = np.lexsort((org_a, ctx_a, res_a))
@@ -2054,6 +2147,9 @@ class SentinelClient:
                     for x in (res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a)
                 )
                 aux_a = [x[order] for x in aux_a]
+                if _tp:
+                    _tp0 = _tp0 or _tp
+                    _ns_presort += OT.now_ns() - _tp
                 self._seg_sample_ctr_c += 1
                 if B2 <= 4096 or (self._seg_sample_ctr_c & 7) == 0:
                     self._note_seg_count(
@@ -2095,6 +2191,20 @@ class SentinelClient:
                 param_hash=self._dev_col("c.ph", ph_np, 0),
             )
 
+        _t_disp = OT.t0()
+        if _t_asm:
+            OT.stage_ns(
+                "tick.assemble",
+                _t_asm,
+                (_t_disp or OT.now_ns()) - _t_asm - _ns_presort,
+                _H_ASSEMBLE,
+                trace=tick_id,
+                attrs={"b": B, "b2": B2},
+            )
+            if _ns_presort:
+                OT.stage_ns(
+                    "tick.presort", _tp0, _ns_presort, _H_PRESORT, trace=tick_id
+                )
         load, cpu = self._sys.sample()
         t = now_ms if now_ms is not None else self.time.now_ms()
         # running average of host batch-build time (assembly + presort +
@@ -2112,6 +2222,13 @@ class SentinelClient:
                 jnp.float32(load),
                 jnp.float32(cpu),
             )
+        _disp_done = 0
+        if _t_disp:
+            _disp_done = OT.now_ns()
+            OT.stage_ns(
+                "tick.dispatch", _t_disp, _disp_done - _t_disp, _H_DISPATCH,
+                trace=tick_id,
+            )
         p = _PendingTick(
             acq=acq,
             blocks=list(blocks),
@@ -2121,6 +2238,8 @@ class SentinelClient:
             check_dropped=bool(presort and not cfg.seg_fallback),
             n_obj=len(acq),
             n_blk=n_blk,
+            tick_id=tick_id,
+            dispatched_ns=_disp_done,
         )
         if self._pipeline_depth:
             # start the device→host verdict transfer NOW so it overlaps
@@ -2155,6 +2274,10 @@ class SentinelClient:
         futs, self._resolve_futs = self._resolve_futs, []
         for f in futs:
             f.result()
+        # the pipeline is empty here — zero the gauges so /metrics never
+        # reports a stale occupancy while the loop idles
+        _G_OCCUPANCY.set(0)
+        _G_RESOLVER_Q.set(0)
 
     def _resolve_tick(self, p: _PendingTick) -> None:
         """Read back one dispatched tick's outputs and fan verdicts out to
@@ -2164,6 +2287,21 @@ class SentinelClient:
         out = p.out
         # stlint: disable-next-line=host-sync — THE designed readback point (see class docstring)
         verdict = np.asarray(out.verdict)
+        if p.dispatched_ns and OT.TRACER.enabled:
+            # dispatch → verdicts host-visible: device compute + transfer,
+            # plus queue wait when pipelined (spans may overlap in time —
+            # that overlap IS the pipelining being measured)
+            OT.stage_ns(
+                "tick.device",
+                p.dispatched_ns,
+                OT.now_ns() - p.dispatched_ns,
+                _H_DEVICE,
+                trace=p.tick_id,
+            )
+        # readback starts AFTER the verdict wait so it measures only the
+        # residual host reads (drop count, wait column) — the device span
+        # above already owns the blocking verdict transfer
+        _t_rb = OT.t0()
         if p.check_dropped:
             # fail-closed capacity overflow must be LOUD (an engine
             # rejecting traffic because seg_u is undersized is an incident,
@@ -2178,6 +2316,9 @@ class SentinelClient:
             wait = np.asarray(out.wait_ms)  # stlint: disable=host-sync — readback point
         else:
             wait = np.zeros(verdict.shape[0], np.int32)
+        if _t_rb:
+            OT.stage("tick.readback", _t_rb, _H_READBACK, trace=p.tick_id)
+        _t_res = OT.t0()
         if p.inv_a is not None:
             # map sorted-batch verdicts back to submission order
             verdict = verdict[p.inv_a]
@@ -2206,6 +2347,11 @@ class SentinelClient:
                         wait[off : off + k].astype(np.int32),
                     )
                     off += k
+        if _t_res:
+            OT.stage(
+                "tick.resolve", _t_res, _H_RESOLVE, trace=p.tick_id,
+                attrs={"n_obj": p.n_obj, "n_blk": p.n_blk},
+            )
 
 
 def _mask_min_rt(v: float) -> float:

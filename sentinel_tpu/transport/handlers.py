@@ -178,6 +178,32 @@ class DefaultHandlerGroup:
             root["children"].append(node)
         return CommandResponse.of_success(root)
 
+    @command_mapping("metrics", "Prometheus text exposition (obs registry)")
+    def prometheus_metrics(self, req: CommandRequest) -> CommandResponse:
+        """``GET /metrics`` — the standard scrape surface: every counter /
+        gauge / histogram in the process-global obs registry (tick-stage
+        latencies, pipeline occupancy, seg drops, cluster degrade state,
+        RPC latencies) in Prometheus text format 0.0.4."""
+        from sentinel_tpu.obs import REGISTRY
+
+        return CommandResponse.of_success(REGISTRY.exposition())
+
+    @command_mapping("api/traces", "span-tracer ring dump (Chrome trace JSON)")
+    def api_traces(self, req: CommandRequest) -> CommandResponse:
+        """``GET /api/traces`` — the current span ring as Chrome Trace
+        Event JSON: load in Perfetto / chrome://tracing, or feed to
+        ``python -m sentinel_tpu.obs --summary``.  ``?enable=true|false``
+        flips tracing on the instance first (an ops toggle, like
+        setSwitch)."""
+        from sentinel_tpu.obs import TRACER
+
+        enable = (req.param("enable") or "").lower()
+        if enable == "true":
+            TRACER.enable()
+        elif enable == "false":
+            TRACER.disable()
+        return CommandResponse.of_success(TRACER.chrome_trace())
+
     @command_mapping("rtQuantiles", "inbound RT quantiles (p50/p90/p99)")
     def rt_quantiles(self, req: CommandRequest) -> CommandResponse:
         qs = [float(x) for x in (req.param("q") or "0.5,0.9,0.99").split(",")]

@@ -65,10 +65,16 @@ class _FakeApi:
     def __init__(self):
         self.calls = []
         self.nodes = []
+        self.prom_text = "sentinel_pipeline_occupancy 2\n"
 
     def fetch_metric(self, ip, port, start_ms, end_ms):
         self.calls.append((start_ms, end_ms))
         return [n for n in self.nodes if start_ms <= n.timestamp <= end_ms]
+
+    def fetch_prometheus(self, ip, port):
+        if port == 666:  # the designated down machine
+            raise OSError("down")
+        return self.prom_text
 
 
 def test_fetcher_catchup_window():
@@ -90,6 +96,18 @@ def test_fetcher_catchup_window():
     f.fetch_once(now + 1000)
     start2, _ = api.calls[1]
     assert start2 == (now - 5000) + 1000
+
+
+def test_fetcher_scrapes_prometheus_per_machine():
+    """MetricFetcher.scrape_prometheus sweeps healthy machines' /metrics
+    (the obs-plane exposition) and skips unreachable ones."""
+    d = AppManagement()
+    d.register(MachineInfo(app="app", ip="127.0.0.1", port=1))
+    d.register(MachineInfo(app="app", ip="127.0.0.1", port=666))
+    f = MetricFetcher(d, InMemoryMetricsRepository(), api=_FakeApi())
+    out = f.scrape_prometheus("app")
+    assert list(out.values()) == ["sentinel_pipeline_occupancy 2\n"]
+    assert f.fetch_ok == 1 and f.fetch_fail == 1
 
 
 def test_dashboard_serves_ui_page():

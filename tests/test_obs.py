@@ -1,0 +1,445 @@
+"""sentinel_tpu.obs — span tracer ring, metrics registry, exposition, CLI.
+
+Covers the ISSUE-3 contracts: ring wraparound and concurrent writers,
+power-of-two histogram bucket boundaries + merge, Prometheus exposition
+(golden text), the tracer-disabled overhead guard, the extension
+error-counter satellite, and the ``python -m sentinel_tpu.obs --summary``
+self-capture printing p50/p99 for all six tick stages.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from sentinel_tpu import obs
+from sentinel_tpu.obs.registry import Counter, Gauge, Histogram, MetricRegistry
+from sentinel_tpu.obs.trace import SpanTracer
+
+
+@pytest.fixture(autouse=True)
+def _tracer_state():
+    """Never leak an enabled/poisoned global tracer into other tests."""
+    was = obs.TRACER.enabled
+    yield
+    obs.TRACER.disable()
+    obs.TRACER.reset()
+    if was:  # pragma: no cover — the suite never leaves it on
+        obs.TRACER.enable()
+
+
+# ---------------------------------------------------------------------------
+# span tracer ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_records_in_order_and_snapshot_is_sorted():
+    tr = SpanTracer(capacity=64)
+    tr.enable()
+    for i in range(10):
+        tr.record(f"s{i}", t0_ns=1000 + i, dur_ns=5, trace=7)
+    snap = tr.snapshot()
+    assert [s["name"] for s in snap] == [f"s{i}" for i in range(10)]
+    assert all(s["trace"] == 7 for s in snap)
+    assert tr.recorded_total == 10
+
+
+def test_ring_wraparound_keeps_newest():
+    tr = SpanTracer(capacity=8)  # already a power of two
+    tr.enable()
+    for i in range(20):
+        tr.record("s", t0_ns=i, dur_ns=1)
+    snap = tr.snapshot()
+    assert len(snap) == 8
+    # the survivors are exactly the last capacity records, oldest first
+    assert [s["t0_ns"] for s in snap] == list(range(12, 20))
+    assert tr.recorded_total == 20
+
+
+def test_capacity_rounds_up_to_power_of_two():
+    assert SpanTracer(capacity=100).capacity == 128
+    assert SpanTracer(capacity=1).capacity == 2
+
+
+def test_concurrent_writers_land_on_distinct_slots():
+    tr = SpanTracer(capacity=4096)
+    tr.enable()
+    n_threads, per = 8, 200
+
+    def work(k):
+        for i in range(per):
+            tr.record(f"t{k}", t0_ns=i, dur_ns=1)
+
+    ts = [threading.Thread(target=work, args=(k,)) for k in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = tr.snapshot()
+    assert len(snap) == n_threads * per  # nothing lost below capacity
+    seqs = [s["seq"] for s in snap]
+    assert len(set(seqs)) == len(seqs)  # no slot ever shared a sequence
+    by_name = {}
+    for s in snap:
+        by_name.setdefault(s["name"], 0)
+        by_name[s["name"]] += 1
+    assert all(v == per for v in by_name.values())
+
+
+def test_span_context_manager_and_disabled_noop():
+    tr = SpanTracer(capacity=16)
+    with tr.span("off"):  # disabled: shared no-op, nothing recorded
+        pass
+    assert tr.snapshot() == []
+    tr.enable()
+    with tr.span("on", trace=3, stage="x"):
+        pass
+    (s,) = tr.snapshot()
+    assert s["name"] == "on" and s["trace"] == 3 and s["attrs"] == {"stage": "x"}
+    assert s["dur_ns"] >= 0
+
+
+def test_begin_end_crosses_threads():
+    tr = SpanTracer(capacity=16)
+    tr.enable()
+    h = tr.begin("xthread", trace=9, chunk=1)
+    done = threading.Event()
+
+    def finisher():
+        tr.end(h, ok=True)
+        done.set()
+
+    threading.Thread(target=finisher).start()
+    assert done.wait(5.0)
+    (s,) = tr.snapshot()
+    assert s["name"] == "xthread" and s["trace"] == 9
+    assert s["attrs"] == {"chunk": 1, "ok": True}
+    # disabled begin returns None and end(None) is a no-op
+    tr.disable()
+    assert tr.begin("nope") is None
+    tr.end(None)
+
+
+def test_chrome_trace_export_shape(tmp_path):
+    tr = SpanTracer(capacity=16)
+    tr.enable()
+    tr.record("a", t0_ns=2_000, dur_ns=1_000, trace=1, attrs={"k": "v"})
+    doc = tr.chrome_trace()
+    (ev,) = doc["traceEvents"]
+    assert ev["ph"] == "X"
+    assert ev["ts"] == 2.0 and ev["dur"] == 1.0  # microseconds
+    assert ev["args"]["k"] == "v" and ev["args"]["trace"] == 1
+    p = tmp_path / "trace.json"
+    tr.dump(str(p))
+    spans = obs.load_spans(str(p))
+    assert spans[0]["name"] == "a" and spans[0]["dur_ns"] == 1_000.0
+
+
+def test_summarize_percentiles():
+    spans = [
+        {"name": "tick.device", "dur_ns": d * 1e6, "t0_ns": 0, "tid": 0}
+        for d in (1.0, 2.0, 3.0, 4.0, 100.0)
+    ] + [{"name": "other", "dur_ns": 5e6, "t0_ns": 0, "tid": 0}]
+    summ = obs.summarize(spans, prefix="tick.")
+    assert list(summ) == ["tick.device"]
+    s = summ["tick.device"]
+    assert s["count"] == 5
+    assert s["p50_ms"] == 3.0
+    assert 4.0 < s["p99_ms"] <= 100.0
+
+
+def test_disabled_overhead_guard():
+    """The disabled fast path is a single flag check: 20k t0() probes must
+    cost microseconds each at worst — no clock read, no allocation."""
+    from sentinel_tpu.obs import trace as OT
+    from sentinel_tpu.utils.time_source import mono_s
+
+    assert not OT.TRACER.enabled
+    n = 20_000
+    t_start = mono_s()
+    acc = 0
+    for _ in range(n):
+        t = OT.t0()
+        if t:  # pragma: no cover — disabled: never taken
+            acc += t
+    elapsed = mono_s() - t_start
+    assert acc == 0
+    # ~100 ns/call in CPython; 5 µs/call is a 50x safety margin for CI
+    assert elapsed / n < 5e-6, f"disabled-path cost {elapsed / n * 1e9:.0f} ns/call"
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_power_of_two_bucket_boundaries():
+    h = Histogram("h", start=1.0, buckets=4)  # bounds 1, 2, 4, 8, +Inf
+    assert list(h.bounds) == [1.0, 2.0, 4.0, 8.0]
+    for v, want in [
+        (0.1, 0),  # below start -> first bucket
+        (1.0, 0),  # boundary is INCLUSIVE (le semantics)
+        (1.0001, 1),
+        (2.0, 1),
+        (2.0001, 2),
+        (4.0, 2),
+        (8.0, 3),
+        (8.0001, 4),  # overflow -> +Inf slot
+        (1e9, 4),
+    ]:
+        assert h._index(v) == want, (v, want, h._index(v))
+    h.observe(1.5)
+    h.observe(3.0)
+    h.observe(100.0)
+    assert h.count == 3 and h.sum == pytest.approx(104.5)
+
+
+def test_histogram_merge_and_quantile():
+    a = Histogram("h", start=1.0, buckets=8)
+    b = Histogram("h", start=1.0, buckets=8)
+    for v in (1.0, 1.0, 2.0, 4.0):
+        a.observe(v)
+    for v in (64.0, 128.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 6
+    assert a.quantile(0.5) == 2.0  # 3rd of 6 samples sits in the le=2 bucket
+    assert a.quantile(1.0) == 128.0
+    c = Histogram("h", start=2.0, buckets=8)
+    with pytest.raises(ValueError):
+        a.merge(c)
+
+
+def test_histogram_quantile_empty_is_zero():
+    assert Histogram("h").quantile(0.99) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# registry + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_registry_get_or_create_identity_and_type_conflict():
+    reg = MetricRegistry()
+    c1 = reg.counter("x_total", "help one")
+    c2 = reg.counter("x_total")
+    assert c1 is c2
+    assert reg.counter("x_total", labels={"a": "1"}) is not c1  # new series
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    assert isinstance(reg.gauge("g"), Gauge)
+    assert reg.get("x_total") is c1
+    assert reg.get("missing") is None
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricRegistry()
+    reg.counter("demo_requests_total", "requests served").inc(3)
+    reg.counter("demo_requests_total", labels={"kind": "bulk"}).inc(2)
+    reg.gauge("demo_depth", "queue depth").set(1.5)
+    h = reg.histogram("demo_ms", "latency", start=1.0, buckets=3)
+    h.observe(0.5)
+    h.observe(3.0)
+    h.observe(99.0)
+    golden = "\n".join(
+        [
+            "# HELP demo_depth queue depth",
+            "# TYPE demo_depth gauge",
+            "demo_depth 1.5",
+            "# HELP demo_ms latency",
+            "# TYPE demo_ms histogram",
+            'demo_ms_bucket{le="1"} 1',
+            'demo_ms_bucket{le="2"} 1',
+            'demo_ms_bucket{le="4"} 2',
+            'demo_ms_bucket{le="+Inf"} 3',
+            "demo_ms_sum 102.5",
+            "demo_ms_count 3",
+            "# HELP demo_requests_total requests served",
+            "# TYPE demo_requests_total counter",
+            "demo_requests_total 3",
+            'demo_requests_total{kind="bulk"} 2',
+            "",
+        ]
+    )
+    assert reg.exposition() == golden
+
+
+def test_exposition_lines_are_well_formed():
+    """Every non-comment line of the GLOBAL registry (fully populated by
+    the instrumented modules' imports) parses as `name{labels} value`."""
+    import re
+
+    import sentinel_tpu.runtime.client  # noqa: F401 — registers tick metrics
+
+    text = obs.REGISTRY.exposition()
+    assert "sentinel_tick_device_ms" in text
+    assert "sentinel_pipeline_occupancy" in text
+    pat = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9][0-9a-zA-Z+.e-]*$"
+    )
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE "))
+        else:
+            assert pat.match(line), line
+
+
+def test_label_values_are_escaped():
+    reg = MetricRegistry()
+    reg.counter("esc_total", labels={"k": 'a"b\\c\nd'}).inc()
+    line = [
+        l for l in reg.exposition().splitlines() if not l.startswith("#")
+    ][0]
+    assert line == 'esc_total{k="a\\"b\\\\c\\nd"} 1'
+
+
+def test_gauges_zero_when_pipeline_drains(client_factory):
+    """Occupancy/resolver-queue gauges must not stay stale after the loop
+    goes idle (scrapes happen while idle)."""
+    import sentinel_tpu as st
+    from sentinel_tpu.runtime import client as RC
+
+    obs.enable()
+    try:
+        c = client_factory()
+        c.flow_rules.load([st.FlowRule(resource="g-res", count=100)])
+        with c.entry("g-res"):
+            pass
+    finally:
+        obs.disable()
+    assert RC.OBS.get("sentinel_pipeline_occupancy").value == 0
+    assert RC.OBS.get("sentinel_resolver_queue_depth").value == 0
+
+
+def test_registry_snapshot_shape():
+    reg = MetricRegistry()
+    reg.counter("c_total").inc(4)
+    h = reg.histogram("h_ms", start=1.0, buckets=4)
+    h.observe(3.0)
+    snap = reg.snapshot()
+    assert snap["c_total"] == 4
+    assert snap["h_ms"]["count"] == 1 and snap["h_ms"]["p50"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# extension error counting satellite
+# ---------------------------------------------------------------------------
+
+
+def test_safe_dispatch_counts_errors_and_rate_limits_log(monkeypatch):
+    from sentinel_tpu.metrics import extension as MEXT
+
+    class Boom(MEXT.MetricExtension):
+        def on_pass(self, *a, **kw):
+            raise RuntimeError("boom")
+
+    logged = []
+
+    class _FakeLog:
+        def exception(self, msg, *args):
+            logged.append(msg % args if args else msg)
+
+    import sentinel_tpu.utils.record_log as RL
+
+    monkeypatch.setattr(RL, "record_log", lambda: _FakeLog())
+    clock = {"t": 100.0}
+    monkeypatch.setattr(MEXT, "mono_s", lambda: clock["t"])
+    MEXT._warn_state.clear()
+
+    ext = Boom()
+    MEXT.register_extension(ext)
+    try:
+        before = MEXT._C_EXT_ERRORS.value
+        for _ in range(5):
+            MEXT.safe_dispatch("on_pass", "res", 1, "")
+        assert MEXT._C_EXT_ERRORS.value == before + 5  # every failure counted
+        assert len(logged) == 1  # ...but only one log line inside the window
+        clock["t"] += MEXT._WARN_INTERVAL_S + 1
+        MEXT.safe_dispatch("on_pass", "res", 1, "")
+        assert MEXT._C_EXT_ERRORS.value == before + 6
+        assert len(logged) == 2
+        assert "+4 more" in logged[1]  # the suppressed count surfaces
+    finally:
+        MEXT.unregister_extension(ext)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: instrumented client + CLI summary
+# ---------------------------------------------------------------------------
+
+#: the six pipelined tick stages the ISSUE-3 acceptance names
+_SIX = (
+    "tick.assemble",
+    "tick.presort",
+    "tick.dispatch",
+    "tick.device",
+    "tick.readback",
+    "tick.resolve",
+)
+
+
+def test_cli_self_capture_prints_all_six_stages(capsys):
+    """`python -m sentinel_tpu.obs --summary` (self-capture path): a
+    SentinelClient run with pipeline_depth>0 yields p50/p99 for all six
+    tick stages."""
+    from sentinel_tpu.obs.__main__ import main
+
+    obs.TRACER.reset()
+    assert main(["--summary", "--blocks", "3"]) == 0
+    out = capsys.readouterr().out
+    for name in _SIX:
+        assert name in out, f"{name} missing from CLI summary:\n{out}"
+    assert "p50 ms" in out and "p99 ms" in out
+    assert "absent from this trace" not in out
+
+
+def test_client_run_populates_stage_histograms_and_gauges(client_factory):
+    """Tick-stage histograms and the occupancy gauge fill from a traced
+    sync-mode client run (the /metrics acceptance surface)."""
+    import sentinel_tpu as st
+    from sentinel_tpu.runtime import client as RC
+
+    before = {n: RC.OBS.get(f"sentinel_tick_{n}_ms").count for n in
+              ("assemble", "dispatch", "device", "readback", "resolve")}
+    obs.enable()
+    try:
+        c = client_factory()
+        c.flow_rules.load([st.FlowRule(resource="obs-res", count=100)])
+        for _ in range(3):
+            with c.entry("obs-res"):
+                pass
+    finally:
+        obs.disable()
+    for n, b in before.items():
+        assert RC.OBS.get(f"sentinel_tick_{n}_ms").count > b, n
+    # tick spans carry matching trace ids across stages
+    spans = [s for s in obs.TRACER.snapshot() if s["name"].startswith("tick.")]
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], set()).add(s["name"])
+    assert any(
+        {"tick.assemble", "tick.dispatch", "tick.device", "tick.resolve"} <= v
+        for v in by_trace.values()
+    )
+
+
+def test_chrome_roundtrip_through_summarize(tmp_path):
+    obs.TRACER.reset()
+    obs.enable()
+    try:
+        with obs.span("tick.device", trace=1):
+            pass
+    finally:
+        obs.disable()
+    p = tmp_path / "t.json"
+    obs.TRACER.dump(str(p))
+    spans = obs.load_spans(str(p))
+    assert "tick.device" in obs.summarize(spans)
+    # files that are neither format are rejected
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"nope": 1}))
+    with pytest.raises(ValueError):
+        obs.load_spans(str(bad))

@@ -105,6 +105,30 @@ def test_time_source_allows_helpers_perf_counter_and_own_module():
     assert _run(TimeSourcePass(), own) == []
 
 
+def test_time_source_allowlists_tracer_read_point_only():
+    """obs/trace.py holds the span tracer's single sanctioned monotonic
+    read (ISSUE 3 satellite); every other obs module stays banned."""
+    src = "import time\n\ndef now_ns():\n    return time.monotonic_ns()\n"
+    assert _run(TimeSourcePass(), _mod(src, path="sentinel_tpu/obs/trace.py")) == []
+    got = _run(TimeSourcePass(), _mod(src, path="sentinel_tpu/obs/registry.py"))
+    assert len(got) == 1 and got[0].rule == "time-source"
+    # the REAL tracer module keeps exactly ONE raw-clock call site
+    real = os.path.join(REPO_ROOT, "sentinel_tpu", "obs", "trace.py")
+    with open(real) as f:
+        tree = ast.parse(f.read())
+    from sentinel_tpu.analysis import astutil as A
+
+    aliases = A.import_aliases(tree)
+    raw_reads = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and A.resolve_call(n, aliases)
+        in ("time.monotonic_ns", "time.monotonic", "time.time", "time.time_ns")
+    ]
+    assert len(raw_reads) == 1, "obs/trace.py must keep ONE sanctioned clock read"
+
+
 # ---------------------------------------------------------------------------
 # fail-open
 # ---------------------------------------------------------------------------

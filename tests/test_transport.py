@@ -137,6 +137,51 @@ def test_http_command_center_end_to_end(client):
         center.stop()
 
 
+def test_metrics_and_traces_endpoints_round_trip(client):
+    """GET /metrics serves Prometheus text (tick histograms + pipeline
+    gauge present) and GET /api/traces serves the span ring as Chrome
+    trace JSON — the obs plane's exposition surface (ISSUE 3)."""
+    from sentinel_tpu import obs
+
+    obs.TRACER.reset()
+    obs.enable()
+    try:
+        client.flow_rules.load([st.FlowRule(resource="prom-res", count=100)])
+        with client.entry("prom-res"):
+            pass
+    finally:
+        obs.disable()
+    center = SimpleHttpCommandCenter(build_default_handlers(client), host="127.0.0.1", port=0)
+    center.start()
+    try:
+        base = f"http://127.0.0.1:{center.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=3) as rsp:
+            assert rsp.status == 200
+            assert rsp.headers["Content-Type"].startswith("text/plain")
+            text = rsp.read().decode()
+        assert "# TYPE sentinel_tick_device_ms histogram" in text
+        assert 'sentinel_tick_device_ms_bucket{le="+Inf"}' in text
+        assert "# TYPE sentinel_pipeline_occupancy gauge" in text
+        # the traced entry above landed at least one device-tick sample
+        count_line = [
+            l for l in text.splitlines() if l.startswith("sentinel_tick_device_ms_count")
+        ][0]
+        assert float(count_line.split()[-1]) >= 1
+        with urllib.request.urlopen(f"{base}/api/traces", timeout=3) as rsp:
+            doc = json.loads(rsp.read())
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "tick.device" in names and "tick.resolve" in names
+        assert all(e["ph"] == "X" for e in doc["traceEvents"])
+        # ?enable=false flips tracing off via the command plane
+        with urllib.request.urlopen(f"{base}/api/traces?enable=false", timeout=3):
+            pass
+        from sentinel_tpu.obs import TRACER
+
+        assert not TRACER.enabled
+    finally:
+        center.stop()
+
+
 def test_heartbeat_against_local_receiver(client):
     """Heartbeat posts land on an HTTP receiver (a stand-in dashboard)."""
     import threading
