@@ -32,11 +32,19 @@ _BANNED = {
 }
 
 #: the modules allowed to touch the clock: utils/time_source (the host
-#: time discipline) and obs/trace.py, whose ``now_ns()`` is the span
+#: time discipline); obs/trace.py, whose ``now_ns()`` is the span
 #: tracer's single sanctioned monotonic read point — span brackets at µs
 #: durations need the raw ns clock, and keeping that read in ONE
-#: function preserves the greppability rule this pass enforces
-_ALLOWED_FILES = ("*utils/time_source.py", "*obs/trace.py")
+#: function preserves the greppability rule this pass enforces; and
+#: chaos/failpoints.py, the fault-injection plane's single sanctioned
+#: home for time manipulation (the ``delay`` action sleeps and
+#: ``clock_skew`` shifts values an armed plan dictates — any future
+#: clock read those actions need must live there, nowhere else)
+_ALLOWED_FILES = (
+    "*utils/time_source.py",
+    "*obs/trace.py",
+    "*chaos/failpoints.py",
+)
 
 
 class TimeSourcePass(Pass):

@@ -1,0 +1,40 @@
+"""sentinel_tpu.chaos — deterministic fault-injection plane.
+
+Three pieces, mirroring the obs plane's structure:
+
+  * ``failpoints`` — named injection sites threaded through transport,
+    cluster, runtime, parallel, and datasource code; one flag check when
+    disarmed, seeded deterministic actions when armed
+  * ``plans`` — declarative fault plans (what/where/when), JSON
+    round-trippable so any run replays from its serialized plan + seed
+  * ``invariants`` + ``runner`` — safety monitors over ``obs.REGISTRY``
+    metrics and client state, plus built-in scenarios driving a real
+    pipelined ``SentinelClient`` (and optionally a cluster token server
+    and a remote-shard pair) under a plan
+
+CLI: ``python -m sentinel_tpu.chaos --seed 7`` runs every built-in
+scenario and reports per-scenario invariant verdicts and injected-event
+counts (identical for identical seeds — the determinism contract).
+
+NOTE: importing this package must stay cheap — ``failpoints`` is
+imported by hot product modules at process start.  Heavy imports (jax,
+the runner's scenarios) stay inside ``runner``/``__main__``.
+"""
+
+from sentinel_tpu.chaos import failpoints
+from sentinel_tpu.chaos.failpoints import arm, armed, catalog, disarm, hit, pipe, skew_ms
+from sentinel_tpu.chaos.plans import ACTIONS, FaultPlan, FaultSpec
+
+__all__ = [
+    "ACTIONS",
+    "FaultPlan",
+    "FaultSpec",
+    "arm",
+    "armed",
+    "catalog",
+    "disarm",
+    "failpoints",
+    "hit",
+    "pipe",
+    "skew_ms",
+]

@@ -1,0 +1,810 @@
+"""Chaos scenario harness: drive real clients under seeded fault plans.
+
+Each built-in scenario assembles REAL product objects — a sync-mode
+``SentinelClient`` on virtual time, and where the scenario calls for it a
+localhost ``ClusterTokenServer`` / ``ClusterTokenClient`` pair or a
+``RemoteShard`` against that server's RES_CHECK path — arms a
+``FaultPlan`` derived from the run seed, drives deterministic traffic,
+and evaluates its invariant set (``chaos/invariants.py``).
+
+Determinism contract: a scenario's reported ``injected`` counts are a
+pure function of its seed.  Schedules are hit-index or ``max_fires``
+gated on sites whose hit order the scenario controls (one round-trip per
+request, one resolve per tick); sites with timing-dependent hit counts
+(reader-thread recv, TCP segmentation) carry only ``max_fires``-pinned
+specs.  The CLI's ``--check-determinism`` mode runs everything twice and
+diffs the counts.
+
+Scenarios (the acceptance set):
+
+  rpc_error_burst     token RPC send failures + latency bursts against a
+                      live server; STATUS_FAIL only where injected
+  cluster_partition   cluster-mode client loses the token server, enters
+                      degraded local enforcement, heals, exits
+  resolver_exception  verdict readback raises; ticks fail CLOSED instead
+                      of stranding futures
+  seg_overflow_storm  fail-closed segment-capacity overflow + live
+                      seg_u grow-and-swap under injected resize delay
+  datasource_flap     rule-file refresh loop faults; rules hold, then
+                      the post-heal update applies
+  shard_reconnect     mid-window shard partition: answered chunks stay
+                      resolved, unanswered degrade, no replay
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time as _time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from sentinel_tpu.chaos import failpoints as FP
+from sentinel_tpu.chaos.invariants import (
+    MetricsDelta,
+    ScenarioContext,
+    Verdict,
+    evaluate,
+)
+from sentinel_tpu.chaos.plans import FaultPlan, FaultSpec
+from sentinel_tpu.utils.time_source import mono_s
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    seed: int
+    ok: bool
+    injected: Dict[str, int]
+    verdicts: List[Verdict]
+    duration_s: float
+    notes: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "ok": self.ok,
+            "injected": dict(sorted(self.injected.items())),
+            "invariants": [
+                {"name": v.name, "ok": v.ok, "detail": v.detail}
+                for v in self.verdicts
+            ],
+            "duration_s": round(self.duration_s, 3),
+            "notes": self.notes,
+        }
+
+
+class _Session:
+    """Accumulates injected/hit counts over one or more armed windows —
+    scenarios that must observe quiet phases (hit counting) around a
+    fault window arm several plans in sequence."""
+
+    def __init__(self):
+        self.injected: Dict[str, int] = {}
+        self.hits: Dict[str, int] = {}
+
+    @contextmanager
+    def window(self, plan: FaultPlan):
+        st = FP.arm(plan)
+        try:
+            yield st
+        finally:
+            FP.disarm()
+            for k, v in st.injected().items():
+                self.injected[k] = self.injected.get(k, 0) + v
+            for k, v in st.hit_counts().items():
+                self.hits[k] = self.hits.get(k, 0) + v
+
+
+# -- builders ----------------------------------------------------------------
+
+
+def _make_client(**kw):
+    """Sync-mode SentinelClient on the small config + fresh virtual time
+    (the deterministic test shape); caller stops it."""
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.runtime.client import SentinelClient
+    from sentinel_tpu.utils.time_source import VirtualTimeSource
+
+    kw.setdefault("cfg", small_engine_config())
+    kw.setdefault("time_source", VirtualTimeSource(start_ms=1_000))
+    kw.setdefault("mode", "sync")
+    c = SentinelClient(**kw)
+    c.start()
+    return c
+
+
+def _make_token_server(flow_count: float = 3.0, flow_id: int = 101):
+    """Decision client + DefaultTokenService + localhost TCP server."""
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.core import rules as R
+
+    decision = _make_client()
+    svc = DefaultTokenService(decision)
+    svc.flow_rules.load(
+        "default",
+        [
+            R.FlowRule(
+                resource=f"res-{flow_id}",
+                count=flow_count,
+                cluster_mode=True,
+                cluster_flow_id=flow_id,
+            )
+        ],
+    )
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0)
+    server.start()
+    # warm the decision engine's first-tick XLA compile on a throwaway
+    # resource BEFORE any scenario traffic: the compile takes seconds and
+    # would otherwise race RPC timeouts, turning scheduled fault indices
+    # into timing lotteries
+    decision.registry.resource_id("chaos/warm")
+    f = decision.submit_acquire("chaos/warm")
+    if f is not None:
+        f.result(timeout=120.0)
+    return decision, svc, server
+
+
+def _drain_entries(client, resource: str, n: int) -> Dict[str, int]:
+    """n blocking entries; returns {"passed": .., "blocked": ..} with every
+    passing entry exited immediately (no leaked concurrency)."""
+    passed = blocked = 0
+    for _ in range(n):
+        e = client.try_entry(resource)
+        if e is not None:
+            e.exit()
+            passed += 1
+        else:
+            blocked += 1
+    return {"passed": passed, "blocked": blocked}
+
+
+# -- scenarios ---------------------------------------------------------------
+
+
+def _scn_rpc_error_burst(seed: int) -> ScenarioResult:
+    """Token RPC against a live server under a send-failure burst plus
+    injected latency: failed round-trips surface as STATUS_FAIL (never
+    OK), every request resolves, failure kinds are labeled."""
+    from sentinel_tpu.cluster import constants as C
+    from sentinel_tpu.cluster.client import ClusterTokenClient
+
+    t0 = mono_s()
+    decision, svc, server = _make_token_server(flow_count=3.0)
+    tok = ClusterTokenClient("127.0.0.1", server.port, timeout_ms=3000)
+    tok.reconnect_interval_s = 0.0  # reconnect on every attempt (chaos pace)
+    tok.start()
+    metrics = MetricsDelta()
+    session = _Session()
+    n = 12
+    burst = (2, 2)  # send-site hit indices [2, 4) raise
+    plan = FaultPlan(
+        name="rpc_error_burst",
+        seed=seed,
+        faults=[
+            FaultSpec(
+                "cluster.rpc.send", "raise",
+                burst_start=burst[0], burst_len=burst[1], exc="OSError",
+            ),
+            FaultSpec(
+                "cluster.rpc.send", "delay",
+                every_nth=5, delay_ms=2.0, max_fires=2,
+            ),
+        ],
+    )
+    try:
+        with session.window(plan):
+            results = [tok.request_token(101) for _ in range(n)]
+    finally:
+        tok.close()
+        server.stop()
+        decision.stop()
+
+    counts = {"requests": n, "ok": 0, "blocked": 0, "failed": 0, "other": 0}
+    degraded_passes = 0
+    for i, r in enumerate(results):
+        if r.status == C.STATUS_OK:
+            counts["ok"] += 1
+            if burst[0] <= i < burst[0] + burst[1]:
+                degraded_passes += 1  # an injected failure must not grant
+        elif r.status == C.STATUS_BLOCKED:
+            counts["blocked"] += 1
+        elif r.status == C.STATUS_FAIL:
+            counts["failed"] += 1
+        else:
+            counts["other"] += 1
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=decision,
+        submitted=n,
+        passed=counts["ok"],
+        blocked=counts["blocked"],
+        degraded=counts["failed"] + counts["other"],
+        degraded_passes=degraded_passes,
+        injected=session.injected,
+        expect_injected={
+            "cluster.rpc.send:raise": burst[1],
+            "cluster.rpc.send:delay": 2,
+        },
+        extra={
+            "token_counts": counts,
+            "expect_token_failures": burst[1],
+            "expect_metric_deltas": {
+                'sentinel_cluster_rpc_failures_total{kind="send"}': burst[1],
+            },
+        },
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "token-conservation",
+            "no-degraded-pass",
+            "metric-deltas",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    return _result("rpc_error_burst", seed, session, verdicts, t0)
+
+
+def _scn_cluster_partition(seed: int) -> ScenarioResult:
+    """A cluster-mode SentinelClient loses its token server mid-traffic:
+    it must degrade to local enforcement of fallback-enabled rules (one
+    enter), hold the cooldown, and exit on the first healthy probe."""
+    from sentinel_tpu.cluster.state import ClusterStateManager
+    from sentinel_tpu.core import rules as R
+
+    t0 = mono_s()
+    decision, svc, server = _make_token_server(flow_count=100.0)
+    sm = ClusterStateManager()
+    # generous RPC timeout: the scenario injects failures explicitly and
+    # must never pick up an accidental timeout on a loaded CI box
+    sm.client_config.request_timeout_ms = 5000
+    sm.set_to_client("127.0.0.1", server.port)
+    sm.token_service().reconnect_interval_s = 0.0
+    main = _make_client()
+    main.set_cluster(sm)
+    # cooldown far beyond the scenario's span: the degraded phase NEVER
+    # probes on its own; the heal step expires the cooldown explicitly so
+    # the probe lands on a deterministic entry (no wall-clock sleep race)
+    main.cluster_retry_interval_s = 300.0
+    main.flow_rules.load(
+        [
+            R.FlowRule(
+                resource="res-101",
+                count=2.0,  # local-fallback budget while degraded
+                cluster_mode=True,
+                cluster_flow_id=101,
+                cluster_fallback_to_local=True,
+            )
+        ]
+    )
+    metrics = MetricsDelta()
+    session = _Session()
+    # healthy phase drives exactly 3 send-site hits, so the raise lands
+    # on hit 3 — the first partition-phase round-trip
+    plan = FaultPlan(
+        name="cluster_partition",
+        seed=seed,
+        faults=[
+            FaultSpec(
+                "cluster.rpc.send", "raise",
+                burst_start=3, burst_len=1, max_fires=1, exc="ConnectionResetError",
+            )
+        ],
+    )
+    totals = {"passed": 0, "blocked": 0}
+    try:
+        with session.window(plan):
+            for phase_n in (3, 1, 3):  # healthy, partition hit, degraded local
+                got = _drain_entries(main, "res-101", phase_n)
+                totals["passed"] += got["passed"]
+                totals["blocked"] += got["blocked"]
+            # heal: expire the (mono_s-based) cooldown so the very next
+            # entry probes the live server and must exit degraded
+            with main._cluster_lock:
+                main._cluster_degraded_until = 0.0
+            got = _drain_entries(main, "res-101", 1)
+            totals["passed"] += got["passed"]
+            totals["blocked"] += got["blocked"]
+    finally:
+        main.stop()
+        sm.stop()
+        server.stop()
+        decision.stop()
+
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=main,
+        submitted=8,
+        passed=totals["passed"],
+        blocked=totals["blocked"],
+        injected=session.injected,
+        expect_injected={"cluster.rpc.send:raise": 1},
+        extra={
+            "expect_degrade_enters": 1,
+            "expect_metric_deltas": {
+                'sentinel_cluster_rpc_failures_total{kind="send"}': 1,
+                'sentinel_cluster_rpc_failures_total{kind="connect"}': 0,
+                'sentinel_cluster_rpc_failures_total{kind="timeout"}': 0,
+            },
+        },
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "degrade-hysteresis",
+            "metric-deltas",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    return _result("cluster_partition", seed, session, verdicts, t0)
+
+
+def _scn_resolver_exception(seed: int) -> ScenarioResult:
+    """Verdict readback raises inside the resolve path: the affected
+    ticks must fail CLOSED (system block) with no stranded futures and
+    no hung pipeline — the _fail_tick contract."""
+    from sentinel_tpu.core import errors as ERR
+
+    t0 = mono_s()
+    client = _make_client()
+    resource = "chaos/resolver"
+    client.registry.resource_id(resource)
+    # prime one tick outside the plan so XLA compile cost and the warmup
+    # resolve don't shift the armed hit indices
+    f = client.submit_acquire(resource)
+    if f is not None:
+        f.result(timeout=60.0)
+    metrics = MetricsDelta()
+    session = _Session()
+    n, nth, fires = 12, 3, 3
+    plan = FaultPlan(
+        name="resolver_exception",
+        seed=seed,
+        faults=[
+            FaultSpec(
+                "runtime.resolve.readback", "raise",
+                every_nth=nth, max_fires=fires, exc="RuntimeError",
+            )
+        ],
+    )
+    futures = []
+    try:
+        with session.window(plan):
+            for _ in range(n):
+                futures.append(client.submit_acquire(resource))
+            results = [f.result(timeout=60.0) for f in futures]
+    finally:
+        client.stop()
+    passed = sum(1 for v, _w in results if v in (ERR.PASS, ERR.PASS_WAIT))
+    blocked = len(results) - passed
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=client,
+        submitted=n,
+        passed=passed,
+        blocked=blocked,
+        futures=futures,
+        injected=session.injected,
+        expect_injected={"runtime.resolve.readback:raise": fires},
+        extra={
+            "expect_metric_deltas": {
+                "sentinel_resolve_failures_total": fires,
+            },
+        },
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "no-stranded-futures",
+            "metric-deltas",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    if blocked != fires:
+        verdicts.append(
+            Verdict(
+                "fail-closed-count",
+                False,
+                f"blocked={blocked}, expected exactly the {fires} injected ticks",
+            )
+        )
+    return _result("resolver_exception", seed, session, verdicts, t0)
+
+
+def _scn_seg_overflow_storm(seed: int) -> ScenarioResult:
+    """Fail-closed segment-capacity overflow: a storm of distinct keys
+    exceeds seg_u while the FIRST grow-and-swap attempt is made to fail
+    (injected raise) — overflow items must fail CLOSED and be counted,
+    serving must continue on the old capacity, and the next storm's
+    retry resize must succeed and stop the drops.  Runs the fused/
+    segment engine in interpret mode — the runner executes it under
+    jax.disable_jit (see run_scenario)."""
+    import numpy as np
+
+    from sentinel_tpu.core import errors as ERR
+    from sentinel_tpu.core.config import small_engine_config
+
+    t0 = mono_s()
+    cfg = small_engine_config(
+        max_resources=256,  # room for 64 distinct storm keys + reserved rows
+        max_nodes=512,
+        use_mxu_tables=True,
+        fused_effects=True,
+        seg_effects=True,
+        seg_fallback=False,
+        seg_u=16,
+        batch_size=64,
+        complete_batch_size=64,
+    )
+    client = _make_client(cfg=cfg, entry_timeout_s=120.0)
+    rids = np.asarray(
+        [client.registry.resource_id(f"chaos/seg{i:02d}") for i in range(64)],
+        np.int32,
+    )
+    metrics = MetricsDelta()
+    session = _Session()
+    # first resize attempt dies mid-compile; the storm's overflow then
+    # drops fail-closed on the undersized engine.  The NEXT overflow
+    # retries the resize (only delayed this time) and recovers.
+    plan = FaultPlan(
+        name="seg_overflow_storm",
+        seed=seed,
+        faults=[
+            FaultSpec(
+                "runtime.seg.resize", "raise",
+                burst_start=0, burst_len=1, exc="RuntimeError",
+            ),
+            FaultSpec("runtime.seg.resize", "delay", delay_ms=1.0),
+        ],
+    )
+    counts = {"passed": 0, "blocked": 0}
+    storm2 = {"passed": 0, "blocked": 0}
+    try:
+        with session.window(plan):
+            for storm, acc in ((0, counts), (1, storm2)):
+                v, _w = client.check_batch_ids(rids, timeout_s=120.0)
+                acc["passed"] += int((v == ERR.PASS).sum()) + int(
+                    (v == ERR.PASS_WAIT).sum()
+                )
+                acc["blocked"] += int(
+                    ((v != ERR.PASS) & (v != ERR.PASS_WAIT)).sum()
+                )
+    finally:
+        client.stop()
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=client,
+        submitted=128,
+        passed=counts["passed"] + storm2["passed"],
+        blocked=counts["blocked"] + storm2["blocked"],
+        injected=session.injected,
+        expect_injected={
+            "runtime.seg.resize:raise": 1,
+            "runtime.seg.resize:delay": 2,
+        },
+        extra={
+            "expect_seg_drops": True,
+            "expect_metric_deltas": {"sentinel_seg_resizes_total": 2},
+        },
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "seg-drops-counted",
+            "metric-deltas",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    if storm2["blocked"]:
+        verdicts.append(
+            Verdict(
+                "post-resize-capacity",
+                False,
+                f"{storm2['blocked']} drops AFTER the seg_u grow-and-swap",
+            )
+        )
+    return _result("seg_overflow_storm", seed, session, verdicts, t0)
+
+
+def _scn_datasource_flap(seed: int) -> ScenarioResult:
+    """The rule-file refresh loop faults for a burst: the loaded rule set
+    must hold (enforcement unchanged), and the first healthy refresh must
+    apply the update that accumulated during the flap."""
+    import json as _json
+
+    from sentinel_tpu.core import rules as R
+    from sentinel_tpu.datasource.base import FileRefreshableDataSource
+
+    t0 = mono_s()
+    client = _make_client()
+    vt = client.time
+    resource = "chaos/ds"
+
+    def parser(s):
+        return [R.FlowRule(resource=resource, count=float(_json.loads(s)["count"]))]
+
+    fd, path = tempfile.mkstemp(prefix="sentinel_chaos_rules_", suffix=".json")
+    os.close(fd)
+    ds = None
+    metrics = MetricsDelta()
+    session = _Session()
+    plan = FaultPlan(
+        name="datasource_flap",
+        seed=seed,
+        faults=[
+            FaultSpec(
+                "datasource.refresh.read", "raise",
+                burst_start=0, burst_len=3, exc="OSError",
+            )
+        ],
+    )
+    totals = {"passed": 0, "blocked": 0}
+    extra = {}
+    try:
+        with open(path, "w") as f:
+            f.write('{"count": 2}')
+        # refresh_ms is huge: the daemon poll never fires; the scenario
+        # calls refresh() itself so hit indices are exact
+        ds = FileRefreshableDataSource(path, parser, refresh_ms=3_600_000)
+        client.flow_rules.register_property(ds.get_property())
+        with session.window(plan):
+            got = _drain_entries(client, resource, 4)  # limit 2 -> 2/2
+            totals["passed"] += got["passed"]
+            totals["blocked"] += got["blocked"]
+            with open(path, "w") as f:
+                f.write('{"count": 5}')
+            for _ in range(3):  # faulted refreshes: rules must hold
+                ds.refresh()
+            intact = [r.count for r in client.flow_rules.get()] == [2.0]
+            vt.advance(1100)
+            got = _drain_entries(client, resource, 4)
+            intact = intact and got == {"passed": 2, "blocked": 2}
+            extra["rules_intact_during_fault"] = intact
+            totals["passed"] += got["passed"]
+            totals["blocked"] += got["blocked"]
+            ds.refresh()  # healed: the count-5 update applies
+            extra["rules_updated_after_heal"] = [
+                r.count for r in client.flow_rules.get()
+            ] == [5.0]
+            vt.advance(1100)
+            got = _drain_entries(client, resource, 6)  # limit 5 -> 5/1
+            totals["passed"] += got["passed"]
+            totals["blocked"] += got["blocked"]
+    finally:
+        if ds is not None:
+            ds.close()
+        os.unlink(path)
+        client.stop()
+    extra["expect_metric_deltas"] = {}
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=client,
+        submitted=14,
+        passed=totals["passed"],
+        blocked=totals["blocked"],
+        injected=session.injected,
+        expect_injected={"datasource.refresh.read:raise": 3},
+        extra=extra,
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "rules-intact",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    return _result("datasource_flap", seed, session, verdicts, t0)
+
+
+def _scn_shard_reconnect(seed: int) -> ScenarioResult:
+    """Mid-window shard partition: with chunks pipelined, the transport
+    dies between dispatch and reply.  Answered chunks keep their remote
+    verdicts, written-but-unanswered chunks degrade to the fallback, the
+    shard host never sees a chunk twice, and a later batch reconnects."""
+    from sentinel_tpu.parallel.remote_shard import RemoteShard
+
+    t0 = mono_s()
+    decision, svc, server = _make_token_server(flow_count=100.0)
+    fallback = _make_client()
+    shard = RemoteShard(
+        "127.0.0.1",
+        server.port,
+        timeout_s=2.0,
+        fallback=fallback,
+        retry_interval_s=0.1,
+    )
+    shard.CHUNK = 4
+    names = [f"chaos/shard{i}" for i in range(12)]
+    metrics = MetricsDelta()
+    session = _Session()
+    observe = FaultPlan(name="observe", seed=seed, faults=[])
+    partition = FaultPlan(
+        name="partition",
+        seed=seed,
+        faults=[FaultSpec("parallel.shard.recv", "drop", max_fires=1)],
+    )
+    results = {}
+    server_hits = 0
+
+    def _await_server_chunks(st, want: int):
+        # the server processes written chunks asynchronously (worker
+        # pool); the count converges — only its final value is asserted
+        deadline = mono_s() + 10.0
+        while st.hit_counts().get("cluster.server.process", 0) < want:
+            if mono_s() > deadline:
+                break
+            _time.sleep(0.01)
+        return st.hit_counts().get("cluster.server.process", 0)
+
+    try:
+        with session.window(observe) as st:
+            results["a"] = shard.check_batch(names)  # 3 chunks answered
+            server_hits += _await_server_chunks(st, 3)
+        with session.window(partition) as st:
+            # chunks dispatched, then the first reply read is dropped ->
+            # peer-closed -> all in-flight chunks forfeited, no replay
+            results["b"] = shard.check_batch(names)
+            server_hits += _await_server_chunks(st, 3)
+        _time.sleep(0.15)  # past retry_interval_s: the shard may reconnect
+        with session.window(observe) as st:
+            results["c"] = shard.check_batch(names[:4])  # 1 chunk, remote again
+            server_hits += _await_server_chunks(st, 1)
+    finally:
+        shard.close()
+        fallback.stop()
+        server.stop()
+        decision.stop()
+
+    from sentinel_tpu.core import errors as ERR
+
+    submitted = sum(len(v) for v in results.values())
+    passed = sum(
+        1
+        for out in results.values()
+        for v, _w in out
+        if v in (ERR.PASS, ERR.PASS_WAIT)
+    )
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=fallback,
+        submitted=submitted,
+        passed=passed,
+        blocked=submitted - passed,
+        injected=session.injected,
+        expect_injected={"parallel.shard.recv:drop": 1},
+        extra={
+            "chunks_written": 7,  # 3 + 3 + 1
+            "server_chunks_processed": server_hits,
+            "expect_metric_deltas": {
+                "sentinel_shard_chunks_total": 4,
+                "sentinel_shard_chunks_degraded_total": 3,
+            },
+        },
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "no-chunk-replay",
+            "metric-deltas",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    return _result("shard_reconnect", seed, session, verdicts, t0)
+
+
+def _result(name, seed, session, verdicts, t0) -> ScenarioResult:
+    return ScenarioResult(
+        name=name,
+        seed=seed,
+        ok=all(v.ok for v in verdicts),
+        injected=dict(sorted(session.injected.items())),
+        verdicts=verdicts,
+        duration_s=mono_s() - t0,
+    )
+
+
+# -- registry ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    fn: Callable[[int], ScenarioResult]
+    description: str
+    fast: bool = True  # tier-1 CI subset member
+    eager: bool = False  # run under jax.disable_jit (interpret-mode Pallas)
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        Scenario(
+            "rpc_error_burst",
+            _scn_rpc_error_burst,
+            "token RPC send-failure + latency burst against a live server",
+            fast=False,
+        ),
+        Scenario(
+            "cluster_partition",
+            _scn_cluster_partition,
+            "token-server partition: degrade to local, hold, heal, exit",
+        ),
+        Scenario(
+            "resolver_exception",
+            _scn_resolver_exception,
+            "verdict readback raises; ticks fail closed, nothing strands",
+        ),
+        Scenario(
+            "seg_overflow_storm",
+            _scn_seg_overflow_storm,
+            "fail-closed segment overflow + live seg_u grow-and-swap",
+            fast=False,
+            eager=True,
+        ),
+        Scenario(
+            "datasource_flap",
+            _scn_datasource_flap,
+            "rule-file refresh faults; rules hold, post-heal update applies",
+        ),
+        Scenario(
+            "shard_reconnect",
+            _scn_shard_reconnect,
+            "mid-window shard partition: degrade forfeited chunks, no replay",
+        ),
+    )
+}
+
+
+def run_scenario(name: str, seed: int) -> ScenarioResult:
+    scn = SCENARIOS[name]
+    if scn.eager:
+        import jax
+
+        with jax.disable_jit():
+            return scn.fn(seed)
+    return scn.fn(seed)
+
+
+def run_all(
+    seed: int, names: Optional[List[str]] = None, fast_only: bool = False
+) -> List[ScenarioResult]:
+    picked = names or [
+        n for n, s in SCENARIOS.items() if (s.fast or not fast_only)
+    ]
+    return [run_scenario(n, seed) for n in picked]
+
+
+def report(results: List[ScenarioResult], as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps([r.to_dict() for r in results], indent=2, sort_keys=True)
+    lines = []
+    for r in results:
+        mark = "PASS" if r.ok else "FAIL"
+        lines.append(f"[{mark}] {r.name} (seed={r.seed}, {r.duration_s:.2f}s)")
+        inj = ", ".join(f"{k}={v}" for k, v in sorted(r.injected.items())) or "none"
+        lines.append(f"       injected: {inj}")
+        for v in r.verdicts:
+            lines.append(
+                f"       {'ok ' if v.ok else 'RED'} {v.name}"
+                + (f" — {v.detail}" if (v.detail and not v.ok) else "")
+            )
+    total = sum(1 for r in results if r.ok)
+    lines.append(f"{total}/{len(results)} scenarios green")
+    return "\n".join(lines)

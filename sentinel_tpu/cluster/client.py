@@ -18,6 +18,7 @@ from concurrent.futures import CancelledError, Future
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Any, Dict, List, Optional
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
@@ -30,9 +31,40 @@ _H_RPC = _OBS.histogram(
     "token-server request/response round-trip (successful responses only; "
     "failures count in sentinel_cluster_rpc_failures_total)",
 )
-_C_RPC_FAIL = _OBS.counter(
-    "sentinel_cluster_rpc_failures_total",
-    "token-server round-trips that degraded (transport failure or timeout)",
+# degraded round-trips, labeled by failure KIND so chaos scenarios (and
+# operators) can assert WHICH fault fired instead of reading one lump:
+#   connect   — could not (re)establish the server connection
+#   send      — the request write failed mid-frame
+#   timeout   — no response within timeout_ms (includes server-side drops
+#               of malformed/corrupted frames, whose xid never resolves)
+#   conn_lost — the connection died while the request was in flight
+#   decode    — a response frame arrived but failed to parse (the caller
+#               still times out, counted separately under `timeout`)
+_RPC_FAIL_HELP = (
+    "token-server round-trips that degraded, by failure kind "
+    "(connect|send|timeout|conn_lost|decode)"
+)
+_C_RPC_FAIL = {
+    k: _OBS.counter(
+        "sentinel_cluster_rpc_failures_total", _RPC_FAIL_HELP, labels={"kind": k}
+    )
+    for k in ("connect", "send", "timeout", "conn_lost", "decode")
+}
+
+#: chaos failpoints (chaos/failpoints.py) on the round-trip path — the
+#: exact points a real transport fault strikes, one flag check disarmed
+_FP_CONNECT = FP.register(
+    "cluster.rpc.connect", "token-server TCP connect", FP.HIT_ACTIONS
+)
+_FP_SEND = FP.register(
+    "cluster.rpc.send",
+    "token-server request frame write (per round-trip)",
+    FP.PIPE_ACTIONS,
+)
+_FP_RECV = FP.register(
+    "cluster.rpc.recv",
+    "token-server response bytes (reader thread)",
+    FP.PIPE_ACTIONS,
 )
 
 #: sentinel returned by _roundtrip for requests that can never be encoded
@@ -96,8 +128,17 @@ class ClusterTokenClient(TokenService):
                 return False
             self._last_attempt = now
             try:
+                FP.hit(_FP_CONNECT)
                 s = socket.create_connection((self.host, self.port), timeout=2.0)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # the CONNECT timeout must not linger as a read deadline:
+                # create_connection leaves it on the socket, and a server
+                # quiet for 2 s (first-tick XLA compile, idle lulls) would
+                # time out the reader thread's recv and tear down a
+                # HEALTHY connection (found by the chaos harness, scenario
+                # cluster_partition).  Response waits are bounded by the
+                # per-request future timeout, not the socket.
+                s.settimeout(None)
             except OSError:
                 return False
             self._sock = s
@@ -135,10 +176,16 @@ class ClusterTokenClient(TokenService):
                 data = s.recv(4096)
                 if not data:
                     break
+                # chaos: drop => treated as peer-close, corrupt/short-read
+                # => decode failures / frame desync below
+                data = FP.pipe(_FP_RECV, data)
+                if not data:
+                    break
                 for body in frames.feed(data):
                     try:
                         rsp = P.decode_response(body)
                     except (ValueError, struct.error):
+                        _C_RPC_FAIL["decode"].inc()
                         continue  # malformed frame; xid never resolves -> caller times out to STATUS_FAIL
                     f = self._pending.pop(rsp.xid, None)
                     if f is not None and not f.done():
@@ -159,7 +206,7 @@ class ClusterTokenClient(TokenService):
 
     def _roundtrip(self, req: P.ClusterRequest) -> Optional[P.ClusterResponse]:
         if not self._ensure_connected():
-            _C_RPC_FAIL.inc()
+            _C_RPC_FAIL["connect"].inc()
             return None
         try:
             raw = P.encode_request(req)
@@ -172,12 +219,15 @@ class ClusterTokenClient(TokenService):
             s = self._sock
             if s is None:
                 raise OSError("not connected")
+            # chaos: raise => this send path's degrade; drop/corrupt =>
+            # the server never answers this xid => timeout kind
+            raw = FP.pipe(_FP_SEND, raw)
             with self._send_lock:
                 s.sendall(raw)
         except OSError:
             self._pending.pop(req.xid, None)
             self._teardown()
-            _C_RPC_FAIL.inc()
+            _C_RPC_FAIL["send"].inc()
             if _t:
                 # failures skip the latency histogram (a timeout-ceiling
                 # sample would corrupt the success-path percentiles; the
@@ -189,12 +239,12 @@ class ClusterTokenClient(TokenService):
             rsp = f.result(timeout=self.timeout_ms / 1000.0)
         except (_FutTimeout, CancelledError):
             self._pending.pop(req.xid, None)
-            _C_RPC_FAIL.inc()
+            _C_RPC_FAIL["timeout"].inc()
             if _t:
                 OT.stage("cluster.rpc", _t, attrs={"type": req.type, "ok": False})
             return None  # -> STATUS_FAIL at the TokenService surface (degrade, never PASS)
         if rsp is None:
-            _C_RPC_FAIL.inc()  # connection died mid-wait (_teardown resolved us)
+            _C_RPC_FAIL["conn_lost"].inc()  # connection died mid-wait (_teardown resolved us)
         if _t:
             OT.stage(
                 "cluster.rpc", _t, _H_RPC if rsp is not None else None,

@@ -37,6 +37,46 @@ from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster.rules import flow_resource, param_resource
 from sentinel_tpu.core import errors as ERR
 from sentinel_tpu.native.loader import load_native
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
+
+#: param rules the ENGINE cannot enforce on any transport (no hash lane
+#: for their param_idx) — the log warning alone was invisible to
+#: monitoring; this makes the misconfiguration a /metrics fact.  Counts
+#: SIGHTINGS: every rule-map rebuild that still carries the bad rule
+#: increments, so a non-flat curve means the condition persists.
+_C_UNENFORCEABLE = _OBS.counter(
+    "sentinel_front_door_unenforceable_rules",
+    "param rules seen without a hash lane for their param_idx (engine "
+    "cannot enforce them); incremented per rule-map rebuild",
+)
+
+
+def resolve_param_lane(service, fid: int, name: str):
+    """Hash lane for a decision param rule, or None when the C ring can't
+    serve it.  Lane-less rules (engine-unenforceable) warn AND count in
+    ``sentinel_front_door_unenforceable_rules``; lane>1 rules only warn —
+    the asyncio server still enforces those."""
+    lane = service.client.param_lane(name, 0)
+    if lane is not None and lane <= 1:
+        return lane
+    from sentinel_tpu.utils.record_log import record_log
+
+    if lane is None:
+        # no hash lane at all: the ENGINE cannot enforce this rule on any
+        # transport — a misconfiguration, not a front-door limitation
+        _C_UNENFORCEABLE.inc()
+        record_log().warning(
+            "front door: param rule %s on %r has no hash lane for "
+            "param_idx 0 — the rule is not enforceable (raise param_dims "
+            "or consolidate indices)", fid, name,
+        )
+    else:
+        record_log().warning(
+            "front door: param rule %s on %r maps to lane %d (ring "
+            "carries lanes 0-1); served by the asyncio server only",
+            fid, name, lane,
+        )
+    return None
 
 
 class NativeFrontDoor:
@@ -127,26 +167,8 @@ class NativeFrontDoor:
                 # wherever the compile assigned idx 0.  The C ring carries
                 # two hash lanes, and sx_front_map_param rejects lane>1 —
                 # such rules keep flowing through the asyncio server
-                lane = service.client.param_lane(name, 0)
-                if lane is None or lane > 1:
-                    from sentinel_tpu.utils.record_log import record_log
-
-                    if lane is None:
-                        # no hash lane at all: the ENGINE cannot enforce
-                        # this rule on any transport — a misconfiguration,
-                        # not a front-door limitation
-                        record_log().warning(
-                            "front door: param rule %s on %r has no hash "
-                            "lane for param_idx 0 — the rule is not "
-                            "enforceable (raise param_dims or consolidate "
-                            "indices)", fid, name,
-                        )
-                    else:
-                        record_log().warning(
-                            "front door: param rule %s on %r maps to lane "
-                            "%d (ring carries lanes 0-1); served by the "
-                            "asyncio server only", fid, name, lane,
-                        )
+                lane = resolve_param_lane(service, fid, name)
+                if lane is None:
                     continue
                 self.map_param(fid, row, lane)
 

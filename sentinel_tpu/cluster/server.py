@@ -20,11 +20,20 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.utils.time_source import mono_s
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
 from sentinel_tpu.utils.record_log import record_log
+
+#: chaos failpoint covering server-side request processing (worker-pool
+#: types incl. RES_CHECK shard chunks); a raise converts to STATUS_FAIL.
+#: Its HIT COUNT doubles as the chaos harness's server-side "chunks
+#: processed" probe — the no-replay invariant reads it.
+_FP_PROCESS = FP.register(
+    "cluster.server.process", "token server request processing", FP.HIT_ACTIONS
+)
 
 
 class ConnectionManager:
@@ -274,6 +283,7 @@ class ClusterTokenServer:
 
     def _process(self, req: P.ClusterRequest) -> P.ClusterResponse:
         try:
+            FP.hit(_FP_PROCESS)
             t = req.type
             if t == C.MSG_TYPE_FLOW:
                 r = self.service.request_token(req.flow_id, req.count, req.priority)

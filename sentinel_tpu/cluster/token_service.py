@@ -29,6 +29,7 @@ import time as _time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster.rules import (
     ClusterFlowRuleManager,
@@ -53,6 +54,13 @@ _C_DECISIONS = _OBS.counter(
 _C_SHED = _OBS.counter(
     "sentinel_token_shed_total",
     "token requests shed before the engine (namespace guard or backpressure)",
+)
+
+#: chaos failpoint on the decision path: a raise here exercises every
+#: caller's STATUS_FAIL conversion (request_token's catch, the TCP
+#: server's _flow_and_reply/_process catches) — degrade, never PASS
+_FP_DECIDE = FP.register(
+    "cluster.token.decide", "token service decision entry", FP.HIT_ACTIONS
 )
 
 
@@ -301,6 +309,7 @@ class DefaultTokenService(TokenService):
         decision engine's micro-batches (the TPU-native shape)."""
         from concurrent.futures import Future as _F
 
+        FP.hit(_FP_DECIDE)
         done = _F()
         rule = self.flow_rules.get_by_id(flow_id)
         if rule is None:
@@ -359,6 +368,7 @@ class DefaultTokenService(TokenService):
         """Partial grant: `units` unit-acquires coalesce into one engine
         micro-batch; granted = how many passed (within-tick prefix-sum
         admission makes this bit-exact with sequential acquisition)."""
+        FP.hit(_FP_DECIDE)
         rule = self.flow_rules.get_by_id(flow_id)
         if rule is None:
             return TokenResult(C.STATUS_NO_RULE)
@@ -378,6 +388,7 @@ class DefaultTokenService(TokenService):
         return TokenResult(C.STATUS_OK, remaining=granted, wait_ms=wait)
 
     def request_param_token(self, flow_id: int, count: int, params: List[Any]) -> TokenResult:
+        FP.hit(_FP_DECIDE)
         rule = self.param_rules.get_by_id(flow_id)
         if rule is None:
             return TokenResult(C.STATUS_NO_RULE)

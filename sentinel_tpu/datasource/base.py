@@ -16,6 +16,7 @@ import os
 import threading
 from typing import Callable, Generic, Optional, TypeVar
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.datasource.property import DynamicSentinelProperty, SentinelProperty
 
 S = TypeVar("S")
@@ -26,6 +27,16 @@ Converter = Callable[[S], T]
 
 MAX_FILE_SIZE = 4 * 1024 * 1024
 DEFAULT_REFRESH_MS = 3000
+
+#: chaos failpoints: a raise on ``refresh.read`` rides the poll loop's
+#: existing catch (rules stay, on_refresh_failed re-arms); ``file.read``
+#: strikes inside read_source so first loads degrade too
+_FP_REFRESH = FP.register(
+    "datasource.refresh.read", "auto-refresh poll iteration", FP.HIT_ACTIONS
+)
+_FP_FILE_READ = FP.register(
+    "datasource.file.read", "rule file read", FP.HIT_ACTIONS
+)
 
 
 class ReadableDataSource(Generic[S, T]):
@@ -89,6 +100,7 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
     def refresh(self) -> bool:
         """One poll iteration; exposed for deterministic tests."""
         try:
+            FP.hit(_FP_REFRESH)
             if not self.is_modified():
                 return False
             new_value = self.load_config()
@@ -143,6 +155,7 @@ class FileRefreshableDataSource(AutoRefreshDataSource[str, T]):
             self.on_refresh_failed()  # re-arm mtime so the poll loop retries
 
     def read_source(self) -> str:
+        FP.hit(_FP_FILE_READ)
         size = os.path.getsize(self.path)
         if size > self.max_size:
             raise ValueError(

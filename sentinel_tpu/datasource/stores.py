@@ -38,7 +38,14 @@ import urllib.request
 from hashlib import md5
 from typing import List, Optional
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.datasource.base import AbstractDataSource, AutoRefreshDataSource, Converter
+
+#: chaos failpoint: a raise inside the long-poll/watch loop exercises the
+#: error-backoff path of every push-style store binding
+_FP_WATCH = FP.register(
+    "datasource.store.watch", "push-store long-poll/watch iteration", FP.HIT_ACTIONS
+)
 
 
 def _get(url: str, timeout: float, headers: Optional[dict] = None) -> bytes:
@@ -81,6 +88,7 @@ class _PushLoopDataSource(AbstractDataSource):
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
+                FP.hit(_FP_WATCH)
                 changed = self._wait_for_change()
                 if self._stop.is_set():
                     return

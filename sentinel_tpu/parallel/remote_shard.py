@@ -22,6 +22,7 @@ import struct
 import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.core import errors as ERR
@@ -40,6 +41,19 @@ _C_CHUNKS = _OBS.counter(
 _C_CHUNKS_DEGRADED = _OBS.counter(
     "sentinel_shard_chunks_degraded_total",
     "remote-shard chunks that fell back locally (unreachable / forfeited / unencodable)",
+)
+
+#: chaos failpoints on the shard transport — mid-window partitions land
+#: here (a recv `drop` reads as peer-close; send `drop`/`corrupt` leaves
+#: the chunk unanswered until the socket timeout)
+_FP_CONNECT = FP.register(
+    "parallel.shard.connect", "shard host TCP connect", FP.HIT_ACTIONS
+)
+_FP_SEND = FP.register(
+    "parallel.shard.send", "shard RES_CHECK chunk frame write", FP.PIPE_ACTIONS
+)
+_FP_RECV = FP.register(
+    "parallel.shard.recv", "shard response bytes (per recv call)", FP.PIPE_ACTIONS
 )
 
 
@@ -65,6 +79,7 @@ class RemoteShard:
     # -- connection ----------------------------------------------------------
 
     def _connect(self) -> socket.socket:
+        FP.hit(_FP_CONNECT)
         s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
         s.settimeout(self.timeout_s)
         return s
@@ -83,14 +98,14 @@ class RemoteShard:
         transport trouble (caller degrades)."""
         head = b""
         while len(head) < 2:
-            chunk = s.recv(2 - len(head))
+            chunk = FP.pipe(_FP_RECV, s.recv(2 - len(head)))
             if not chunk:
                 raise OSError("peer closed")
             head += chunk
         (n,) = struct.unpack(">H", head)
         body = b""
         while len(body) < n:
-            chunk = s.recv(n - len(body))
+            chunk = FP.pipe(_FP_RECV, s.recv(n - len(body)))
             if not chunk:
                 raise OSError("peer closed")
             body += chunk
@@ -246,7 +261,7 @@ class RemoteShard:
                         _t = OT.t0()
                         if _t:
                             t_sent[i] = _t
-                        s.sendall(wires[i])
+                        s.sendall(FP.pipe(_FP_SEND, wires[i]))
                     while inflight:
                         rsp = self._read_response(s)
                         i = inflight.pop(0)
@@ -268,7 +283,7 @@ class RemoteShard:
                             _t = OT.t0()
                             if _t:
                                 t_sent[j] = _t
-                            s.sendall(wires[j])
+                            s.sendall(FP.pipe(_FP_SEND, wires[j]))
                     return rsps
                 except OSError:
                     self._close()

@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.core import errors as ERR
 from sentinel_tpu.core import rules as R
 from sentinel_tpu.core.config import EngineConfig
@@ -112,6 +113,27 @@ _C_DEGRADE_EXIT = OBS.counter(
 )
 _C_SEG_RESIZE = OBS.counter(
     "sentinel_seg_resizes_total", "seg_u capacity grow-and-hot-swap events"
+)
+_C_RESOLVE_FAILED = OBS.counter(
+    "sentinel_resolve_failures_total",
+    "tick resolutions that raised; their items failed CLOSED (system block)",
+)
+
+#: chaos failpoints (chaos/failpoints.py) on the tick loop's own failure
+#: surfaces — one flag check per site when disarmed
+_FP_TICK_CLOCK = FP.register(
+    "runtime.tick.clock", "engine tick timestamp (skew shifts windows)",
+    FP.SKEW_ACTIONS,
+)
+_FP_READBACK = FP.register(
+    "runtime.resolve.readback", "verdict device-to-host readback", FP.HIT_ACTIONS
+)
+_FP_FANOUT = FP.register(
+    "runtime.resolve.fanout", "verdict fan-out to futures/blocks/doors",
+    FP.HIT_ACTIONS,
+)
+_FP_SEG_RESIZE = FP.register(
+    "runtime.seg.resize", "background seg_u grow-and-swap compile", FP.HIT_ACTIONS
 )
 
 
@@ -190,6 +212,11 @@ class _PendingTick:
     n_blk: int  # block item count (fronts start at n_obj + n_blk)
     tick_id: int = 0  # obs trace correlation id (0 = tracing disabled)
     dispatched_ns: int = 0  # obs: dispatch-complete stamp for the device span
+    # fan-out progress (count of blocks/fronts fully resolved): a failed
+    # resolve must fail CLOSED only the consumers the normal path hadn't
+    # reached — no double-decrement, no double-respond (_fail_tick)
+    blocks_done: int = 0
+    fronts_done: int = 0
 
 
 class Entry:
@@ -1887,6 +1914,7 @@ class SentinelClient:
         _C_SEG_RESIZE.inc()
         _h = OT.TRACER.begin("engine.seg_resize", seg_u=int(new_u))
         try:
+            FP.hit(_FP_SEG_RESIZE)  # chaos: a raise keeps the old capacity
             feats = self._features
             new_cfg = dataclasses.replace(self.cfg, seg_u=int(new_u))
             new_tick = E.make_tick(new_cfg, donate=True, features=feats)
@@ -2207,6 +2235,7 @@ class SentinelClient:
                 )
         load, cpu = self._sys.sample()
         t = now_ms if now_ms is not None else self.time.now_ms()
+        t += FP.skew_ms(_FP_TICK_CLOCK)  # chaos: deterministic clock skew
         # running average of host batch-build time (assembly + presort +
         # column upload dispatch) — the serial host share of a tick; read
         # via host_build_ms_avg (benchmark decomposition, ops dashboards)
@@ -2264,7 +2293,9 @@ class SentinelClient:
 
     def _drain_resolves(self) -> None:
         """Flush deferred readbacks: pendings not yet handed to the pool,
-        then every in-flight pool resolution (exceptions surface here)."""
+        then every in-flight pool resolution.  _resolve_tick fails its
+        own tick closed instead of raising, so this wait cannot abort
+        mid-drain and strand later ticks."""
         while self._pending_ticks:
             p = self._pending_ticks.pop(0)
             if self._pipeline_depth > 0:
@@ -2280,10 +2311,76 @@ class SentinelClient:
         _G_RESOLVER_Q.set(0)
 
     def _resolve_tick(self, p: _PendingTick) -> None:
-        """Read back one dispatched tick's outputs and fan verdicts out to
-        futures / array blocks / front doors.  May run on a resolver-pool
-        thread; everything it touches is per-tick (futures, disjoint block
-        slices) or lock-protected (drop counters)."""
+        """Read back one dispatched tick's outputs and fan verdicts out —
+        and if ANYTHING in that path raises (backend readback failure,
+        chaos injection), fail the tick CLOSED instead of stranding its
+        futures: every waiting caller gets a system-block verdict
+        immediately rather than an entry_timeout_s hang.  The same
+        degrade-never-break contract the seg-overflow path follows."""
+        try:
+            self._resolve_tick_inner(p)
+        except Exception as exc:  # stlint: disable=fail-open — items fail CLOSED (BLOCK_SYSTEM) below; nothing is admitted or stranded
+            _C_RESOLVE_FAILED.inc()
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().error(
+                "tick resolution failed (%r) — failing %d object / %d block "
+                "item(s) CLOSED",
+                exc,
+                p.n_obj,
+                p.n_blk,
+                exc_info=True,
+            )
+            self._fail_tick(p)
+
+    def _fail_tick(self, p: _PendingTick) -> None:
+        """Resolve every consumer of a failed tick with a fail-closed
+        system-block verdict.  Safe against partial fan-out: futures are
+        done-guarded, and block/front-door slices the normal path already
+        resolved (p.blocks_done / p.fronts_done) are left untouched — no
+        double-decrement of block accounting, no double-respond."""
+        v_fail, w_fail = int(ERR.BLOCK_SYSTEM), 0
+        for r in p.acq:
+            if r.future is not None and not r.future.done():
+                r.future.set_result((v_fail, w_fail))
+        for blk, off, take in p.blocks[p.blocks_done :]:
+            blk.verdicts[off : off + take] = v_fail
+            blk.waits[off : off + take] = w_fail
+            with self._blk_lock:
+                blk.unresolved -= take
+                fire = blk.unresolved <= 0
+            if fire and blk.future is not None and not blk.future.done():
+                blk.future.set_result((blk.verdicts, blk.waits))
+            p.blocks_done += 1
+        if p.fronts_done < len(p.fronts):
+            with self._respond_lock:
+                for door, cols in p.fronts[p.fronts_done :]:
+                    # advance FIRST: a door whose respond fails here
+                    # failed the normal path too — retrying it would
+                    # raise out of the fail-closed handler and strand
+                    # every other pending tick (_drain_resolves aborts)
+                    p.fronts_done += 1
+                    k = len(cols[0])
+                    try:
+                        door.respond(
+                            cols[3],
+                            np.full(k, v_fail, np.int32),
+                            np.zeros(k, np.int32),
+                        )
+                    except Exception:  # stlint: disable=fail-open — the door transport itself is broken; its clients time out while every OTHER consumer still fails closed
+                        from sentinel_tpu.utils.record_log import record_log
+
+                        record_log().error(
+                            "front-door respond failed during fail-closed "
+                            "fan-out; its clients will time out",
+                            exc_info=True,
+                        )
+
+    def _resolve_tick_inner(self, p: _PendingTick) -> None:
+        """The actual readback + fan-out; may run on a resolver-pool
+        thread.  Everything it touches is per-tick (futures, disjoint
+        block slices) or lock-protected (drop counters)."""
+        FP.hit(_FP_READBACK)  # chaos: a raise fails this tick closed
         out = p.out
         # stlint: disable-next-line=host-sync — THE designed readback point (see class docstring)
         verdict = np.asarray(out.verdict)
@@ -2318,6 +2415,7 @@ class SentinelClient:
             wait = np.zeros(verdict.shape[0], np.int32)
         if _t_rb:
             OT.stage("tick.readback", _t_rb, _H_READBACK, trace=p.tick_id)
+        FP.hit(_FP_FANOUT)  # chaos: raise BEFORE any consumer resolves
         _t_res = OT.t0()
         if p.inv_a is not None:
             # map sorted-batch verdicts back to submission order
@@ -2335,6 +2433,7 @@ class SentinelClient:
                 fire = blk.unresolved <= 0
             if fire and blk.future is not None:
                 blk.future.set_result((blk.verdicts, blk.waits))
+            p.blocks_done += 1
             o += take
         if p.fronts:
             off = p.n_obj + p.n_blk
@@ -2346,6 +2445,7 @@ class SentinelClient:
                         verdict[off : off + k].astype(np.int32),
                         wait[off : off + k].astype(np.int32),
                     )
+                    p.fronts_done += 1
                     off += k
         if _t_res:
             OT.stage(

@@ -13,6 +13,14 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from sentinel_tpu.chaos import failpoints as FP
+
+#: chaos failpoint: a raise converts to the command plane's of_failure
+#: response — the "command plane must not crash" contract under test
+_FP_DISPATCH = FP.register(
+    "transport.command.dispatch", "command handler dispatch", FP.HIT_ACTIONS
+)
+
 
 @dataclass
 class CommandRequest:
@@ -71,6 +79,7 @@ class CommandRegistry:
         if entry is None:
             return CommandResponse.of_failure(f"unknown command: {name}")
         try:
+            FP.hit(_FP_DISPATCH)
             return entry[1](request)
         except Exception as e:  # noqa: BLE001 — command plane must not crash
             return CommandResponse.of_failure(f"{type(e).__name__}: {e}")

@@ -16,7 +16,14 @@ import urllib.parse
 import urllib.request
 from typing import List, Optional
 
+from sentinel_tpu.chaos import failpoints as FP
+
 DEFAULT_INTERVAL_S = 10.0
+
+#: chaos failpoint: a raise rides the rotate-on-failure catch below
+_FP_HB_SEND = FP.register(
+    "transport.heartbeat.send", "dashboard heartbeat POST", FP.HIT_ACTIONS
+)
 
 
 def _local_ip() -> str:
@@ -111,6 +118,7 @@ class HeartbeatSender:
         addr = self.addresses[self._idx % len(self.addresses)]
         url = f"http://{addr}/registry/machine"
         try:
+            FP.hit(_FP_HB_SEND)
             from sentinel_tpu.utils.authn import bearer_header
 
             # the custom header doubles as CSRF proof: a cross-site form

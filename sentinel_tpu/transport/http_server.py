@@ -18,9 +18,16 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.transport.command import CommandRegistry, CommandRequest
 
 DEFAULT_PORT = 8719
+
+#: chaos failpoint: a raise aborts just this HTTP exchange (the threading
+#: server's per-connection handler); the command center stays up
+_FP_HTTP_REQ = FP.register(
+    "transport.http.request", "command-center HTTP request service", FP.HIT_ACTIONS
+)
 MAX_PORT_PROBES = 100
 
 
@@ -53,6 +60,7 @@ class _Handler(BaseHTTPRequestHandler):
             for k, v in urllib.parse.parse_qs(body).items():
                 params.setdefault(k, v[-1])
             body = params.get("data", body)
+        FP.hit(_FP_HTTP_REQ)
         rsp = self.registry.handle(name, CommandRequest(parameters=params, body=body))
         if rsp.success:
             if isinstance(rsp.result, str):

@@ -112,6 +112,16 @@ def test_time_source_allowlists_tracer_read_point_only():
     assert _run(TimeSourcePass(), _mod(src, path="sentinel_tpu/obs/trace.py")) == []
     got = _run(TimeSourcePass(), _mod(src, path="sentinel_tpu/obs/registry.py"))
     assert len(got) == 1 and got[0].rule == "time-source"
+    # the chaos failpoint registry is the fault-injection plane's single
+    # sanctioned home for time manipulation (ISSUE 4 satellite): its
+    # delay/clock_skew actions may touch the clock there, and NOWHERE
+    # else in the chaos package
+    assert (
+        _run(TimeSourcePass(), _mod(src, path="sentinel_tpu/chaos/failpoints.py"))
+        == []
+    )
+    got = _run(TimeSourcePass(), _mod(src, path="sentinel_tpu/chaos/runner.py"))
+    assert len(got) == 1 and got[0].rule == "time-source"
     # the REAL tracer module keeps exactly ONE raw-clock call site
     real = os.path.join(REPO_ROOT, "sentinel_tpu", "obs", "trace.py")
     with open(real) as f:
