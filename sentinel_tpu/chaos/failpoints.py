@@ -268,6 +268,15 @@ def _apply(site: str, value):
                 st.events.append((site, s.action, hit_idx - 1))
             if lf.counter is not None:
                 lf.counter.inc()
+            # black-box journal: every injected fault lands in the flight
+            # recorder so a post-mortem bundle shows WHAT was injected
+            # right next to the state transitions it caused.  Lazy import
+            # (armed-only path) keeps the disarmed module import-light.
+            from sentinel_tpu.obs.flight import FLIGHT as _FLIGHT
+
+            _FLIGHT.note(
+                "failpoint.fire", site=site, action=s.action, hit=hit_idx - 1
+            )
             if s.action == "delay":
                 delay_s += s.delay_ms / 1000.0
             elif s.action == "raise":

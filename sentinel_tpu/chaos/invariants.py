@@ -251,7 +251,9 @@ CATALOG: Dict[str, Callable[[ScenarioContext], Verdict]] = {
 
 def evaluate(names: List[str], ctx: ScenarioContext) -> List[Verdict]:
     """Run the named invariants in order; unknown names fail loudly (a
-    scenario typo must not silently skip a safety check)."""
+    scenario typo must not silently skip a safety check).  Any RED
+    verdict triggers a flight-recorder bundle (obs/flight.py) so the
+    state that produced the breach survives for post-mortem."""
     out: List[Verdict] = []
     for n in names:
         chk = CATALOG.get(n)
@@ -262,4 +264,10 @@ def evaluate(names: List[str], ctx: ScenarioContext) -> List[Verdict]:
             out.append(chk(ctx))
         except Exception as e:  # noqa: BLE001 — a crashed monitor is a RED verdict, never a skipped one
             out.append(_v(n, False, f"monitor crashed: {type(e).__name__}: {e}"))
+    breached = [v.name for v in out if not v.ok]
+    if breached:
+        from sentinel_tpu.obs.flight import FLIGHT
+
+        FLIGHT.note("invariant.breach", invariants=breached)
+        FLIGHT.trigger("invariant-breach")
     return out

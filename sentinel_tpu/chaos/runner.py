@@ -169,9 +169,17 @@ def _drain_entries(client, resource: str, n: int) -> Dict[str, int]:
 def _scn_rpc_error_burst(seed: int) -> ScenarioResult:
     """Token RPC against a live server under a send-failure burst plus
     injected latency: failed round-trips surface as STATUS_FAIL (never
-    OK), every request resolves, failure kinds are labeled."""
+    OK), every request resolves, failure kinds are labeled.  After the
+    armed window the scenario loses the server entirely and drives one
+    cluster-mode entry so the runtime's degrade path fires — asserting
+    the flight recorder (obs/flight.py) captured a post-mortem bundle
+    whose journal holds both the injected failpoint fires and the
+    degrade-enter transition."""
     from sentinel_tpu.cluster import constants as C
     from sentinel_tpu.cluster.client import ClusterTokenClient
+    from sentinel_tpu.cluster.state import ClusterStateManager
+    from sentinel_tpu.core import rules as R
+    from sentinel_tpu.obs.flight import FLIGHT
 
     t0 = mono_s()
     decision, svc, server = _make_token_server(flow_count=3.0)
@@ -196,11 +204,57 @@ def _scn_rpc_error_burst(seed: int) -> ScenarioResult:
             ),
         ],
     )
+    flight_detail = "bundle not captured"
+    flight_ok = False
+    sm = None
     try:
         with session.window(plan):
             results = [tok.request_token(101) for _ in range(n)]
-    finally:
+        # -- black-box phase (outside the armed window: injected counts
+        # stay a pure function of the seed).  Kill the server, point the
+        # decision client at the dead port in cluster mode, and drive one
+        # entry: request_token fails -> degrade-to-local -> the flight
+        # recorder triggers a cluster-degrade-enter bundle whose journal
+        # already holds this run's failpoint.fire events.
         tok.close()
+        server.stop()
+        sm = ClusterStateManager()
+        sm.set_to_client("127.0.0.1", server.port)
+        sm.token_service().reconnect_interval_s = 0.0
+        decision.set_cluster(sm)
+        decision.flow_rules.load(
+            [
+                R.FlowRule(
+                    resource="chaos/flight",
+                    count=100.0,
+                    cluster_mode=True,
+                    cluster_flow_id=424242,
+                    cluster_fallback_to_local=True,
+                )
+            ]
+        )
+        FLIGHT.reset_rate_limit()  # a prior scenario's bundle must not mask ours
+        e = decision.try_entry("chaos/flight")
+        if e is not None:
+            e.exit()
+        b = FLIGHT.last_bundle()
+        if b is not None and b["reason"] == "cluster-degrade-enter":
+            kinds = {ev["kind"] for ev in b["journal"]}
+            flight_ok = "failpoint.fire" in kinds and "cluster.degrade.enter" in kinds
+            flight_detail = f"reason={b['reason']} journal_kinds={sorted(kinds)}"
+        elif b is not None:
+            flight_detail = f"unexpected bundle reason {b['reason']!r}"
+    finally:
+        # restore FIRST (even when the black-box phase raised): pair the
+        # transition and zero the process-global degrade gauge so the
+        # degrade-hysteresis invariant of LATER scenarios stays clean
+        try:
+            decision._exit_cluster_degraded()
+        except Exception:  # noqa: BLE001 — cleanup must reach the stops below
+            pass
+        tok.close()
+        if sm is not None:
+            sm.stop()
         server.stop()
         decision.stop()
 
@@ -249,6 +303,7 @@ def _scn_rpc_error_burst(seed: int) -> ScenarioResult:
         ],
         ctx,
     )
+    verdicts.append(Verdict("flight-bundle-captured", flight_ok, flight_detail))
     return _result("rpc_error_burst", seed, session, verdicts, t0)
 
 

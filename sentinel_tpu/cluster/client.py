@@ -22,6 +22,7 @@ from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.obs import flight as FL
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.utils.time_source import mono_s
@@ -113,7 +114,7 @@ class ClusterTokenClient(TokenService):
 
     def close(self) -> None:
         self._closed = True
-        self._teardown()
+        self._teardown(kind="close")
 
     def _ensure_connected(self) -> bool:
         if self._sock is not None:
@@ -152,15 +153,23 @@ class ClusterTokenClient(TokenService):
                 P.ClusterRequest(self._next_xid(), C.MSG_TYPE_PING, namespace=self.namespace)
             )
         except OSError:
-            self._teardown()
+            self._teardown(kind="send_fail")
             return False
         return True
 
-    def _teardown(self) -> None:
+    def _teardown(self, kind: str = "conn_lost") -> None:
         with self._lock:
             s, self._sock = self._sock, None
             pending, self._pending = self._pending, {}
         if s is not None:
+            # black-box journal: WHY a live connection went away (close /
+            # send_fail / conn_lost) with how many requests it stranded
+            FL.note(
+                "cluster.conn.teardown",
+                kind=kind,
+                peer=f"{self.host}:{self.port}",
+                in_flight=len(pending),
+            )
             try:
                 s.close()
             except OSError:
@@ -208,11 +217,27 @@ class ClusterTokenClient(TokenService):
         if not self._ensure_connected():
             _C_RPC_FAIL["connect"].inc()
             return None
+        _t = OT.t0()
+        _attrs = None
+        if _t:
+            # distributed trace context: adopt the caller's ambient trace
+            # (or start a fresh wire trace), mint this round-trip's span
+            # id, and ride both on the frame's optional trace tail — the
+            # server's decision spans re-install them (obs.trace.maybe_ctx)
+            # so `--merge` can join the two processes' dumps with flow
+            # events.  All of it is behind the one t0() flag check.
+            tid, parent = OT.current_ctx()
+            if not tid:
+                tid = OT.new_trace_id()
+            req.trace_id = tid
+            req.span_id = OT.new_span_id()
+            _attrs = {"type": req.type, "span_id": req.span_id}
+            if parent:
+                _attrs["parent"] = parent
         try:
             raw = P.encode_request(req)
         except (ValueError, struct.error):
             return _BAD_REQUEST  # unencodable request; connection is fine
-        _t = OT.t0()
         f: Future = Future()
         self._pending[req.xid] = f
         try:
@@ -226,14 +251,17 @@ class ClusterTokenClient(TokenService):
                 s.sendall(raw)
         except OSError:
             self._pending.pop(req.xid, None)
-            self._teardown()
+            self._teardown(kind="send_fail")
             _C_RPC_FAIL["send"].inc()
             if _t:
                 # failures skip the latency histogram (a timeout-ceiling
                 # sample would corrupt the success-path percentiles; the
                 # failure RATE lives in _C_RPC_FAIL) — the span keeps the
                 # duration for trace-level diagnosis
-                OT.stage("cluster.rpc", _t, attrs={"type": req.type, "ok": False})
+                OT.stage(
+                    "cluster.rpc", _t, trace=req.trace_id,
+                    attrs=dict(_attrs, ok=False),
+                )
             return None
         try:
             rsp = f.result(timeout=self.timeout_ms / 1000.0)
@@ -241,14 +269,18 @@ class ClusterTokenClient(TokenService):
             self._pending.pop(req.xid, None)
             _C_RPC_FAIL["timeout"].inc()
             if _t:
-                OT.stage("cluster.rpc", _t, attrs={"type": req.type, "ok": False})
+                OT.stage(
+                    "cluster.rpc", _t, trace=req.trace_id,
+                    attrs=dict(_attrs, ok=False),
+                )
             return None  # -> STATUS_FAIL at the TokenService surface (degrade, never PASS)
         if rsp is None:
             _C_RPC_FAIL["conn_lost"].inc()  # connection died mid-wait (_teardown resolved us)
         if _t:
             OT.stage(
                 "cluster.rpc", _t, _H_RPC if rsp is not None else None,
-                attrs={"type": req.type, "ok": rsp is not None},
+                trace=req.trace_id,
+                attrs=dict(_attrs, ok=rsp is not None),
             )
         return rsp
 

@@ -40,6 +40,18 @@ _T_LONG = 1
 _T_DOUBLE = 2
 _T_STRING = 3
 _T_BOOL = 4
+#: trace-context tag: a 17-byte ``[0x07][trace_id:u64][span_id:u64]``
+#: block.  In variable-payload frames (PARAM_FLOW / RES_CHECK) it rides
+#: the param stream as a final tagged element; in fixed-payload frames
+#: it is an optional tail after the known payload size.  Version
+#: tolerance: frames WITHOUT the block are byte-identical to the pre-
+#: trace format (tracing-off peers interoperate bit-exactly with any
+#: version), an old fixed-offset reader skips the tail of a traced
+#: frame, and a reader that has never seen tag 7 rejects only traced
+#: variable frames — which the transport already treats as a dropped
+#: malformed frame (caller times out and degrades, never crashes).
+_T_TRACE = 7
+_TRACE_BLOCK = struct.Struct(">BQQ")
 
 
 @dataclass
@@ -52,6 +64,9 @@ class ClusterRequest:
     token_id: int = 0
     namespace: str = ""
     params: List[Any] = field(default_factory=list)
+    # distributed-trace context (0 = absent; see _T_TRACE above)
+    trace_id: int = 0
+    span_id: int = 0
 
 
 @dataclass
@@ -63,6 +78,25 @@ class ClusterResponse:
     wait_ms: int = 0
     token_id: int = 0
     items: List[tuple] = field(default_factory=list)  # RES_CHECK verdicts
+    # trace context echoed from the request (0 = absent)
+    trace_id: int = 0
+    span_id: int = 0
+
+
+def _trace_tail(trace_id: int, span_id: int) -> bytes:
+    """Optional 17-byte trace block; empty when no context is attached —
+    untraced frames stay byte-identical to the legacy format."""
+    if not trace_id:
+        return b""
+    return _TRACE_BLOCK.pack(_T_TRACE, trace_id & 2**64 - 1, span_id & 2**64 - 1)
+
+
+def _read_trace_tail(p: bytes, off: int) -> Tuple[int, int]:
+    """Trace block at ``off`` if present, else ``(0, 0)`` (legacy frame)."""
+    if len(p) >= off + _TRACE_BLOCK.size and p[off] == _T_TRACE:
+        _tag, tid, sid = _TRACE_BLOCK.unpack_from(p, off)
+        return tid, sid
+    return 0, 0
 
 
 def _pack_params(params: List[Any]) -> bytes:
@@ -85,11 +119,21 @@ def _pack_params(params: List[Any]) -> bytes:
     return bytes(out)
 
 
-def _unpack_params(buf: bytes) -> List[Any]:
+def _unpack_params(buf: bytes) -> Tuple[List[Any], int, int]:
+    """Decode a tagged param stream; returns ``(params, trace_id,
+    span_id)`` — the trace block (tag 7) is surfaced out-of-band, never
+    as a param value."""
     out: List[Any] = []
+    trace_id = span_id = 0
     i = 0
     while i < len(buf):
         tag = buf[i]
+        if tag == _T_TRACE:
+            tid, sid = _read_trace_tail(buf, i)
+            if tid:
+                trace_id, span_id = tid, sid
+                i += _TRACE_BLOCK.size
+                continue
         i += 1
         if tag == _T_INT:
             out.append(struct.unpack_from(">i", buf, i)[0])
@@ -110,25 +154,28 @@ def _unpack_params(buf: bytes) -> List[Any]:
             i += 1
         else:
             raise ValueError(f"bad param tag {tag}")
-    return out
+    return out, trace_id, span_id
 
 
 def encode_request(req: ClusterRequest) -> bytes:
     head = struct.pack(">iB", req.xid, req.type)
     t = req.type
+    tail = _trace_tail(req.trace_id, req.span_id)
     if t == C.MSG_TYPE_PING:
+        # PING's payload is the raw namespace string (whole remainder) —
+        # no room for a skippable tail, and registration needs no trace
         payload = req.namespace.encode("utf-8")
     elif t == C.MSG_TYPE_FLOW or t == C.MSG_TYPE_FLOW_BATCH:
-        payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0)
+        payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0) + tail
     elif t == C.MSG_TYPE_PARAM_FLOW:
-        payload = struct.pack(">qi", req.flow_id, req.count) + _pack_params(req.params)
+        payload = struct.pack(">qi", req.flow_id, req.count) + _pack_params(req.params) + tail
     elif t == C.MSG_TYPE_CONCURRENT_ACQUIRE:
-        payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0)
+        payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0) + tail
     elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
-        payload = struct.pack(">q", req.token_id)
+        payload = struct.pack(">q", req.token_id) + tail
     elif t == C.MSG_TYPE_RES_CHECK:
         # params = flat 5-tuples (name, count, prio, origin, typed-param)
-        payload = _pack_params(req.params)
+        payload = _pack_params(req.params) + tail
     else:
         raise ValueError(f"bad request type {t}")
     body = head + payload
@@ -146,13 +193,15 @@ def decode_request(body: bytes) -> ClusterRequest:
     elif t in (C.MSG_TYPE_FLOW, C.MSG_TYPE_FLOW_BATCH, C.MSG_TYPE_CONCURRENT_ACQUIRE):
         req.flow_id, req.count, prio = struct.unpack_from(">qiB", p, 0)
         req.priority = prio != 0
+        req.trace_id, req.span_id = _read_trace_tail(p, 13)
     elif t == C.MSG_TYPE_PARAM_FLOW:
         req.flow_id, req.count = struct.unpack_from(">qi", p, 0)
-        req.params = _unpack_params(p[12:])
+        req.params, req.trace_id, req.span_id = _unpack_params(p[12:])
     elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
         (req.token_id,) = struct.unpack_from(">q", p, 0)
+        req.trace_id, req.span_id = _read_trace_tail(p, 8)
     elif t == C.MSG_TYPE_RES_CHECK:
-        req.params = _unpack_params(p)
+        req.params, req.trace_id, req.span_id = _unpack_params(p)
     else:
         raise ValueError(f"bad request type {t}")
     return req
@@ -170,7 +219,9 @@ def encode_response(rsp: ClusterResponse) -> bytes:
         )
     else:
         payload = b""
-    body = head + payload
+    # every response payload is either fixed-size or count-bounded, so an
+    # appended trace tail is skipped cleanly even by a legacy reader
+    body = head + payload + _trace_tail(rsp.trace_id, rsp.span_id)
     return struct.pack(">H", len(body)) + body
 
 
@@ -178,10 +229,13 @@ def decode_response(body: bytes) -> ClusterResponse:
     xid, t, status = struct.unpack_from(">iBb", body, 0)
     p = body[6:]
     rsp = ClusterResponse(xid=xid, type=t, status=status)
+    tail_off = 0
     if t in (C.MSG_TYPE_FLOW, C.MSG_TYPE_PARAM_FLOW, C.MSG_TYPE_FLOW_BATCH) and len(p) >= 8:
         rsp.remaining, rsp.wait_ms = struct.unpack_from(">ii", p, 0)
+        tail_off = 8
     elif t == C.MSG_TYPE_CONCURRENT_ACQUIRE and len(p) >= 8:
         (rsp.token_id,) = struct.unpack_from(">q", p, 0)
+        tail_off = 8
     elif t == C.MSG_TYPE_RES_CHECK and len(p) >= 4:
         (n,) = struct.unpack_from(">i", p, 0)
         off = 4
@@ -193,6 +247,8 @@ def decode_response(body: bytes) -> ClusterResponse:
             v, w = struct.unpack_from(">bi", p, off)
             off += 5
             rsp.items.append((v, w))
+        tail_off = off
+    rsp.trace_id, rsp.span_id = _read_trace_tail(p, tail_off)
     return rsp
 
 

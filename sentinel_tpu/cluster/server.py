@@ -22,6 +22,7 @@ from typing import Dict, Optional
 
 from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.utils.time_source import mono_s
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
@@ -258,9 +259,13 @@ class ClusterTokenServer:
         when its future resolves — no per-request worker, so the in-flight
         ceiling is the engine batch size, not the pool size."""
         try:
-            fut = self.service.request_token_async(
-                req.flow_id, req.count, req.priority
-            )
+            # adopt the frame's trace context for the synchronous part of
+            # the decision (the token.decision span begins in here), so
+            # the server-side span carries the client's trace id + parent
+            with OT.maybe_ctx(req.trace_id, req.span_id):
+                fut = self.service.request_token_async(
+                    req.flow_id, req.count, req.priority
+                )
             # bounded wait: a wedged engine must produce STATUS_FAIL, not a
             # silently hung connection (the worker-pool path got this from
             # check_batch's entry timeout)
@@ -270,11 +275,14 @@ class ClusterTokenServer:
             )
             rsp = P.ClusterResponse(
                 req.xid, req.type, r.status, remaining=r.remaining,
-                wait_ms=r.wait_ms,
+                wait_ms=r.wait_ms, trace_id=req.trace_id, span_id=req.span_id,
             )
         except Exception:  # stlint: disable=fail-open — converted to STATUS_FAIL: an explicit degrade signal, never a PASS
             record_log().exception("token request failed")
-            rsp = P.ClusterResponse(req.xid, req.type, C.STATUS_FAIL)
+            rsp = P.ClusterResponse(
+                req.xid, req.type, C.STATUS_FAIL,
+                trace_id=req.trace_id, span_id=req.span_id,
+            )
         try:
             writer.write(P.encode_response(rsp))
             await writer.drain()
@@ -282,6 +290,15 @@ class ClusterTokenServer:
             pass  # peer vanished mid-reply
 
     def _process(self, req: P.ClusterRequest) -> P.ClusterResponse:
+        # install the frame's trace context on this worker thread so every
+        # decision span recorded below (token.decision*, server.res_check)
+        # adopts the caller's trace id and parents to its RPC span
+        with OT.maybe_ctx(req.trace_id, req.span_id):
+            rsp = self._process_inner(req)
+        rsp.trace_id, rsp.span_id = req.trace_id, req.span_id
+        return rsp
+
+    def _process_inner(self, req: P.ClusterRequest) -> P.ClusterResponse:
         try:
             FP.hit(_FP_PROCESS)
             t = req.type
@@ -316,13 +333,17 @@ class ClusterTokenServer:
                         pvals.append(xs[2:])
                     else:  # legacy/bare value
                         pvals.append(xs)
-                res = self.service.client.check_batch(
-                    names,
-                    counts=counts,
-                    prioritized=prios,
-                    origins=origins if any(origins) else None,
-                    params=pvals if any(p is not None for p in pvals) else None,
-                )
+                # server-side chunk span: adopts the ambient trace ctx
+                # installed by _process, so the shard client's per-chunk
+                # span and this one share a trace id across the wire
+                with OT.TRACER.span("server.res_check", items=len(names)):
+                    res = self.service.client.check_batch(
+                        names,
+                        counts=counts,
+                        prioritized=prios,
+                        origins=origins if any(origins) else None,
+                        params=pvals if any(p is not None for p in pvals) else None,
+                    )
                 return P.ClusterResponse(
                     req.xid, t, C.STATUS_OK, items=[(int(v), int(w)) for v, w in res]
                 )

@@ -335,8 +335,10 @@ class DefaultTokenService(TokenService):
             _C_DECISIONS.inc()  # fast-path verdict is still a served decision
             done.set_result(TokenResult(C.STATUS_OK))
             return done
-        # cross-thread span: begun here, ended on the resolver/tick thread
-        # that fires the engine future (the explicit begin/end API's job)
+        # cross-thread span: begun here (adopting the wire trace context
+        # the TCP server installed, if any), ended on the resolver/tick
+        # thread that fires the engine future — the handle carries the
+        # trace id and the caller's span id (attrs["parent"]) across
         _span = OT.TRACER.begin("token.decision", flow_id=flow_id)
 
         def _chain(fut):
@@ -347,6 +349,7 @@ class DefaultTokenService(TokenService):
                     _span.t0_ns,
                     OT.now_ns() - _span.t0_ns,
                     _H_DECISION,
+                    trace=_span.trace,
                     attrs=_span.attrs,
                 )
             try:

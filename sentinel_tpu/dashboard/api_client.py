@@ -109,6 +109,15 @@ class SentinelApiClient:
         JSON (Perfetto-loadable; ``obs.load_spans`` parses it)."""
         return json.loads(self._get(ip, port, "api/traces"))
 
+    def fetch_flight(self, ip: str, port: int, stored: Optional[int] = None):
+        """``GET /api/flight`` — the machine's black-box flight recorder:
+        a fresh on-demand bundle, or with ``stored=N`` the last N
+        automatically-triggered ones (``obs.flight`` docs the contents;
+        ``python -m sentinel_tpu.obs --postmortem`` analyzes a bundle)."""
+        return json.loads(
+            self._get(ip, port, "api/flight", stored=stored)
+        )
+
     def fetch_json_tree(self, ip: str, port: int) -> dict:
         return json.loads(self._get(ip, port, "jsonTree"))
 

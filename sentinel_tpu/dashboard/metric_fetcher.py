@@ -14,10 +14,27 @@ from typing import Dict, Optional
 from sentinel_tpu.dashboard.api_client import SentinelApiClient
 from sentinel_tpu.dashboard.discovery import AppManagement
 from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.utils.time_source import wall_ms_now
 
 DEFAULT_INTERVAL_S = 1.0
 DEFAULT_MAX_CATCHUP_MS = 15_000
+
+# dashboard self-observability: a silently failing fetch loop used to be
+# invisible — the repository just stopped filling.  Now every machine
+# pull (metric-log line fetch or /metrics scrape) counts by outcome, and
+# the last-success gauge gives alerting a freshness signal.
+_FETCH_HELP = "dashboard machine pulls (metric fetch + prometheus scrape) by outcome"
+_C_FETCH_OK = _OBS.counter(
+    "sentinel_dashboard_fetch_total", _FETCH_HELP, labels={"result": "ok"}
+)
+_C_FETCH_ERR = _OBS.counter(
+    "sentinel_dashboard_fetch_total", _FETCH_HELP, labels={"result": "error"}
+)
+_G_LAST_SUCCESS = _OBS.gauge(
+    "sentinel_dashboard_last_success_ms",
+    "wall-clock ms of the dashboard's last successful machine pull",
+)
 
 
 class MetricFetcher:
@@ -73,8 +90,11 @@ class MetricFetcher:
                 try:
                     nodes = self.api.fetch_metric(m.ip, m.port, start, end)
                     self.fetch_ok += 1
+                    _C_FETCH_OK.inc()
+                    _G_LAST_SUCCESS.set(wall_ms_now())
                 except OSError:
                     self.fetch_fail += 1
+                    _C_FETCH_ERR.inc()
                     continue
                 if nodes:
                     self.repository.save_all(app, nodes)
@@ -96,8 +116,11 @@ class MetricFetcher:
                 try:
                     out[m.key] = self.api.fetch_prometheus(m.ip, m.port)
                     self.fetch_ok += 1
+                    _C_FETCH_OK.inc()
+                    _G_LAST_SUCCESS.set(wall_ms_now())
                 except OSError:
                     self.fetch_fail += 1
+                    _C_FETCH_ERR.inc()
         return out
 
     def _loop(self) -> None:

@@ -1,21 +1,29 @@
 """sentinel_tpu.obs — the observability plane.
 
-Two always-importable, dependency-light pieces:
+Three always-importable, dependency-light pieces:
 
 * ``obs.trace``    — lock-light fixed-capacity span tracer (ring buffer,
-  Chrome-trace/Perfetto export, optional jax.profiler passthrough);
+  Chrome-trace/Perfetto export, optional jax.profiler passthrough) plus
+  the distributed trace context (``new_trace_id`` / ``trace_ctx``) that
+  rides the cluster wire so client and server spans share a trace id;
 * ``obs.registry`` — counters / gauges / power-of-two latency histograms
-  with Prometheus text exposition.
+  with Prometheus text exposition (incl. the ``sentinel_build_info``
+  identity gauge);
+* ``obs.flight``   — always-on black-box flight recorder: a bounded
+  journal of state transitions and triggered post-mortem bundles.
 
 Instrumented subsystems (runtime tick stages, engine compile events,
 cluster RPC + degrade transitions, remote-shard chunks) record through
-the process-global ``TRACER`` and ``REGISTRY``; the command center
-serves them at ``GET /metrics`` and ``GET /api/traces``; the CLI
-(``python -m sentinel_tpu.obs``) dumps and summarizes trace rings.
+the process-global ``TRACER``, ``REGISTRY``, and ``FLIGHT``; the command
+center serves them at ``GET /metrics``, ``GET /api/traces``, and ``GET
+/api/flight``; the CLI (``python -m sentinel_tpu.obs``) dumps and
+summarizes trace rings, joins multi-process dumps (``--merge``), and
+analyzes flight bundles (``--postmortem``).
 
 Tracing defaults OFF: call ``obs.enable()`` (or set ``SENTINEL_TRACE=1``)
 to start recording.  Disabled-mode cost at every instrumented call site
 is a single flag check — no allocation, no formatting, no clock read.
+The flight journal is always on (rare events, O(1) appends).
 """
 
 from __future__ import annotations
@@ -26,18 +34,28 @@ from sentinel_tpu.obs.registry import (
     Gauge,
     Histogram,
     MetricRegistry,
+    register_build_info,
 )
+from sentinel_tpu.obs.flight import FLIGHT, FlightRecorder, load_bundle
 from sentinel_tpu.obs.trace import (
     TRACER,
     SpanTracer,
+    current_ctx,
     event,
     load_spans,
+    maybe_ctx,
+    new_span_id,
+    new_trace_id,
     now_ns,
     stage,
     stage_ns,
     summarize,
     t0,
+    trace_ctx,
 )
+
+#: every process that imports the obs plane identifies itself on /metrics
+register_build_info()
 
 
 def enable(jax_annotations: bool = False) -> None:
@@ -60,22 +78,31 @@ def span(name: str, trace: int = 0, **attrs):
 
 
 __all__ = [
+    "FLIGHT",
     "REGISTRY",
     "TRACER",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricRegistry",
     "SpanTracer",
+    "current_ctx",
     "enable",
     "disable",
     "enabled",
     "event",
+    "load_bundle",
     "load_spans",
+    "maybe_ctx",
+    "new_span_id",
+    "new_trace_id",
     "now_ns",
+    "register_build_info",
     "span",
     "stage",
     "stage_ns",
     "summarize",
     "t0",
+    "trace_ctx",
 ]

@@ -1,10 +1,12 @@
-"""CLI: dump / summarize a span-trace ring.
+"""CLI: dump / summarize / merge span traces, analyze flight bundles.
 
 Usage:
 
     python -m sentinel_tpu.obs --summary [trace.json]
     python -m sentinel_tpu.obs --chrome out.json [trace.json]
     python -m sentinel_tpu.obs --json [trace.json]
+    python -m sentinel_tpu.obs --merge a.json b.json ... -o merged.json
+    python -m sentinel_tpu.obs --postmortem bundle.json
 
 With a ``trace.json`` argument (a Chrome-trace file from ``GET
 /api/traces`` or ``SpanTracer.dump``) the CLI reads it; with no input it
@@ -15,12 +17,28 @@ then reports from the live ring.  ``--summary`` prints per-stage
 count / p50 / p99 / mean for every traced stage — the six tick stages
 (``tick.assemble``/``presort``/``dispatch``/``device``/``readback``/
 ``resolve``) decompose where each millisecond of a decision goes.
+
+``--merge`` joins per-process dumps (client + token server + shard
+hosts) into ONE Perfetto/Chrome trace: each input keeps its own pid
+lane (collisions remapped, a process_name metadata row names the source
+file), each process's monotonic clock is re-based to its earliest span
+(cross-process clocks share no epoch — causality comes from flows, not
+from the time axis), and every client RPC span that carries a
+``span_id`` is linked to the server spans that recorded it as
+``parent`` with Chrome flow events (``ph: s``/``f``) — the wire-level
+``(trace_id, parent_span_id)`` pair made visible.
+
+``--postmortem`` prints a flight bundle (obs/flight.py) as one merged
+timeline: journal events and trace spans interleaved on the bundle's
+monotonic clock, followed by the provider sections and the non-zero
+incident counters.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -96,7 +114,174 @@ def _self_capture(n_blocks: int = 4, block: int = 64) -> List[dict]:
     return OT.TRACER.snapshot()
 
 
-def _print_summary(spans: List[dict], out=sys.stdout) -> None:
+def merge_traces(paths: List[str]) -> dict:
+    """Join multi-process Chrome-trace dumps into one document with flow
+    events linking RPC client spans to the server spans they caused.
+
+    Linking contract: a span recorded with ``args.span_id = S`` (the
+    client half of a cross-process edge — ``cluster.rpc``,
+    ``shard.chunk``) is the flow SOURCE; every span in any input whose
+    ``args.parent == S`` (``token.decision*``, ``server.res_check``) is
+    a flow TARGET.  Chrome binds flow events to slices by (pid, tid,
+    ts), so the s/f events are stamped inside their respective spans.
+    """
+    all_events: List[dict] = []
+    used_pids: dict = {}
+    for idx, path in enumerate(paths):
+        with open(path) as f:
+            data = json.load(f)
+        if isinstance(data, dict) and "traceEvents" in data:
+            events = [dict(e) for e in data["traceEvents"]]
+        elif isinstance(data, list):  # raw snapshot list
+            events = [
+                {
+                    "name": s.get("name", "?"),
+                    "ph": "X",
+                    "ts": s.get("t0_ns", 0) / 1000.0,
+                    "dur": s.get("dur_ns", 0) / 1000.0,
+                    "pid": idx,
+                    "tid": s.get("tid", 0),
+                    "args": dict(
+                        s.get("attrs") or {}, **(
+                            {"trace": s["trace"]} if s.get("trace") else {}
+                        )
+                    ),
+                }
+                for s in data
+            ]
+        else:
+            raise ValueError(f"{path}: neither a chrome trace nor a span snapshot")
+        # one pid lane per input file; collide-remap keeps lanes distinct
+        # even when two dumps came from the same (or a re-used) pid
+        orig_pids = {e.get("pid", 0) for e in events} or {0}
+        remap = {}
+        for p in sorted(orig_pids):
+            q = p
+            while q in used_pids:
+                q += 100_000
+            remap[p] = q
+            used_pids[q] = path
+        # re-base each process's monotonic clock to its earliest event:
+        # cross-process monotonic clocks share no epoch, so absolute
+        # offsets are meaningless — flows carry the causality
+        t_min = min((e.get("ts", 0.0) for e in events), default=0.0)
+        for e in events:
+            e["pid"] = remap.get(e.get("pid", 0), e.get("pid", 0))
+            e["ts"] = e.get("ts", 0.0) - t_min
+        for new_pid in remap.values():
+            all_events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": new_pid,
+                    "tid": 0,
+                    "args": {"name": os.path.basename(path)},
+                }
+            )
+        all_events.extend(events)
+
+    # flow events: span_id (source) -> parent (targets), matched over ALL
+    # merged inputs so in-process parent/child pairs link too
+    sources = {}
+    for e in all_events:
+        sid = (e.get("args") or {}).get("span_id")
+        if sid and e.get("ph") == "X":
+            sources[sid] = e
+    flows: List[dict] = []
+    n_links = 0
+    for e in all_events:
+        parent = (e.get("args") or {}).get("parent")
+        if not parent or e.get("ph") != "X":
+            continue
+        src = sources.get(parent)
+        if src is None or src is e:
+            continue
+        n_links += 1
+        flows.append(
+            {
+                "name": "rpc",
+                "cat": "rpc",
+                "ph": "s",
+                "id": parent,
+                "ts": src["ts"],
+                "pid": src["pid"],
+                "tid": src.get("tid", 0),
+            }
+        )
+        flows.append(
+            {
+                "name": "rpc",
+                "cat": "rpc",
+                "ph": "f",
+                "bp": "e",
+                "id": parent,
+                "ts": e["ts"],
+                "pid": e["pid"],
+                "tid": e.get("tid", 0),
+            }
+        )
+    return {
+        "traceEvents": all_events + flows,
+        "displayTimeUnit": "ms",
+        "otherData": {"merged_from": [os.path.basename(p) for p in paths],
+                      "flow_links": n_links},
+    }
+
+
+def _print_postmortem(path: str, out=None) -> None:
+    """Flight-bundle analysis: journal events + trace spans on one
+    timeline (they share the capturing process's monotonic clock)."""
+    from sentinel_tpu.obs.flight import load_bundle
+
+    out = out or sys.stdout  # resolved at call time (test capture swaps it)
+    b = load_bundle(path)
+    print(
+        f"flight bundle: reason={b['reason']!r} pid={b['pid']} "
+        f"captured_wall_ms={b['captured_wall_ms']}",
+        file=out,
+    )
+    rows = []  # (t_ns, kind, text)
+    for ev in b.get("journal", ()):
+        fields = " ".join(f"{k}={v}" for k, v in sorted(ev["fields"].items()))
+        rows.append((ev["t_ns"], "event", f"{ev['kind']}  {fields}".rstrip()))
+    for s in b.get("spans", ()):
+        attrs = s.get("attrs") or {}
+        extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        rows.append(
+            (
+                s["t0_ns"],
+                "span",
+                f"{s['name']}  dur={s['dur_ns'] / 1e6:.3f}ms  {extra}".rstrip(),
+            )
+        )
+    rows.sort(key=lambda r: r[0])
+    t_ref = b.get("captured_mono_ns", rows[-1][0] if rows else 0)
+    print(f"timeline ({len(rows)} entries, t relative to capture):", file=out)
+    for t_ns, kind, text in rows:
+        print(f"  {(t_ns - t_ref) / 1e6:>12.3f}ms  {kind:<5} {text}", file=out)
+    provs = b.get("providers") or {}
+    for name, section in sorted(provs.items()):
+        print(f"provider [{name}]: {json.dumps(section, sort_keys=True)}", file=out)
+    metrics = b.get("metrics") or {}
+    hot = {
+        k: v
+        for k, v in sorted(metrics.items())
+        if not isinstance(v, dict)
+        and v
+        and any(
+            t in k
+            for t in ("degrade", "failures", "dropped", "shed", "injections",
+                      "flight", "resize")
+        )
+    }
+    if hot:
+        print("incident counters (non-zero):", file=out)
+        for k, v in hot.items():
+            print(f"  {k} = {v:g}", file=out)
+
+
+def _print_summary(spans: List[dict], out=None) -> None:
+    out = out or sys.stdout  # resolved at call time (test capture swaps it)
     summ = OT.summarize(spans)
     if not summ:
         print("no spans recorded", file=out)
@@ -139,7 +324,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "--blocks", type=int, default=4, help="self-capture: blocks to submit"
     )
+    ap.add_argument(
+        "--merge",
+        nargs="+",
+        metavar="TRACE",
+        help="join multi-process chrome-trace dumps into one (flow events "
+        "link client RPC spans to the server decision spans)",
+    )
+    ap.add_argument(
+        "-o", "--out", metavar="OUT",
+        help="output path for --merge (default: stdout)",
+    )
+    ap.add_argument(
+        "--postmortem",
+        metavar="BUNDLE",
+        help="analyze a flight-recorder bundle (GET /api/flight / "
+        "SENTINEL_FLIGHT_DIR): merged event/span timeline + providers",
+    )
     args = ap.parse_args(argv)
+
+    if args.postmortem:
+        _print_postmortem(args.postmortem)
+        return 0
+    if args.merge:
+        doc = merge_traces(args.merge)
+        n = len(doc["traceEvents"])
+        links = doc["otherData"]["flow_links"]
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f)
+            print(f"wrote {args.out} ({n} events, {links} flow links)")
+        else:
+            json.dump(doc, sys.stdout)
+            print()
+        return 0
 
     if args.input:
         spans = OT.load_spans(args.input)

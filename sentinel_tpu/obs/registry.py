@@ -22,6 +22,8 @@ the command center serves at ``GET /metrics``.
 from __future__ import annotations
 
 import math
+import os
+import sys
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -311,3 +313,45 @@ class MetricRegistry:
 
 #: process-global default registry — the one ``GET /metrics`` serves
 REGISTRY = MetricRegistry()
+
+
+#: the one registered build-info series (module cache: labels freeze at
+#: first registration, so a later call can never fork a second series)
+_BUILD_INFO: Optional[Gauge] = None
+
+
+def register_build_info(registry: Optional[MetricRegistry] = None) -> Gauge:
+    """``sentinel_build_info`` — the Prometheus info-gauge idiom (value
+    1, identity in the labels) so every scrape says WHAT it scraped:
+    sentinel version, jax version, configured backend, python.
+
+    Label values are resolved defensively and WITHOUT imports: versions
+    come from ``sys.modules`` only — forcing ``import jax`` here would
+    drag the multi-second jax import into jax-free processes (the
+    dashboard pulls this module via ``metric_fetcher``), and reading
+    ``jax.default_backend()`` would initialize a backend as a side
+    effect of metric setup.  Engine processes import jax before the obs
+    plane (runtime/client's module imports run in order), so they label
+    correctly; a process that truly never loads jax reports
+    ``jax_version="unloaded"``.  The default-registry labels freeze at
+    the first call — later calls return the same series.
+    """
+    global _BUILD_INFO
+    if registry is None and _BUILD_INFO is not None:
+        return _BUILD_INFO
+    st = sys.modules.get("sentinel_tpu")
+    jx = sys.modules.get("jax")
+    g = (registry or REGISTRY).gauge(
+        "sentinel_build_info",
+        "build/runtime identity (value is always 1; the labels carry it)",
+        labels={
+            "sentinel_version": getattr(st, "__version__", "unknown"),
+            "jax_version": getattr(jx, "__version__", "unloaded"),
+            "backend": os.environ.get("JAX_PLATFORMS") or "auto",
+            "python": ".".join(str(x) for x in sys.version_info[:3]),
+        },
+    )
+    g.set(1)
+    if registry is None:
+        _BUILD_INFO = g
+    return g

@@ -40,6 +40,7 @@ import json
 import os
 import threading
 import time as _time
+from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 
@@ -48,6 +49,77 @@ def now_ns() -> int:
     (time-source lint allowlist; everything else routes through
     ``utils/time_source``)."""
     return _time.monotonic_ns()
+
+
+# -- distributed trace context ------------------------------------------------
+#
+# Wire-level trace ids are 64-bit and PROCESS-UNIQUE (pid + startup-clock
+# salt in the high bits, a counter below), unlike the small per-tick
+# correlation ids ``SpanTracer.next_trace_id`` hands out: a client's
+# ``cluster.rpc`` span and the server's ``token.decision`` span live in
+# different processes and may only collide if both ids are global.  The
+# pair ``(trace_id, parent_span_id)`` rides the cluster protocol's
+# optional trace tail (cluster/protocol.py) and the receiving side
+# re-installs it as this thread-local ambient context, so spans begun
+# while serving the request adopt the caller's trace id and record the
+# caller's span id as ``parent`` — the joins ``--merge`` turns into
+# Perfetto flow events.
+
+_ID_SALT = ((os.getpid() & 0xFFFF) << 48) | ((now_ns() & 0xFFFFFF) << 24)
+_trace_seq = itertools.count(1)
+_span_seq = itertools.count(1)
+_ctx = threading.local()
+
+
+def new_trace_id() -> int:
+    """Fresh 64-bit wire trace id, unique across processes (pid + clock
+    salt + counter).  Never 0 — 0 means "no trace context" on the wire."""
+    return _ID_SALT | (next(_trace_seq) & 0xFFFFFF)
+
+
+def new_span_id() -> int:
+    """Fresh 64-bit span id (same uniqueness construction as trace ids)."""
+    return _ID_SALT | (next(_span_seq) & 0xFFFFFF)
+
+
+def current_ctx() -> Tuple[int, int]:
+    """Ambient ``(trace_id, span_id)`` for this thread; ``(0, 0)`` unset."""
+    return getattr(_ctx, "trace", 0), getattr(_ctx, "span", 0)
+
+
+@contextmanager
+def trace_ctx(trace_id: int, span_id: int = 0):
+    """Install an ambient trace context for the current thread.  Spans
+    begun inside (``begin``/``span`` with ``trace=0``) adopt ``trace_id``
+    and record ``span_id`` as their ``parent`` attr."""
+    old = (getattr(_ctx, "trace", 0), getattr(_ctx, "span", 0))
+    _ctx.trace, _ctx.span = trace_id, span_id
+    try:
+        yield
+    finally:
+        _ctx.trace, _ctx.span = old
+
+
+def maybe_ctx(trace_id: int, span_id: int = 0):
+    """``trace_ctx`` when a wire trace id arrived AND tracing is on,
+    else a shared no-op — the receiving side's single-check adoption."""
+    if trace_id and TRACER.enabled:
+        return trace_ctx(trace_id, span_id)
+    return _NOOP
+
+
+def _adopt(trace: int, attrs: Optional[dict]) -> Tuple[int, Optional[dict]]:
+    """Fold the ambient context into a span being created with no
+    explicit trace id.  Called only on the tracing-ENABLED path."""
+    if trace == 0:
+        t = getattr(_ctx, "trace", 0)
+        if t:
+            trace = t
+            parent = getattr(_ctx, "span", 0)
+            if parent:
+                attrs = dict(attrs) if attrs else {}
+                attrs.setdefault("parent", parent)
+    return trace, attrs
 
 
 def _pow2_at_least(n: int) -> int:
@@ -113,7 +185,7 @@ class SpanTracer:
     """Fixed-capacity span ring.  See the module docstring for the
     concurrency and disabled-mode contracts."""
 
-    def __init__(self, capacity: int = 8192):
+    def __init__(self, capacity: int = 8192, drop_counter=None):
         self.capacity = _pow2_at_least(capacity)
         self._mask = self.capacity - 1
         self.enabled = False
@@ -122,6 +194,11 @@ class SpanTracer:
         self._trace_ids = itertools.count(1)
         self._ann_cls = None  # jax.profiler.TraceAnnotation when requested
         self._lock = threading.Lock()  # guards enable/reset, not the hot path
+        # optional obs Counter mirroring ring-overwrite loss (the global
+        # tracer wires sentinel_trace_spans_dropped_total); synced on the
+        # READ side so the one-store write path stays untouched
+        self._drop_counter = drop_counter
+        self._drops_synced = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -180,7 +257,8 @@ class SpanTracer:
         single flag check).  Pass the handle to ``end`` on ANY thread."""
         if not self.enabled:
             return None
-        return SpanHandle(name, now_ns(), trace, attrs or None)
+        trace, a = _adopt(trace, attrs or None)
+        return SpanHandle(name, now_ns(), trace, a)
 
     def end(self, handle: Optional[SpanHandle], **attrs) -> None:
         if handle is None:
@@ -197,12 +275,36 @@ class SpanTracer:
         """Context-manager span; a shared no-op when disabled."""
         if not self.enabled:
             return _NOOP
-        return _Span(self, name, trace, attrs or None)
+        trace, a = _adopt(trace, attrs or None)
+        return _Span(self, name, trace, a)
 
     # -- read side -----------------------------------------------------------
 
+    def spans_dropped_total(self) -> int:
+        """Spans lost to ring overwrite so far: everything ever recorded
+        beyond what one full ring can hold.  0 until the first wrap."""
+        return max(0, self.recorded_total - self.capacity)
+
+    def _sync_drop_counter(self) -> None:
+        """Mirror overwrite loss into the registry counter (monotonic:
+        only the delta since the last read is added).  Read-side only,
+        so taking the tracer lock here costs the hot write path nothing
+        — and concurrent snapshot() callers can't double-count a delta."""
+        if self._drop_counter is None:
+            return
+        d = self.spans_dropped_total()
+        with self._lock:
+            delta = d - self._drops_synced
+            if delta <= 0:
+                return
+            self._drops_synced = d
+        self._drop_counter.inc(delta)
+
     def snapshot(self) -> List[dict]:
-        """Spans currently in the ring, oldest first."""
+        """Spans currently in the ring, oldest first.  A wrapped ring has
+        lost its oldest spans — that loss is surfaced (not silent) via
+        ``spans_dropped_total`` / ``sentinel_trace_spans_dropped_total``."""
+        self._sync_drop_counter()
         recs = [r for r in list(self._ring) if r is not None]
         recs.sort(key=lambda r: r[0])
         return [
@@ -262,9 +364,21 @@ def _env_capacity(default: int = 8192) -> int:
         return default
 
 
+def _global_drop_counter():
+    """Registry counter for the global tracer's ring-overwrite loss.
+    Lazy import: registry never imports trace, so this is cycle-free."""
+    from sentinel_tpu.obs.registry import REGISTRY
+
+    return REGISTRY.counter(
+        "sentinel_trace_spans_dropped_total",
+        "spans overwritten by trace-ring wraparound (snapshot() holds at "
+        "most SENTINEL_TRACE_CAPACITY spans; older ones are lost)",
+    )
+
+
 #: process-global default tracer; enable with ``sentinel_tpu.obs.enable()``
 #: or SENTINEL_TRACE=1 in the environment
-TRACER = SpanTracer(capacity=_env_capacity())
+TRACER = SpanTracer(capacity=_env_capacity(), drop_counter=_global_drop_counter())
 if os.environ.get("SENTINEL_TRACE", "") not in ("", "0"):
     TRACER.enable()
 

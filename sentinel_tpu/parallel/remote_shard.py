@@ -140,6 +140,18 @@ class RemoteShard:
     ) -> List[Tuple[int, int]]:
         n = len(resources)
         spans = [(lo, min(lo + self.CHUNK, n)) for lo in range(0, n, self.CHUNK)]
+        # distributed trace context: one wire trace id per batch (the
+        # ambient one if a caller installed it, else fresh), one span id
+        # per chunk — the shard host's server.res_check span adopts them,
+        # so a merged dump shows every chunk's client and server halves
+        # on one timeline.  Zero work when tracing is off.
+        trace_id = 0
+        sids: Optional[List[int]] = None
+        if OT.TRACER.enabled:
+            trace_id, _parent = OT.current_ctx()
+            if not trace_id:
+                trace_id = OT.new_trace_id()
+            sids = [OT.new_span_id() for _ in spans]
         wires = [
             self._encode_chunk(
                 resources[lo:hi],
@@ -147,10 +159,12 @@ class RemoteShard:
                 origins[lo:hi] if origins else None,
                 params[lo:hi] if params else None,
                 prioritized[lo:hi] if prioritized else None,
+                trace_id=trace_id,
+                span_id=sids[k] if sids else 0,
             )
-            for lo, hi in spans
+            for k, (lo, hi) in enumerate(spans)
         ]
-        rsps = self._rpc_pipeline(wires)
+        rsps = self._rpc_pipeline(wires, trace_id=trace_id, sids=sids)
         out: List[Tuple[int, int]] = []
         for (lo, hi), rsp in zip(spans, rsps):
             k = hi - lo
@@ -179,7 +193,8 @@ class RemoteShard:
         return out
 
     def _encode_chunk(
-        self, resources, counts, origins, params, prioritized
+        self, resources, counts, origins, params, prioritized,
+        trace_id: int = 0, span_id: int = 0,
     ) -> Optional[bytes]:
         # wire layout: 5-tuples (name, count, prio, origin, param) with the
         # param TYPED via prefix — "i:<n>" int, "s:<text>" string, "" none —
@@ -211,7 +226,8 @@ class RemoteShard:
             self._xid += 1
             return P.encode_request(
                 P.ClusterRequest(
-                    xid=self._xid, type=C.MSG_TYPE_RES_CHECK, params=flat
+                    xid=self._xid, type=C.MSG_TYPE_RES_CHECK, params=flat,
+                    trace_id=trace_id, span_id=span_id,
                 )
             )
         except ValueError:
@@ -220,7 +236,9 @@ class RemoteShard:
             )
             return None
 
-    def _rpc_pipeline(self, wires) -> List[Optional[P.ClusterResponse]]:
+    def _rpc_pipeline(
+        self, wires, trace_id: int = 0, sids: Optional[List[int]] = None
+    ) -> List[Optional[P.ClusterResponse]]:
         """Windowed request/response exchange: up to WINDOW frames on the
         wire before the first read (the server answers in order per
         connection).
@@ -273,8 +291,12 @@ class RemoteShard:
                             # send-ahead WINDOW means later chunks' spans
                             # include queueing behind earlier ones
                             OT.stage(
-                                "shard.chunk", _t, _H_CHUNK,
-                                attrs={"chunk": i, "inflight": len(inflight)},
+                                "shard.chunk", _t, _H_CHUNK, trace=trace_id,
+                                attrs={
+                                    "chunk": i,
+                                    "inflight": len(inflight),
+                                    "span_id": sids[i] if sids else 0,
+                                },
                             )
                         pending.remove(i)
                         if queue:

@@ -54,6 +54,7 @@ from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.core.rule_tensors import hash_param
 from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import window as W
+from sentinel_tpu.obs import flight as FL
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as OBS
 from sentinel_tpu.runtime import context as CTX
@@ -551,8 +552,54 @@ class SentinelClient:
             self.metric_timer = MetricTimerListener(self, writer)
             if self.mode == "threaded":
                 self.metric_timer.start()
+        # black-box providers: every flight bundle captured while this
+        # client serves includes its rule fingerprints, pipeline state,
+        # and a config digest (last started client wins the name)
+        self._flight_provider = self._flight_state
+        FL.FLIGHT.register_provider("client", self._flight_provider)
+
+    def _flight_state(self) -> dict:
+        """Flight-bundle section: what a post-mortem needs to know about
+        this client at capture time (obs/flight.py provider contract)."""
+        import hashlib
+        import json as _json
+        from dataclasses import asdict
+
+        fps = {}
+        for name in (
+            "flow_rules",
+            "degrade_rules",
+            "system_rules",
+            "authority_rules",
+            "param_flow_rules",
+        ):
+            rules = getattr(self, name).get()
+            js = _json.dumps(R.rules_to_json_list(rules), sort_keys=True)
+            fps[name] = {
+                "count": len(rules),
+                "sha1": hashlib.sha1(js.encode()).hexdigest()[:12],
+            }
+        cfg = {
+            k: v
+            for k, v in asdict(self.cfg).items()
+            if isinstance(v, (int, float, str, bool))
+        }
+        return {
+            "app": self.app_name,
+            "mode": self.mode,
+            "enabled": self.enabled,
+            "degraded": self._cluster_degraded_active,
+            "pending_ticks": len(self._pending_ticks),
+            "registered_resources": self.registry.num_resources,
+            "rule_fingerprints": fps,
+            "config": cfg,
+        }
 
     def stop(self) -> None:
+        fp = getattr(self, "_flight_provider", None)
+        if fp is not None:
+            # only if still ours — a newer client may have taken the slot
+            FL.FLIGHT.unregister_provider("client", fp)
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
@@ -617,6 +664,12 @@ class SentinelClient:
         with self._cluster_lock:
             with OT.TRACER.span("client.recompile_rules"):
                 self._recompile_rules_locked()
+            FL.note(
+                "rules.recompile",
+                degraded=self._cluster_degraded_active,
+                flow=len(self.flow_rules.get()),
+                param=len(self.param_flow_rules.get()),
+            )
 
     def _recompile_rules_locked(self) -> None:
         flow = self.flow_rules.get()
@@ -783,6 +836,7 @@ class SentinelClient:
         without recompiling if already degraded.  The flag flip and the
         recompile are atomic under _cluster_lock so a concurrent exit/enter
         pair can't commit a stale ruleset for the winning state."""
+        entered = False
         with self._cluster_lock:
             self._cluster_degraded_until = (
                 mono_s() + self.cluster_retry_interval_s
@@ -792,7 +846,17 @@ class SentinelClient:
                 _C_DEGRADE_ENTER.inc()
                 _G_DEGRADED.set(1)
                 OT.event("cluster.degrade.enter")
+                FL.note(
+                    "cluster.degrade.enter",
+                    cooldown_s=self.cluster_retry_interval_s,
+                )
                 self._recompile_rules()
+                entered = True
+        if entered:
+            # black box: freeze the state that produced the degrade —
+            # outside the lock (bundle capture reads rule managers and
+            # the registry) and rate-limited inside trigger()
+            FL.FLIGHT.trigger("cluster-degrade-enter")
 
     def _exit_cluster_degraded(self) -> None:
         with self._cluster_lock:
@@ -801,6 +865,7 @@ class SentinelClient:
                 _C_DEGRADE_EXIT.inc()
                 _G_DEGRADED.set(0)
                 OT.event("cluster.degrade.exit")
+                FL.note("cluster.degrade.exit")
                 self._recompile_rules()
 
     def _authority_pre_blocks(self, resource: str, origin: str) -> bool:
@@ -1912,6 +1977,7 @@ class SentinelClient:
         import dataclasses
 
         _C_SEG_RESIZE.inc()
+        FL.note("seg.resize", seg_u=int(new_u), old_u=int(self.cfg.seg_u))
         _h = OT.TRACER.begin("engine.seg_resize", seg_u=int(new_u))
         try:
             FP.hit(_FP_SEG_RESIZE)  # chaos: a raise keeps the old capacity
@@ -2321,6 +2387,12 @@ class SentinelClient:
             self._resolve_tick_inner(p)
         except Exception as exc:  # stlint: disable=fail-open — items fail CLOSED (BLOCK_SYSTEM) below; nothing is admitted or stranded
             _C_RESOLVE_FAILED.inc()
+            FL.note(
+                "resolve.fail_closed",
+                error=f"{type(exc).__name__}: {exc}",
+                n_obj=p.n_obj,
+                n_blk=p.n_blk,
+            )
             from sentinel_tpu.utils.record_log import record_log
 
             record_log().error(

@@ -204,6 +204,23 @@ class DefaultHandlerGroup:
             TRACER.disable()
         return CommandResponse.of_success(TRACER.chrome_trace())
 
+    @command_mapping("api/flight", "flight-recorder bundle (black-box post-mortem)")
+    def api_flight(self, req: CommandRequest) -> CommandResponse:
+        """``GET /api/flight`` — the black-box surface: by default a
+        FRESH bundle captured on demand (not rate-limited — an operator
+        asking for state deserves current state); ``?stored=N`` returns
+        the last N automatically-triggered bundles instead (degrade
+        entries, invariant breaches).  Feed either to
+        ``python -m sentinel_tpu.obs --postmortem``."""
+        from sentinel_tpu.obs.flight import FLIGHT
+
+        stored = req.param("stored")
+        if stored is not None:
+            n = max(int(stored), 0)
+            # [-0:] would slice the WHOLE list; stored=0 means none
+            return CommandResponse.of_success(FLIGHT.bundles()[-n:] if n else [])
+        return CommandResponse.of_success(FLIGHT.dump_bundle(reason="api"))
+
     @command_mapping("rtQuantiles", "inbound RT quantiles (p50/p90/p99)")
     def rt_quantiles(self, req: CommandRequest) -> CommandResponse:
         qs = [float(x) for x in (req.param("q") or "0.5,0.9,0.99").split(",")]

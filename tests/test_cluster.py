@@ -75,6 +75,135 @@ def test_protocol_concurrent_roundtrip():
 
 
 # ---------------------------------------------------------------------------
+# trace-context tail: version tolerance both ways
+# ---------------------------------------------------------------------------
+
+import struct  # noqa: E402 — the back-compat tests re-implement the legacy reader
+
+
+def test_traced_frames_roundtrip_all_types():
+    """(trace_id, span_id) survives encode→decode for every request type
+    that carries it and for responses (echoed server-side)."""
+    tid, sid = 0xABCDEF0123456789, 0x1122334455667788
+    for req in (
+        P.ClusterRequest(xid=1, type=C.MSG_TYPE_FLOW, flow_id=5, count=2,
+                         priority=True, trace_id=tid, span_id=sid),
+        P.ClusterRequest(xid=2, type=C.MSG_TYPE_FLOW_BATCH, flow_id=5, count=9,
+                         trace_id=tid, span_id=sid),
+        P.ClusterRequest(xid=3, type=C.MSG_TYPE_PARAM_FLOW, flow_id=5, count=1,
+                         params=[42, "user-x", True], trace_id=tid, span_id=sid),
+        P.ClusterRequest(xid=4, type=C.MSG_TYPE_CONCURRENT_ACQUIRE, flow_id=5,
+                         trace_id=tid, span_id=sid),
+        P.ClusterRequest(xid=5, type=C.MSG_TYPE_CONCURRENT_RELEASE, token_id=7,
+                         trace_id=tid, span_id=sid),
+        P.ClusterRequest(xid=6, type=C.MSG_TYPE_RES_CHECK,
+                         params=["r", 1, False, "", ""], trace_id=tid, span_id=sid),
+    ):
+        got = P.decode_request(P.FrameReader().feed(P.encode_request(req))[0])
+        assert (got.trace_id, got.span_id) == (tid, sid), req.type
+        assert got.params == req.params and got.flow_id == req.flow_id
+    rsp = P.ClusterResponse(xid=9, type=C.MSG_TYPE_FLOW, status=C.STATUS_OK,
+                            remaining=3, wait_ms=10, trace_id=tid, span_id=sid)
+    got = P.decode_response(P.FrameReader().feed(P.encode_response(rsp))[0])
+    assert (got.trace_id, got.span_id) == (tid, sid)
+    assert (got.remaining, got.wait_ms) == (3, 10)
+
+
+def test_untraced_frames_are_byte_identical_to_legacy_format():
+    """With no trace context the wire format is bit-exact the pre-trace
+    encoding — a tracing-off deployment interoperates with ANY version."""
+    req = P.ClusterRequest(xid=7, type=C.MSG_TYPE_FLOW, flow_id=12, count=3,
+                           priority=True)
+    legacy = struct.pack(">iB", 7, C.MSG_TYPE_FLOW) + struct.pack(">qiB", 12, 3, 1)
+    assert P.encode_request(req) == struct.pack(">H", len(legacy)) + legacy
+    rsp = P.ClusterResponse(xid=7, type=C.MSG_TYPE_FLOW, status=C.STATUS_OK,
+                            remaining=2, wait_ms=0)
+    legacy_r = struct.pack(">iBb", 7, C.MSG_TYPE_FLOW, C.STATUS_OK) + struct.pack(">ii", 2, 0)
+    assert P.encode_response(rsp) == struct.pack(">H", len(legacy_r)) + legacy_r
+    # and legacy frames (no tail) decode on the new reader with ctx == 0
+    got = P.decode_request(P.FrameReader().feed(P.encode_request(req))[0])
+    assert (got.trace_id, got.span_id) == (0, 0)
+    got_r = P.decode_response(P.FrameReader().feed(P.encode_response(rsp))[0])
+    assert (got_r.trace_id, got_r.span_id) == (0, 0)
+
+
+def test_legacy_reader_skips_trace_tail_on_fixed_and_response_frames():
+    """A pre-trace reader parsed fixed-size payloads by offset and
+    count-bounded item lists — both skip the appended tail untouched.
+    (Re-implemented here exactly as the legacy decoder read the wire.)"""
+    tid, sid = 0x1234, 0x5678
+    raw = P.encode_request(
+        P.ClusterRequest(xid=3, type=C.MSG_TYPE_FLOW, flow_id=11, count=4,
+                         priority=False, trace_id=tid, span_id=sid)
+    )
+    body = P.FrameReader().feed(raw)[0]
+    xid, t = struct.unpack_from(">iB", body, 0)
+    flow_id, count, prio = struct.unpack_from(">qiB", body[5:], 0)  # legacy parse
+    assert (xid, t, flow_id, count, prio) == (3, C.MSG_TYPE_FLOW, 11, 4, 0)
+
+    rsp = P.ClusterResponse(xid=4, type=C.MSG_TYPE_RES_CHECK, status=C.STATUS_OK,
+                            items=[(0, 0), (4, 9)], trace_id=tid, span_id=sid)
+    body = P.FrameReader().feed(P.encode_response(rsp))[0]
+    xid, t, status = struct.unpack_from(">iBb", body, 0)
+    p = body[6:]
+    (n,) = struct.unpack_from(">i", p, 0)
+    items, off = [], 4
+    for _ in range(n):  # the legacy count-bounded item loop
+        v, w = struct.unpack_from(">bi", p, off)
+        off += 5
+        items.append((v, w))
+    assert items == [(0, 0), (4, 9)]
+
+
+def test_tcp_roundtrip_carries_trace_context_end_to_end(tcp_cluster, tmp_path):
+    """ISSUE-5 acceptance over the REAL wire: tracing on both ends of a
+    SentinelClient↔ClusterTokenServer round-trip, the client's
+    cluster.rpc span and the server's token.decision span share one wire
+    trace id (parent = the RPC span id), and the per-endpoint dumps
+    --merge into one Chrome trace with a flow event linking them."""
+    import json as _json
+
+    from sentinel_tpu import obs
+    from sentinel_tpu.obs.__main__ import merge_traces
+
+    server, tok, svc = tcp_cluster
+    obs.TRACER.reset()
+    obs.enable()
+    try:
+        assert tok.request_token(101).status in (C.STATUS_OK, C.STATUS_BLOCKED)
+    finally:
+        obs.disable()
+    spans = obs.TRACER.snapshot()
+    rpc = [s for s in spans if s["name"] == "cluster.rpc"]
+    dec = [s for s in spans if s["name"] == "token.decision"]
+    assert rpc and dec
+    links = [
+        (r, d)
+        for r in rpc
+        for d in dec
+        if d["attrs"].get("parent") == r["attrs"].get("span_id")
+    ]
+    assert links, f"no parent link: rpc={rpc} dec={dec}"
+    r, d = links[0]
+    assert r["trace"] == d["trace"] != 0
+
+    # the context crossed a real socket (client and server halves run in
+    # one test process but share NOTHING except the wire frames) — dump
+    # each endpoint's spans as its own process and merge
+    client_doc = obs.TRACER.chrome_trace(rpc)
+    server_doc = obs.TRACER.chrome_trace(dec)
+    for e in server_doc["traceEvents"]:
+        e["pid"] += 1  # the server's own dump would carry its own pid
+    a, b = tmp_path / "client.json", tmp_path / "server.json"
+    a.write_text(_json.dumps(client_doc))
+    b.write_text(_json.dumps(server_doc))
+    doc = merge_traces([str(a), str(b)])
+    assert doc["otherData"]["flow_links"] >= 1
+    flow_ids = {e["id"] for e in doc["traceEvents"] if e.get("ph") in ("s", "f")}
+    assert r["attrs"]["span_id"] in flow_ids
+
+
+# ---------------------------------------------------------------------------
 # host window / namespace guard
 # ---------------------------------------------------------------------------
 

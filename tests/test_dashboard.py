@@ -110,6 +110,34 @@ def test_fetcher_scrapes_prometheus_per_machine():
     assert f.fetch_ok == 1 and f.fetch_fail == 1
 
 
+def test_fetcher_self_observability_counters():
+    """ISSUE-5 satellite: the dashboard's OWN fetch loop is observable —
+    sentinel_dashboard_fetch_total{result} moves per pull outcome and
+    the last-success gauge is a fresh wall timestamp; before this, a
+    silently failing loop just stopped filling the repository."""
+    from sentinel_tpu.dashboard import metric_fetcher as MF
+
+    ok0, err0 = MF._C_FETCH_OK.value, MF._C_FETCH_ERR.value
+    d = AppManagement()
+    d.register(MachineInfo(app="app", ip="127.0.0.1", port=1))
+    d.register(MachineInfo(app="app", ip="127.0.0.1", port=666))
+    f = MetricFetcher(d, InMemoryMetricsRepository(), api=_FakeApi())
+    f.scrape_prometheus("app")
+    assert MF._C_FETCH_OK.value == ok0 + 1
+    assert MF._C_FETCH_ERR.value == err0 + 1
+    last = MF._G_LAST_SUCCESS.value
+    assert last > 0
+    # the metric-log path counts too (fake api's fetch_metric never fails)
+    api = _FakeApi()
+    api.nodes = [_node(1_700_000_095_000, "r", p=1)]
+    f2 = MetricFetcher(
+        AppManagement(), InMemoryMetricsRepository(), api=api
+    )
+    f2.discovery.register(MachineInfo(app="app", ip="127.0.0.1", port=1))
+    f2.fetch_once(1_700_000_100_000)
+    assert MF._C_FETCH_OK.value == ok0 + 2
+
+
 def test_dashboard_serves_ui_page():
     dash = DashboardServer(host="127.0.0.1", port=0, fetch_metrics=False,
                            auth_token="tok")

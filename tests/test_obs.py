@@ -426,6 +426,255 @@ def test_client_run_populates_stage_histograms_and_gauges(client_factory):
     )
 
 
+def test_wraparound_loss_is_accounted_not_silent():
+    """ISSUE-5 satellite: overwrite loss is exposed (spans_dropped_total
+    + a registry counter synced on the read side), never silent."""
+    from sentinel_tpu.obs.registry import MetricRegistry
+
+    reg = MetricRegistry()
+    c = reg.counter("sentinel_trace_spans_dropped_total")
+    tr = SpanTracer(capacity=8, drop_counter=c)
+    tr.enable()
+    for i in range(20):
+        tr.record("s", t0_ns=i, dur_ns=1)
+    assert tr.spans_dropped_total() == 12  # 20 recorded, 8 retained
+    snap = tr.snapshot()  # read side syncs the counter
+    assert len(snap) == 8
+    assert c.value == 12
+    # further records keep the accounting monotonic, no double count
+    for i in range(4):
+        tr.record("s", t0_ns=100 + i, dur_ns=1)
+    tr.snapshot()
+    assert tr.spans_dropped_total() == 16 and c.value == 16
+    tr.snapshot()
+    assert c.value == 16
+    # below-capacity tracers never report drops
+    small = SpanTracer(capacity=64, drop_counter=reg.counter("other_total"))
+    small.enable()
+    small.record("x", 0, 1)
+    small.snapshot()
+    assert small.spans_dropped_total() == 0
+
+
+def test_global_tracer_drop_counter_registered():
+    assert obs.REGISTRY.get("sentinel_trace_spans_dropped_total") is not None
+
+
+# ---------------------------------------------------------------------------
+# distributed trace context
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ctx_adoption_and_ids():
+    from sentinel_tpu.obs import trace as OT
+
+    t1, t2 = OT.new_trace_id(), OT.new_trace_id()
+    assert t1 != t2 and t1 != 0 and t1 < 2**64
+    tr = SpanTracer(capacity=16)
+    tr.enable()
+    with OT.trace_ctx(t1, 42):
+        with tr.span("child"):
+            pass
+        h = tr.begin("xchild")
+    tr.end(h)
+    with tr.span("orphan"):
+        pass
+    by_name = {s["name"]: s for s in tr.snapshot()}
+    assert by_name["child"]["trace"] == t1
+    assert by_name["child"]["attrs"]["parent"] == 42
+    assert by_name["xchild"]["trace"] == t1
+    assert by_name["xchild"]["attrs"]["parent"] == 42
+    assert by_name["orphan"]["trace"] == 0  # no ambient ctx -> unchanged
+    # explicit trace beats ambient; ctx restores on exit
+    with OT.trace_ctx(t1, 42):
+        with tr.span("explicit", trace=7):
+            pass
+    assert OT.current_ctx() == (0, 0)
+    assert {s["name"]: s for s in tr.snapshot()}["explicit"]["trace"] == 7
+
+
+def test_maybe_ctx_noop_when_disabled():
+    from sentinel_tpu.obs import trace as OT
+
+    assert not OT.TRACER.enabled
+    with OT.maybe_ctx(123, 456):
+        assert OT.current_ctx() == (0, 0)  # disabled: nothing installed
+
+
+def test_trace_context_plumbing_disabled_overhead_guard():
+    """The wire-trace plumbing's disabled path (maybe_ctx on the server,
+    the enabled-flag check before minting ids on the client) stays in
+    the same <5 µs/call budget as every other disarmed obs site."""
+    from sentinel_tpu.obs import trace as OT
+    from sentinel_tpu.utils.time_source import mono_s
+
+    assert not OT.TRACER.enabled
+    n = 20_000
+    t_start = mono_s()
+    for _ in range(n):
+        with OT.maybe_ctx(0, 0):
+            pass
+    elapsed = mono_s() - t_start
+    assert elapsed / n < 5e-6, f"maybe_ctx cost {elapsed / n * 1e9:.0f} ns/call"
+
+
+def test_golden_cross_process_merge_links_rpc_to_decision(tmp_path, capsys):
+    """ISSUE-5 acceptance: client + server dumps --merge into ONE chrome
+    trace where a cluster.rpc span and the server decision span share a
+    trace id and are linked by flow events."""
+    from sentinel_tpu.obs import trace as OT
+    from sentinel_tpu.obs.__main__ import main, merge_traces
+
+    tid, sid = OT.new_trace_id(), OT.new_span_id()
+    # client process: the RPC span carrying its span id on the wire
+    cl = SpanTracer(capacity=16)
+    cl.enable()
+    cl.record("cluster.rpc", t0_ns=1_000_000, dur_ns=900_000, trace=tid,
+              attrs={"span_id": sid, "ok": True, "type": 1})
+    client_doc = cl.chrome_trace()
+    # server process: the decision span that adopted (tid, sid)
+    sv = SpanTracer(capacity=16)
+    sv.enable()
+    with OT.trace_ctx(tid, sid):
+        sv.record("token.decision", t0_ns=5_000_000, dur_ns=400_000, trace=tid,
+                  attrs={"parent": sid, "flow_id": 101})
+    server_doc = sv.chrome_trace()
+    for e in server_doc["traceEvents"]:
+        e["pid"] = e["pid"] + 1  # distinct process
+    a, b = tmp_path / "client.json", tmp_path / "server.json"
+    a.write_text(json.dumps(client_doc))
+    b.write_text(json.dumps(server_doc))
+
+    doc = merge_traces([str(a), str(b)])
+    ev = doc["traceEvents"]
+    rpc = [e for e in ev if e.get("name") == "cluster.rpc"]
+    dec = [e for e in ev if e.get("name") == "token.decision"]
+    assert rpc and dec
+    assert rpc[0]["args"]["trace"] == dec[0]["args"]["trace"] == tid
+    assert rpc[0]["pid"] != dec[0]["pid"]  # separate lanes survived
+    starts = [e for e in ev if e.get("ph") == "s"]
+    ends = [e for e in ev if e.get("ph") == "f"]
+    assert len(starts) == 1 and len(ends) == 1
+    assert starts[0]["id"] == ends[0]["id"] == sid
+    # flow endpoints bind inside their spans' (pid, ts) lanes
+    assert starts[0]["pid"] == rpc[0]["pid"] and ends[0]["pid"] == dec[0]["pid"]
+    # the CLI writes the same document
+    out = tmp_path / "merged.json"
+    assert main(["--merge", str(a), str(b), "-o", str(out)]) == 0
+    written = json.loads(out.read_text())
+    assert written["otherData"]["flow_links"] == 1
+    assert "1 flow links" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_journal_ring_and_events():
+    from sentinel_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(capacity=8)
+    for i in range(12):
+        fr.note("k", i=i)
+    evs = fr.events()
+    assert len(evs) == 8  # bounded: oldest overwritten
+    assert [e["fields"]["i"] for e in evs] == list(range(4, 12))
+    assert fr.recorded_total() == 12
+    assert [e["fields"]["i"] for e in fr.events(last=3)] == [9, 10, 11]
+    assert evs[0]["kind"] == "k" and evs[0]["t_ns"] > 0
+
+
+def test_flight_bundle_contents_and_providers():
+    from sentinel_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(capacity=32)
+    fr.note("cluster.degrade.enter", cooldown_s=5.0)
+    fr.register_provider("good", lambda: {"x": 1})
+    fr.register_provider("bad", lambda: 1 / 0)
+    b = fr.dump_bundle("unit")
+    assert b["kind"] == "sentinel-flight-bundle" and b["reason"] == "unit"
+    assert b["journal"][-1]["kind"] == "cluster.degrade.enter"
+    assert isinstance(b["metrics"], dict) and "captured_wall_ms" in b
+    assert b["providers"]["good"] == {"x": 1}
+    assert "ZeroDivisionError" in b["providers"]["bad"]["error"]
+    # unregister honors identity
+    keeper = lambda: {}  # noqa: E731
+    fr.register_provider("good", keeper)
+    fr.unregister_provider("good", lambda: {})  # not the registered fn
+    assert "good" in fr.dump_bundle("u2")["providers"]
+    fr.unregister_provider("good", keeper)
+    assert "good" not in fr.dump_bundle("u3")["providers"]
+
+
+def test_flight_trigger_rate_limit_and_keep_k(tmp_path, monkeypatch):
+    from sentinel_tpu.obs.flight import FlightRecorder
+
+    monkeypatch.setenv("SENTINEL_FLIGHT_DIR", str(tmp_path))
+    fr = FlightRecorder(capacity=8, keep=2, min_interval_s=3600.0)
+    assert fr.trigger("breach") is not None
+    assert fr.trigger("breach") is None  # inside the window
+    fr.reset_rate_limit()
+    assert fr.trigger("degrade") is not None
+    fr.reset_rate_limit()
+    assert fr.trigger("third") is not None
+    reasons = [b["reason"] for b in fr.bundles()]
+    assert reasons == ["degrade", "third"]  # keep=2, oldest evicted
+    assert fr.last_bundle()["reason"] == "third"
+    files = sorted(tmp_path.glob("flight_*.json"))
+    assert len(files) == 3  # disk keeps everything the process triggered
+    from sentinel_tpu.obs.flight import load_bundle
+
+    assert load_bundle(str(files[0]))["kind"] == "sentinel-flight-bundle"
+    rl = obs.REGISTRY.get("sentinel_flight_bundles_rate_limited_total")
+    assert rl is None or rl.value >= 0  # registered lazily per instance
+
+
+def test_flight_note_disarmed_overhead_guard():
+    """The journal append is the black box's hot hook: it must stay in
+    the same <5 µs/call budget as t0() and disarmed failpoints."""
+    from sentinel_tpu.obs.flight import FlightRecorder
+    from sentinel_tpu.utils.time_source import mono_s
+
+    fr = FlightRecorder(capacity=1024)
+    n = 20_000
+    t_start = mono_s()
+    for i in range(n):
+        fr.note("overhead.guard.event")
+    elapsed = mono_s() - t_start
+    assert elapsed / n < 5e-6, f"note() cost {elapsed / n * 1e9:.0f} ns/call"
+
+
+def test_postmortem_cli_prints_timeline(tmp_path, capsys):
+    from sentinel_tpu.obs.__main__ import main
+    from sentinel_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(capacity=16)
+    fr.note("failpoint.fire", site="cluster.rpc.send", action="raise", hit=2)
+    fr.note("cluster.degrade.enter", cooldown_s=5.0)
+    b = fr.dump_bundle("unit-test")
+    p = tmp_path / "bundle.json"
+    p.write_text(json.dumps(b))
+    assert main(["--postmortem", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "reason='unit-test'" in out
+    assert "failpoint.fire" in out and "cluster.degrade.enter" in out
+    # non-bundles are rejected loudly
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"nope": 1}))
+    with pytest.raises(ValueError):
+        main(["--postmortem", str(bad)])
+
+
+def test_build_info_gauge_in_exposition():
+    text = obs.REGISTRY.exposition()
+    line = [l for l in text.splitlines() if l.startswith("sentinel_build_info")]
+    assert line, "sentinel_build_info missing from exposition"
+    assert line[0].endswith(" 1")
+    assert 'sentinel_version="' in line[0] and 'jax_version="' in line[0]
+    assert 'backend="' in line[0]
+
+
 def test_chrome_roundtrip_through_summarize(tmp_path):
     obs.TRACER.reset()
     obs.enable()

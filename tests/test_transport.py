@@ -182,6 +182,33 @@ def test_metrics_and_traces_endpoints_round_trip(client):
         center.stop()
 
 
+def test_api_flight_endpoint_round_trip(client):
+    """GET /api/flight serves a fresh black-box bundle (journal, metrics,
+    the running client's provider section); ?stored=N returns the
+    auto-triggered bundle list (ISSUE 5)."""
+    from sentinel_tpu.obs.flight import FLIGHT
+
+    FLIGHT.note("cluster.degrade.enter", cooldown_s=1.0)
+    center = SimpleHttpCommandCenter(build_default_handlers(client), host="127.0.0.1", port=0)
+    center.start()
+    try:
+        base = f"http://127.0.0.1:{center.port}"
+        with urllib.request.urlopen(f"{base}/api/flight", timeout=3) as rsp:
+            assert rsp.status == 200
+            b = json.loads(rsp.read())
+        assert b["kind"] == "sentinel-flight-bundle" and b["reason"] == "api"
+        assert any(e["kind"] == "cluster.degrade.enter" for e in b["journal"])
+        assert isinstance(b["metrics"], dict)
+        # the fixture client registered its provider on start()
+        assert "client" in b["providers"]
+        assert "rule_fingerprints" in b["providers"]["client"]
+        with urllib.request.urlopen(f"{base}/api/flight?stored=2", timeout=3) as rsp:
+            stored = json.loads(rsp.read())
+        assert isinstance(stored, list)
+    finally:
+        center.stop()
+
+
 def test_heartbeat_against_local_receiver(client):
     """Heartbeat posts land on an HTTP receiver (a stand-in dashboard)."""
     import threading
