@@ -544,6 +544,126 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
     return out
 
 
+def cluster_sharded_bench(n_requests: int = 2000, workers: int = 8) -> dict:
+    """ISSUE-6 satellite: the sharded cluster token fleet (cluster/shard.py)
+    at N=1 vs N=4 shards — routed decisions/s, decision p50/p99, and the
+    failover blip (kill one shard → time until its flows are being served
+    again from the bounded-slack lease fallback).  Host-path numbers: the
+    work here is the TCP round-trip + the decision engine's micro-batched
+    tick, so this row measures the FLEET overhead, not the kernels."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from sentinel_tpu.cluster import constants as CC
+    from sentinel_tpu.cluster.shard import ShardFleet
+    from sentinel_tpu.core import rules as R
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    made = []
+
+    def factory():
+        c = SentinelClient(cfg=small_engine_config(), mode="sync")
+        c.start()
+        made.append(c)
+        return c
+
+    flows = list(range(1001, 1017))  # 16 flows spread over the ring
+    out: dict = {
+        "flows": len(flows),
+        "requests": n_requests,
+        "workers": workers,
+        "note": (
+            "in-process fleet: all shards' decision engines share this "
+            "host's cores, so N=4 measures fleet-protocol overhead and "
+            "the failover blip, not capacity scaling — deployed shards "
+            "run on separate hosts/devices"
+        ),
+    }
+    try:
+        for n_shards in (1, 4):
+            fleet = ShardFleet(
+                factory,
+                n_shards=n_shards,
+                lease_slack=0.25,
+                retry_interval_s=300.0,
+                lease_ttl_ms=600_000,
+                timeout_ms=5000,
+                reconnect_interval_s=0.0,
+            )
+            try:
+                fleet.load_flow_rules(
+                    "default",
+                    [
+                        R.FlowRule(
+                            resource=f"res-{fid}",
+                            count=1e9,  # measure routing, not admission
+                            cluster_mode=True,
+                            cluster_flow_id=fid,
+                            cluster_threshold_type=1,
+                        )
+                        for fid in flows
+                    ],
+                )
+                for fid in flows:  # warm connections + leases off the clock
+                    fleet.client.request_token(fid)
+                lat: list = []
+                lat_lock = threading.Lock()
+
+                def one(i):
+                    t0 = time.perf_counter()
+                    r = fleet.client.request_token(flows[i % len(flows)])
+                    dt = time.perf_counter() - t0
+                    with lat_lock:
+                        lat.append(dt)
+                    return r.status
+
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    statuses = list(pool.map(one, range(n_requests)))
+                wall = time.perf_counter() - t0
+                lat_ms = np.sort(np.array(lat)) * 1000.0
+                row = {
+                    "shards": n_shards,
+                    "dps": round(n_requests / wall),
+                    "decision_p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 3),
+                    "decision_p99_ms": round(
+                        float(lat_ms[int(len(lat_ms) * 0.99)]), 3
+                    ),
+                    "non_ok": int(sum(1 for s in statuses if s != CC.STATUS_OK)),
+                }
+                if n_shards > 1:
+                    # failover blip: kill one flow's owner and time until a
+                    # decision for that flow is served again (lease fallback)
+                    victim_fid = flows[0]
+                    victim = fleet.client.owner_of(victim_fid)
+                    t_kill = time.perf_counter()
+                    fleet.kill(victim)
+                    blip_deadline = t_kill + 30.0
+                    recovered = False
+                    while time.perf_counter() < blip_deadline:
+                        if fleet.client.request_token(victim_fid).status == CC.STATUS_OK:
+                            recovered = True
+                            break
+                    row["failover_blip_ms"] = round(
+                        (time.perf_counter() - t_kill) * 1000.0, 1
+                    )
+                    if not recovered:
+                        # deadline exhaustion, NOT a measured blip — mark
+                        # it so ~30000 ms can't read as a real recovery
+                        row["failover_timed_out"] = True
+                    row["degraded_shard"] = victim
+                out[f"n{n_shards}"] = row
+            finally:
+                fleet.stop()
+        if out["n1"]["dps"]:
+            out["speedup_n4_vs_n1"] = round(out["n4"]["dps"] / out["n1"]["dps"], 2)
+    finally:
+        for c in made:
+            c.stop()
+    return out
+
+
 def main() -> None:
     use_tpu = _tpu_available()
     import jax
@@ -694,6 +814,7 @@ def main() -> None:
                 "req_p99_ms_best": best_p99,
                 "joint_point_p99_under_2ms": joint,
                 "client_path": client_path,
+                "cluster_sharded": cluster_sharded_bench(),
                 "platform": platform,
             }
         )
@@ -701,4 +822,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--cluster-sharded" in sys.argv:
+        # the fleet row alone (host path only — no device build): fast
+        # enough to run on CPU, which is how BENCH_r06 captured it
+        print(json.dumps({"cluster_sharded": cluster_sharded_bench()}))
+    else:
+        main()

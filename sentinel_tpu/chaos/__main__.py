@@ -45,6 +45,7 @@ def main(argv=None) -> int:
     # process happened to import already
     import sentinel_tpu.cluster.client  # noqa: F401
     import sentinel_tpu.cluster.server  # noqa: F401
+    import sentinel_tpu.cluster.shard  # noqa: F401
     import sentinel_tpu.datasource.stores  # noqa: F401
     import sentinel_tpu.parallel.remote_shard  # noqa: F401
     import sentinel_tpu.runtime.client  # noqa: F401

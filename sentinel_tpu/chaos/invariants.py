@@ -32,6 +32,12 @@ The catalog (README "Chaos & fault injection" documents each):
                        (e.g. the labeled RPC failure KIND that fired)
   injected-as-planned  observed injected-event counts equal the
                        scenario's expectation (the determinism anchor)
+  shard-degrade-hysteresis
+                       per-SHARD failover transitions pair up (the fleet
+                       analog of degrade-hysteresis): for every shard the
+                       scenario names, enter/exit counts match the
+                       expectation and the live per-shard gauge equals
+                       enters - exits ∈ {0, 1}
 """
 
 from __future__ import annotations
@@ -146,6 +152,47 @@ def degrade_hysteresis(ctx: ScenarioContext) -> Verdict:
     )
 
 
+def shard_degrade_hysteresis(ctx: ScenarioContext) -> Verdict:
+    """Fleet failover discipline: ``extra["expect_shard_transitions"]``
+    maps shard name → expected (enters, exits) over the run; every named
+    shard must also leave its ``sentinel_shard_degraded`` gauge equal to
+    the open transition count (0 or 1)."""
+    want: Dict[str, tuple] = ctx.extra.get("expect_shard_transitions", {})
+    bad = []
+    k_enter = {
+        name: f'sentinel_shard_degrade_transitions_total{{shard="{name}",transition="enter"}}'
+        for name in want
+    }
+    k_exit = {
+        name: f'sentinel_shard_degrade_transitions_total{{shard="{name}",transition="exit"}}'
+        for name in want
+    }
+    # ONE registry walk for every shard's pair (deltas contract), plus
+    # one for the gauges, instead of 3 full walks per shard
+    d = ctx.metrics.deltas(list(k_enter.values()) + list(k_exit.values()))
+    now = MetricsDelta._flatten()
+    for name, (w_enter, w_exit) in want.items():
+        enters = d[k_enter[name]]
+        exits = d[k_exit[name]]
+        gauge = now.get(f'sentinel_shard_degraded{{shard="{name}"}}', 0.0)
+        open_ = enters - exits
+        if not (
+            enters == w_enter
+            and exits == w_exit
+            and open_ in (0.0, 1.0)
+            and gauge == open_
+        ):
+            bad.append(
+                f"{name}: enters={enters:g}/{w_enter} exits={exits:g}/{w_exit} "
+                f"gauge={gauge:g}"
+            )
+    return _v(
+        "shard-degrade-hysteresis",
+        not bad,
+        "; ".join(bad) or f"{len(want)} shards paired",
+    )
+
+
 def token_conservation(ctx: ScenarioContext) -> Verdict:
     c = ctx.extra.get("token_counts", {})
     requests = c.get("requests", 0)
@@ -238,6 +285,7 @@ CATALOG: Dict[str, Callable[[ScenarioContext], Verdict]] = {
     "verdict-accounting": verdict_accounting,
     "no-degraded-pass": no_degraded_pass,
     "degrade-hysteresis": degrade_hysteresis,
+    "shard-degrade-hysteresis": shard_degrade_hysteresis,
     "token-conservation": token_conservation,
     "no-chunk-replay": no_chunk_replay,
     "pipeline-drained": pipeline_drained,

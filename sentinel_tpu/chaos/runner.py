@@ -29,6 +29,9 @@ Scenarios (the acceptance set):
                       the post-heal update applies
   shard_reconnect     mid-window shard partition: answered chunks stay
                       resolved, unanswered degrade, no replay
+  shard_failover      fleet shard kill/partition/rejoin: only the dead
+                      shard's flows fail over to the bounded-slack lease
+                      fallback, per-shard hysteresis pairs up
 """
 
 from __future__ import annotations
@@ -764,6 +767,166 @@ def _scn_shard_reconnect(seed: int) -> ScenarioResult:
     return _result("shard_reconnect", seed, session, verdicts, t0)
 
 
+def _scn_shard_failover(seed: int) -> ScenarioResult:
+    """Shard-kill / partition / rejoin against a real 2-shard fleet
+    (cluster/shard.py): an injected route failure partitions ONE shard —
+    only its flows fail over to the bounded-slack lease fallback while
+    the other shard keeps answering remotely — the heal probe exits the
+    degraded state within one hysteresis window, and a REAL server kill
+    + rejoin after the armed window exercises the same protocol over an
+    actual dead socket.  Token conservation: every fallback pass debits
+    a lease the owner granted out of the global budget beforehand."""
+    from sentinel_tpu.cluster import constants as CC
+    from sentinel_tpu.cluster.shard import ShardFleet
+    from sentinel_tpu.core import rules as R
+
+    t0 = mono_s()
+    decisions = []
+
+    def factory():
+        c = _make_client()
+        decisions.append(c)
+        return c
+
+    fleet = ShardFleet(
+        factory,
+        n_shards=2,
+        lease_slack=0.5,
+        retry_interval_s=300.0,  # heal is explicit, never a wall-clock race
+        lease_ttl_ms=600_000,
+        timeout_ms=5000,
+        reconnect_interval_s=0.0,
+    )
+    # one flow per shard, found through the ring itself so the scenario
+    # never hardcodes placement; big budget => healthy phases always pass
+    fid_a = next(f for f in range(101, 500) if fleet.client.owner_of(f) == "shard-0")
+    fid_b = next(f for f in range(101, 500) if fleet.client.owner_of(f) == "shard-1")
+    fleet.load_flow_rules(
+        "default",
+        [
+            R.FlowRule(
+                resource=f"res-{fid}",
+                count=100.0,
+                cluster_mode=True,
+                cluster_flow_id=fid,
+                cluster_threshold_type=1,
+            )
+            for fid in (fid_a, fid_b)
+        ],
+    )
+    metrics = MetricsDelta()
+    session = _Session()
+    # healthy phase drives exactly 4 route-site hits (A A B B), so the
+    # raise lands on hit 4 — the first partition-phase request to A
+    plan = FaultPlan(
+        name="shard_failover",
+        seed=seed,
+        faults=[
+            FaultSpec(
+                "cluster.shard.route", "raise",
+                burst_start=4, burst_len=1, max_fires=1, exc="ConnectionResetError",
+            )
+        ],
+    )
+    counts = {"requests": 0, "ok": 0, "blocked": 0, "failed": 0, "other": 0}
+
+    def drive(fid, n=1):
+        for _ in range(n):
+            r = fleet.client.request_token(fid)
+            counts["requests"] += 1
+            if r.status == CC.STATUS_OK:
+                counts["ok"] += 1
+            elif r.status == CC.STATUS_BLOCKED:
+                counts["blocked"] += 1
+            elif r.status == CC.STATUS_FAIL:
+                counts["failed"] += 1
+            else:
+                counts["other"] += 1
+
+    sh_a = fleet.client._shards["shard-0"]
+    sh_b = fleet.client._shards["shard-1"]
+    try:
+        with session.window(plan):
+            drive(fid_a, 2)          # healthy: route hits 0,1 (+ lease grant)
+            drive(fid_b, 2)          # healthy: route hits 2,3 (+ lease grant)
+            drive(fid_a, 1)          # hit 4 raises -> enter degraded(shard-0)
+            failover_one_window = sh_a.degraded_active  # within ONE hysteresis window
+            drive(fid_a, 3)          # degraded: lease-fallback passes, no route hits
+            drive(fid_b, 2)          # other shard unaffected: route hits 5,6
+            with sh_a.lock:          # heal: expire the cooldown explicitly
+                sh_a.degraded_until = 0.0
+            drive(fid_a, 1)          # probe -> healthy answer -> exit degraded
+            healed = not sh_a.degraded_active
+        # -- real-kill phase (outside the armed window: injected counts
+        # stay a pure function of the seed).  shard-1's server dies for
+        # real; its flow fails over to the lease while shard-0's flow is
+        # untouched; rejoin on the ORIGINAL port + explicit cooldown
+        # expiry brings it back.
+        fleet.kill("shard-1")
+        _time.sleep(0.2)  # let the client's reader observe the close
+        drive(fid_b, 1)              # dead socket -> enter degraded(shard-1), lease pass
+        killed_over = sh_b.degraded_active
+        fleet.rejoin("shard-1")
+        with sh_b.lock:
+            sh_b.degraded_until = 0.0
+        drive(fid_b, 1)              # probe the rejoined server -> exit
+        rejoined = not sh_b.degraded_active
+    finally:
+        fleet.stop()
+        for c in decisions:
+            c.stop()
+
+    lease_cap = 50  # ceil(100 * lease_slack); fallback passes beyond it would be unmetered
+    fallback_passes = int(
+        metrics.delta('sentinel_shard_fallback_total{shard="shard-0",verdict="pass"}')
+        + metrics.delta('sentinel_shard_fallback_total{shard="shard-1",verdict="pass"}')
+    )
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=decisions[0],
+        submitted=counts["requests"],
+        passed=counts["ok"],
+        blocked=counts["blocked"],
+        degraded=counts["failed"] + counts["other"],
+        degraded_passes=max(fallback_passes - 2 * lease_cap, 0),
+        injected=session.injected,
+        expect_injected={"cluster.shard.route:raise": 1},
+        extra={
+            "token_counts": counts,
+            "expect_token_failures": 0,
+            "expect_shard_transitions": {"shard-0": (1, 1), "shard-1": (1, 1)},
+            "expect_metric_deltas": {
+                'sentinel_shard_fallback_total{shard="shard-0",verdict="pass"}': 4,
+                'sentinel_shard_fallback_total{shard="shard-0",verdict="block"}': 0,
+                'sentinel_shard_fallback_total{shard="shard-1",verdict="pass"}': 1,
+                'sentinel_shard_fallback_total{shard="shard-1",verdict="block"}': 0,
+                'sentinel_shard_lease_tokens_total{shard="shard-0"}': lease_cap,
+                'sentinel_shard_lease_tokens_total{shard="shard-1"}': lease_cap,
+            },
+        },
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "token-conservation",
+            "no-degraded-pass",
+            "shard-degrade-hysteresis",
+            "metric-deltas",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    for nm, ok in (
+        ("failover-within-one-window", failover_one_window),
+        ("healed-on-first-probe", healed),
+        ("real-kill-failover", killed_over),
+        ("rejoin-restores-remote", rejoined),
+    ):
+        verdicts.append(Verdict(nm, ok, "" if ok else "expected transition missing"))
+    return _result("shard_failover", seed, session, verdicts, t0)
+
+
 def _result(name, seed, session, verdicts, t0) -> ScenarioResult:
     return ScenarioResult(
         name=name,
@@ -822,6 +985,11 @@ SCENARIOS: Dict[str, Scenario] = {
             "shard_reconnect",
             _scn_shard_reconnect,
             "mid-window shard partition: degrade forfeited chunks, no replay",
+        ),
+        Scenario(
+            "shard_failover",
+            _scn_shard_failover,
+            "fleet shard kill/partition/rejoin: lease fallback, per-shard hysteresis",
         ),
     )
 }

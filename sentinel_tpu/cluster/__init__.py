@@ -15,6 +15,9 @@ Modules:
   server         — asyncio TCP token server + ConnectionManager
   client         — ClusterTokenClient (xid-correlated, auto-reconnect)
   state          — ClusterStateManager (NOT_STARTED / CLIENT / SERVER flips)
+  ring           — consistent-hash ring with virtual nodes (placement law)
+  shard          — ShardedTokenClient + ShardFleet: N-shard fleet with
+                   per-shard failover and bounded-slack budget leases
 """
 
 from sentinel_tpu.cluster.constants import (  # noqa: F401
@@ -39,3 +42,8 @@ from sentinel_tpu.cluster.token_service import (  # noqa: F401
     DefaultTokenService,
 )
 from sentinel_tpu.cluster.state import ClusterStateManager  # noqa: F401
+from sentinel_tpu.cluster.ring import HashRing, flow_key  # noqa: F401
+from sentinel_tpu.cluster.shard import (  # noqa: F401
+    ShardFleet,
+    ShardedTokenClient,
+)

@@ -316,6 +316,16 @@ class ClusterTokenClient(TokenService):
             return TokenResult(C.STATUS_FAIL)
         return TokenResult(rsp.status, remaining=rsp.remaining, wait_ms=rsp.wait_ms)
 
+    def request_lease(self, flow_id: int, units: int) -> TokenResult:
+        rsp = self._roundtrip(
+            P.ClusterRequest(
+                self._next_xid(), C.MSG_TYPE_LEASE, flow_id=flow_id, count=units
+            )
+        )
+        if rsp is None:
+            return TokenResult(C.STATUS_FAIL)
+        return TokenResult(rsp.status, remaining=rsp.remaining, wait_ms=rsp.wait_ms)
+
     def request_concurrent_token(self, flow_id: int, count: int = 1) -> TokenResult:
         rsp = self._roundtrip(
             P.ClusterRequest(

@@ -22,6 +22,13 @@ MSG_TYPE_FLOW_BATCH = 10
 # ShardRouter treat a remote host as a shard over the same framing/codec
 # as token requests
 MSG_TYPE_RES_CHECK = 12
+# extension: bounded-slack budget LEASE (cluster/shard.py) — request n
+# units against a flow's budget; the owning shard grants k (0..n) in
+# `remaining` and a validity window in `wait_ms`.  The holder may spend
+# the granted units locally while the shard is unreachable (failover
+# fallback), so global overshoot is bounded by the outstanding leases —
+# the slack-window reconciliation idea (arXiv 1703.01166)
+MSG_TYPE_LEASE = 13
 
 # -- token result status (TokenResultStatus.java) ----------------------------
 STATUS_BAD_REQUEST = -4
@@ -46,6 +53,16 @@ DEFAULT_SAMPLE_COUNT = 10
 DEFAULT_INTERVAL_MS = 1000
 DEFAULT_NAMESPACE = "default"
 DEFAULT_REQUEST_TIMEOUT_MS = 200
+# lease validity window: one flow-rule accounting interval — granted
+# units are spendable for at most this long, so a dead shard's budget
+# stops leaking exactly one window after its last grant
+DEFAULT_LEASE_TTL_MS = 1000
+# hard ceiling on units per LEASE grant, enforced on BOTH sides of the
+# wire: the server answers a lease with `units` unit-acquires in engine
+# micro-batches, so an uncapped request against a huge-threshold rule
+# (slack × 1e9) would stall the decision engine for everyone.  Large
+# budgets just re-lease more often; slack stays bounded either way.
+MAX_LEASE_UNITS = 1024
 
 # cluster threshold types (ClusterRuleConstant)
 FLOW_THRESHOLD_AVG_LOCAL = 0

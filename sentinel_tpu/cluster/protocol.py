@@ -17,6 +17,10 @@ Payloads (big-endian, mirroring the reference entity writers):
                        only primitives/strings serialize; others dropped)
   CONCURRENT_ACQUIRE → [flowId:int64][count:int32][prioritized:uint8]
   CONCURRENT_RELEASE → [tokenId:int64]
+  LEASE              → [flowId:int64][units:int32][reserved:uint8]
+                       (bounded-slack budget lease, cluster/shard.py;
+                       response: granted k in `remaining`, validity
+                       window ms in `waitMs`)
 
   flow/param response       → [remaining:int32][waitMs:int32]
   concurrent acquire resp   → [tokenId:int64]
@@ -165,7 +169,7 @@ def encode_request(req: ClusterRequest) -> bytes:
         # PING's payload is the raw namespace string (whole remainder) —
         # no room for a skippable tail, and registration needs no trace
         payload = req.namespace.encode("utf-8")
-    elif t == C.MSG_TYPE_FLOW or t == C.MSG_TYPE_FLOW_BATCH:
+    elif t in (C.MSG_TYPE_FLOW, C.MSG_TYPE_FLOW_BATCH, C.MSG_TYPE_LEASE):
         payload = struct.pack(">qiB", req.flow_id, req.count, 1 if req.priority else 0) + tail
     elif t == C.MSG_TYPE_PARAM_FLOW:
         payload = struct.pack(">qi", req.flow_id, req.count) + _pack_params(req.params) + tail
@@ -190,7 +194,12 @@ def decode_request(body: bytes) -> ClusterRequest:
     req = ClusterRequest(xid=xid, type=t)
     if t == C.MSG_TYPE_PING:
         req.namespace = p.decode("utf-8") if p else C.DEFAULT_NAMESPACE
-    elif t in (C.MSG_TYPE_FLOW, C.MSG_TYPE_FLOW_BATCH, C.MSG_TYPE_CONCURRENT_ACQUIRE):
+    elif t in (
+        C.MSG_TYPE_FLOW,
+        C.MSG_TYPE_FLOW_BATCH,
+        C.MSG_TYPE_CONCURRENT_ACQUIRE,
+        C.MSG_TYPE_LEASE,
+    ):
         req.flow_id, req.count, prio = struct.unpack_from(">qiB", p, 0)
         req.priority = prio != 0
         req.trace_id, req.span_id = _read_trace_tail(p, 13)
@@ -209,7 +218,12 @@ def decode_request(body: bytes) -> ClusterRequest:
 
 def encode_response(rsp: ClusterResponse) -> bytes:
     head = struct.pack(">iBb", rsp.xid, rsp.type, rsp.status)
-    if rsp.type in (C.MSG_TYPE_FLOW, C.MSG_TYPE_PARAM_FLOW, C.MSG_TYPE_FLOW_BATCH):
+    if rsp.type in (
+        C.MSG_TYPE_FLOW,
+        C.MSG_TYPE_PARAM_FLOW,
+        C.MSG_TYPE_FLOW_BATCH,
+        C.MSG_TYPE_LEASE,
+    ):
         payload = struct.pack(">ii", rsp.remaining, rsp.wait_ms)
     elif rsp.type == C.MSG_TYPE_CONCURRENT_ACQUIRE:
         payload = struct.pack(">q", rsp.token_id)
@@ -230,7 +244,16 @@ def decode_response(body: bytes) -> ClusterResponse:
     p = body[6:]
     rsp = ClusterResponse(xid=xid, type=t, status=status)
     tail_off = 0
-    if t in (C.MSG_TYPE_FLOW, C.MSG_TYPE_PARAM_FLOW, C.MSG_TYPE_FLOW_BATCH) and len(p) >= 8:
+    if (
+        t
+        in (
+            C.MSG_TYPE_FLOW,
+            C.MSG_TYPE_PARAM_FLOW,
+            C.MSG_TYPE_FLOW_BATCH,
+            C.MSG_TYPE_LEASE,
+        )
+        and len(p) >= 8
+    ):
         rsp.remaining, rsp.wait_ms = struct.unpack_from(">ii", p, 0)
         tail_off = 8
     elif t == C.MSG_TYPE_CONCURRENT_ACQUIRE and len(p) >= 8:

@@ -312,6 +312,8 @@ class ClusterTokenServer:
                 r = self.service.request_concurrent_token(req.flow_id, req.count)
             elif t == C.MSG_TYPE_CONCURRENT_RELEASE:
                 r = self.service.release_concurrent_token(req.token_id)
+            elif t == C.MSG_TYPE_LEASE:
+                r = self.service.request_lease(req.flow_id, req.count)
             elif t == C.MSG_TYPE_RES_CHECK:
                 # host-shard resource batch (parallel/remote_shard.py):
                 # params = flat (name, count, prio, origin, param) 5-tuples

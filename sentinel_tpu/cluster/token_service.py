@@ -95,6 +95,10 @@ class TokenResult:
 class TokenService:
     """Abstract token service (cluster/TokenService.java:26-62)."""
 
+    #: lease validity window granted to holders; implementations with a
+    #: configured TTL (``DefaultTokenService``) shadow this per instance
+    lease_ttl_ms: int = C.DEFAULT_LEASE_TTL_MS
+
     def request_token(self, flow_id: int, count: int = 1, prioritized: bool = False) -> TokenResult:
         raise NotImplementedError
 
@@ -117,6 +121,23 @@ class TokenService:
 
     def release_concurrent_token(self, token_id: int) -> TokenResult:
         raise NotImplementedError
+
+    def request_lease(self, flow_id: int, units: int) -> TokenResult:
+        """Bounded-slack budget lease (cluster/shard.py): grant up to
+        ``units`` tokens spendable by the holder for one validity window
+        (``remaining`` = granted k, ``wait_ms`` = window ms).  The grant
+        rides the partial-grant batch acquire — debited from the SAME
+        global budget as ordinary tokens, which is what makes the
+        holder's offline spending conserve it — so any TokenService can
+        serve as a lease source.  Units clamp to ``MAX_LEASE_UNITS``
+        here, for EVERY implementation: a hostile/miscalibrated request
+        must not stall the decision backend."""
+        r = self.request_token_batch(flow_id, min(units, C.MAX_LEASE_UNITS))
+        if r.status == C.STATUS_OK:
+            return TokenResult(
+                C.STATUS_OK, remaining=r.remaining, wait_ms=self.lease_ttl_ms
+            )
+        return r
 
 
 class GlobalRequestLimiter:
@@ -220,8 +241,10 @@ class DefaultTokenService(TokenService):
         config: Optional[ClusterServerConfigManager] = None,
         connected_count_fn: Optional[Callable[[str], int]] = None,
         concurrent_ttl_ms: int = 5000,
+        lease_ttl_ms: int = C.DEFAULT_LEASE_TTL_MS,
     ):
         self.client = decision_client
+        self.lease_ttl_ms = lease_ttl_ms
         self.config = config or ClusterServerConfigManager()
         self.connected_count_fn = connected_count_fn or (lambda ns: 1)
         self.flow_rules = ClusterFlowRuleManager(on_change=self._reproject)
@@ -412,6 +435,10 @@ class DefaultTokenService(TokenService):
         if all(v == ERR.PASS for v, _ in results):
             return TokenResult(C.STATUS_OK)
         return TokenResult(C.STATUS_BLOCKED)
+
+    # request_lease: the TokenService base implementation already rides
+    # request_token_batch with the MAX_LEASE_UNITS clamp and honors this
+    # instance's lease_ttl_ms — no override needed
 
     def request_concurrent_token(self, flow_id: int, count: int = 1) -> TokenResult:
         rule = self.flow_rules.get_by_id(flow_id)

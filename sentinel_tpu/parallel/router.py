@@ -15,23 +15,105 @@ remote host stubs in a real deployment.
 
 from __future__ import annotations
 
-import zlib
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from sentinel_tpu.cluster.ring import HashRing
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
+
+_ROUTE_FAIL_HELP = (
+    "shard check_batch fan-out legs that raised, by shard index and "
+    "failure kind (timeout|io|error); the affected spans degrade per "
+    "on_shard_error (block = fail closed, fallback = local re-check)"
+)
+
+#: shared index rings so ``shard_of`` and every ``ShardRouter`` of the
+#: same width agree on placement (and tests can derive expectations)
+_RINGS: Dict[int, HashRing] = {}
+_RINGS_LOCK = threading.Lock()
+
+
+def _index_ring(n_shards: int) -> HashRing:
+    ring = _RINGS.get(n_shards)
+    if ring is None:
+        with _RINGS_LOCK:
+            ring = _RINGS.get(n_shards)
+            if ring is None:
+                ring = HashRing([str(i) for i in range(n_shards)])
+                _RINGS[n_shards] = ring
+    return ring
 
 
 def shard_of(resource: str, n_shards: int) -> int:
-    """Deterministic, process-independent shard assignment (crc32 — the
-    same stability argument as the RLS flow-id derivation)."""
-    return zlib.crc32(resource.encode("utf-8")) % n_shards
+    """Deterministic, process-independent shard assignment — now through
+    the consistent-hash ring (``cluster/ring.py``) instead of the old
+    bare ``crc32 % n``, so growing the host set remaps ~1/N of the
+    resource space rather than reshuffling nearly all of it."""
+    return int(_index_ring(n_shards).owner(resource))
 
 
 class ShardRouter:
-    def __init__(self, shards: Sequence[Any]):
+    """Deterministic resource→shard fan-out.
+
+    ``on_shard_error`` governs what happens to the spans of a shard
+    whose ``check_batch`` leg RAISES mid-fan-out (the other shards'
+    results are always kept):
+
+      ``"block"``     (default) those spans fail CLOSED — verdict
+                      ``BLOCK_SYSTEM``, the engine's explicit degrade
+                      verdict, never a silent pass
+      ``"fallback"``  those spans re-check on the ``fallback`` client
+                      (local enforcement, the degrade-to-local shape)
+      ``"raise"``     legacy behavior: the first failing leg's exception
+                      propagates (once every leg has finished) and the
+                      whole batch is lost
+
+    Every failed leg counts in
+    ``sentinel_shard_route_failures_total{shard,kind}``.
+    """
+
+    def __init__(
+        self,
+        shards: Sequence[Any],
+        on_shard_error: str = "block",
+        fallback: Optional[Any] = None,
+    ):
         assert shards, "at least one shard"
+        if on_shard_error not in ("block", "fallback", "raise"):
+            raise ValueError(f"bad on_shard_error {on_shard_error!r}")
+        if on_shard_error == "fallback" and fallback is None:
+            raise ValueError("on_shard_error='fallback' needs a fallback client")
         self.shards = list(shards)
+        self.on_shard_error = on_shard_error
+        self.fallback = fallback
+        # PRIVATE copy, not the shared _RINGS instance (HashRing
+        # advertises add/remove — mutating a shared ring would corrupt
+        # every same-width router and shard_of), and it IS this router's
+        # routing authority: shard_for/check_batch consult it, so a
+        # mutation at least fails loudly instead of silently diverging
+        self.ring = HashRing([str(i) for i in range(len(self.shards))])
+
+    def _owner(self, resource: str) -> int:
+        return int(self.ring.owner(resource))
+
+    @staticmethod
+    def _fail_kind(exc: BaseException) -> str:
+        if isinstance(exc, TimeoutError):
+            return "timeout"
+        if isinstance(exc, OSError):
+            return "io"
+        return "error"
+
+    @staticmethod
+    def _count_route_failure(shard: int, kind: str) -> None:
+        _OBS.counter(
+            "sentinel_shard_route_failures_total",
+            _ROUTE_FAIL_HELP,
+            labels={"shard": str(shard), "kind": kind},
+        ).inc()
 
     def shard_for(self, resource: str):
-        return self.shards[shard_of(resource, len(self.shards))]
+        return self.shards[self._owner(resource)]
 
     def entry(self, resource: str, **kw):
         """Single entry routes to the owning shard (SphU.entry surface)."""
@@ -49,18 +131,25 @@ class ShardRouter:
         """Mixed-shard bulk check: group per shard (EVERY per-item sequence
         sliced with its group), shards consulted concurrently — one DCN
         round-trip of latency, not one per shard — results restored to
-        input order."""
+        input order.
+
+        A shard leg that raises no longer loses its spans (nor the other
+        shards' answers, which the old first-``result()``-raises shape
+        discarded): the failed group degrades per ``on_shard_error`` and
+        the failure is counted by (shard, kind)."""
+        from sentinel_tpu.core import errors as ERR
+
         n = len(resources)
         groups: Dict[int, List[int]] = {}
         for i, r in enumerate(resources):
-            groups.setdefault(shard_of(r, len(self.shards)), []).append(i)
+            groups.setdefault(self._owner(r), []).append(i)
         out: List[Optional[Tuple[int, int]]] = [None] * n
 
         def pick(seq, idxs):
             return [seq[i] for i in idxs] if seq is not None else None
 
-        def run(s, idxs):
-            return self.shards[s].check_batch(
+        def run(s, idxs, client=None):
+            return (client or self.shards[s]).check_batch(
                 pick(resources, idxs),
                 counts=pick(counts, idxs),
                 origins=pick(origins, idxs),
@@ -69,18 +158,42 @@ class ShardRouter:
                 **kw,
             )
 
+        def capture(s, call):
+            # one leg-failure policy for BOTH fan-out shapes: a raising
+            # leg becomes its exception (counted by shard+kind) instead
+            # of poisoning the whole batch
+            try:
+                return call()
+            except Exception as e:  # stlint: disable=fail-open — captured exception routes to the fail-closed BLOCK_SYSTEM fill below
+                self._count_route_failure(s, self._fail_kind(e))
+                return e
+
         if len(groups) == 1:
             ((s, idxs),) = groups.items()
-            results = {s: run(s, idxs)}
+            results = {s: capture(s, lambda: run(s, idxs))}
         else:
             from concurrent.futures import ThreadPoolExecutor
 
             with ThreadPoolExecutor(max_workers=len(groups)) as pool:
                 futures = {s: pool.submit(run, s, idxs) for s, idxs in groups.items()}
-                results = {s: f.result() for s, f in futures.items()}
+                results = {s: capture(s, f.result) for s, f in futures.items()}
+        if self.on_shard_error == "raise":
+            for got in results.values():
+                if isinstance(got, Exception):
+                    raise got
         for s, idxs in groups.items():
+            got = results[s]
+            if isinstance(got, Exception):
+                if self.on_shard_error == "fallback":
+                    try:
+                        got = run(s, idxs, client=self.fallback)
+                    except Exception as e:  # stlint: disable=fail-open — double fault: the spans fall through to the fail-closed fill below
+                        self._count_route_failure(s, self._fail_kind(e))
+                        got = e
+                if isinstance(got, Exception):
+                    got = [(ERR.BLOCK_SYSTEM, 0)] * len(idxs)
             for j, i in enumerate(idxs):
-                out[i] = results[s][j]
+                out[i] = got[j]
         return out  # type: ignore[return-value]
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:

@@ -5,6 +5,15 @@ sentinel-cluster-server-envoy-rls module (SURVEY.md §2.5): an Envoy proxy
 configured with a gRPC rate_limit_service can point at
 ``SentinelRlsGrpcServer`` and get cluster-wide token decisions from the
 TPU decision engine.
+
+Load-bearing fleet mode (README "Cluster sharding & RLS front door"):
+back the server with a ``ShardFleet``'s ``ShardedTokenClient``
+(``cluster/shard.py``) and each descriptor's flow id routes through the
+consistent-hash ring to its owning token-server shard — descriptor
+resolution, ring routing, per-shard failover, and the decision span all
+happen behind one ``ShouldRateLimit`` call, so external traffic is
+governed without linking the library.  ``sentinel_tpu.rls.server``
+imports lazily (it needs grpcio); the rule model here does not.
 """
 
 from sentinel_tpu.rls.rules import (  # noqa: F401

@@ -13,26 +13,117 @@ message classes — same wire behavior as a generated servicer.
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import grpc
 
 from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.obs import trace as OT
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.rls import rls_pb2 as pb
 from sentinel_tpu.rls.rules import EnvoyRlsRuleManager
+from sentinel_tpu.utils.record_log import record_log
+from sentinel_tpu.utils.time_source import mono_s
 
 SERVICE_NAME = "envoy.service.ratelimit.v2.RateLimitService"
 
+#: rate limit for the fail-closed error log (the error counter carries
+#: the rate; the log carries the traceback)
+_ERROR_LOG_INTERVAL_S = 10.0
+_error_log_lock = threading.Lock()
+_last_error_log_s = -_ERROR_LOG_INTERVAL_S
+
+_H_DECISION = _OBS.histogram(
+    "sentinel_rls_decision_ms",
+    "ShouldRateLimit request latency (descriptor resolution + token "
+    "round-trips to the owning shards)",
+)
+_C_REQUESTS = {
+    code: _OBS.counter(
+        "sentinel_rls_requests_total",
+        "ShouldRateLimit verdicts served by the RLS front door, by "
+        "overall code (error = decision raised and was converted to "
+        "OVER_LIMIT: the front door fails closed)",
+        labels={"code": code},
+    )
+    for code in ("ok", "over_limit", "error")
+}
+
 
 class SentinelEnvoyRlsService:
-    """The ShouldRateLimit decision logic (unary-unary)."""
+    """The ShouldRateLimit decision logic (unary-unary).
+
+    ``token_service`` is anything with the TokenService surface: a local
+    ``DefaultTokenService`` (single token server, the embedded shape) or
+    a ``ShardedTokenClient``/``ShardFleet.client`` — then each resolved
+    flow id routes through the consistent-hash ring to its owning shard,
+    and external Envoy traffic is governed by the fleet without linking
+    the library.  Unmatched descriptors and unknown domains return OK
+    (the reference's semantics); any over-limit descriptor makes the
+    overall verdict OVER_LIMIT.
+    """
 
     def __init__(self, token_service, rule_manager: Optional[EnvoyRlsRuleManager] = None):
         self.token_service = token_service
         self.rules = rule_manager or EnvoyRlsRuleManager(token_service)
 
     def should_rate_limit(self, request: pb.RateLimitRequest, context=None) -> pb.RateLimitResponse:
+        _t = OT.t0()
+        try:
+            rsp = self._traced_decide(request, _t)
+        except Exception:  # stlint: disable=fail-open — converted to OVER_LIMIT: an escaping exception surfaces to Envoy as UNKNOWN, and Envoy's default failure_mode admits the request unmetered — the front door must fail CLOSED instead
+            global _last_error_log_s
+            now = mono_s()
+            if now - _last_error_log_s >= _ERROR_LOG_INTERVAL_S:
+                # rate-limited: a persistently broken decision path must
+                # be diagnosable, not just an error-counter blip
+                with _error_log_lock:
+                    if now - _last_error_log_s >= _ERROR_LOG_INTERVAL_S:
+                        _last_error_log_s = now
+                        record_log().exception(
+                            "RLS decision failed; failing CLOSED (OVER_LIMIT)"
+                        )
+            _C_REQUESTS["error"].inc()
+            rsp = pb.RateLimitResponse()
+            rsp.overall_code = pb.RateLimitResponse.OVER_LIMIT
+            return rsp
+        _C_REQUESTS[
+            "over_limit"
+            if rsp.overall_code == pb.RateLimitResponse.OVER_LIMIT
+            else "ok"
+        ].inc()
+        return rsp
+
+    def _traced_decide(self, request: pb.RateLimitRequest, _t) -> pb.RateLimitResponse:
+        if not _t:
+            rsp = self._decide(request)
+        else:
+            # front-door span: mint (or adopt) a wire trace id and install
+            # it as the ambient context, so every downstream cluster RPC
+            # span (ClusterTokenClient._roundtrip) parents to this span —
+            # the merged Perfetto dump then shows one request's
+            # client → RLS → shard timeline as a single flow
+            tid = OT.current_ctx()[0] or OT.new_trace_id()
+            sid = OT.new_span_id()
+            with OT.trace_ctx(tid, sid):
+                rsp = self._decide(request)
+            OT.stage(
+                "rls.should_rate_limit",
+                _t,
+                _H_DECISION,
+                trace=tid,
+                attrs={
+                    "span_id": sid,
+                    "domain": request.domain,
+                    "descriptors": len(request.descriptors),
+                    "over_limit": rsp.overall_code == pb.RateLimitResponse.OVER_LIMIT,
+                },
+            )
+        return rsp
+
+    def _decide(self, request: pb.RateLimitRequest) -> pb.RateLimitResponse:
         hits = request.hits_addend or 1
         rsp = pb.RateLimitResponse()
         overall = pb.RateLimitResponse.OK
@@ -53,6 +144,10 @@ class SentinelEnvoyRlsService:
                 status.code = pb.RateLimitResponse.OK
                 status.limit_remaining = max(r.remaining, 0)
             else:
+                # BLOCKED, and also FAIL/TOO_MANY from a tokenless backend:
+                # the front door fails CLOSED on ambiguity (a fleet-backed
+                # service already converts shard failure into a lease
+                # fallback verdict before it reaches here)
                 status.code = pb.RateLimitResponse.OVER_LIMIT
                 overall = pb.RateLimitResponse.OVER_LIMIT
         rsp.overall_code = overall

@@ -221,6 +221,16 @@ class DefaultHandlerGroup:
             return CommandResponse.of_success(FLIGHT.bundles()[-n:] if n else [])
         return CommandResponse.of_success(FLIGHT.dump_bundle(reason="api"))
 
+    @command_mapping("api/shards", "token-fleet topology + per-shard health")
+    def api_shards(self, req: CommandRequest) -> CommandResponse:
+        """``GET /api/shards`` — every live sharded token client in the
+        process: ring parameters, per-flow spread, and per-shard address
+        / connection / failover state (the operator's view of WHICH
+        shard is degraded and how long its cooldown has left)."""
+        from sentinel_tpu.cluster.shard import describe_fleets
+
+        return CommandResponse.of_success(describe_fleets())
+
     @command_mapping("rtQuantiles", "inbound RT quantiles (p50/p90/p99)")
     def rt_quantiles(self, req: CommandRequest) -> CommandResponse:
         qs = [float(x) for x in (req.param("q") or "0.5,0.9,0.99").split(",")]

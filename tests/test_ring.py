@@ -1,0 +1,109 @@
+"""Consistent-hash ring contracts (cluster/ring.py).
+
+The golden test pins the EXACT assignment for a fixed member set so
+placement is process- and version-independent: a refactor that changes
+the hash, the vnode point construction, or the tie-break silently
+reshuffles every deployed fleet's flow ownership — this test makes that
+a loud diff instead.
+"""
+
+import math
+
+import pytest
+
+from sentinel_tpu.cluster.ring import DEFAULT_VNODES, HashRing, flow_key
+
+MEMBERS = ["shard-0", "shard-1", "shard-2", "shard-3"]
+
+#: pinned owner indices for keys k0..k63 on MEMBERS at vnodes=32
+#: (regenerate ONLY for a deliberate placement-law change:
+#:  [int(ring.owner(f"k{i}").split('-')[1]) for i in range(64)])
+GOLDEN_V32 = [
+    2, 2, 2, 2, 3, 3, 2, 1, 0, 3, 0, 0, 0, 0, 0, 0,
+    0, 0, 2, 2, 2, 2, 0, 3, 2, 3, 3, 0, 2, 2, 1, 2,
+    0, 0, 2, 1, 1, 0, 1, 2, 2, 2, 2, 2, 2, 2, 0, 2,
+    3, 0, 1, 0, 0, 1, 0, 2, 1, 0, 1, 1, 1, 1, 0, 0,
+]
+
+#: pinned flow-id owners (the fleet/RLS placement surface)
+GOLDEN_FLOWS_V32 = {101: "shard-3", 202: "shard-1", 303: "shard-1", 505: "shard-3"}
+
+
+def test_golden_assignment_is_pinned():
+    ring = HashRing(MEMBERS, vnodes=32)
+    got = [int(ring.owner(f"k{i}").split("-")[1]) for i in range(64)]
+    assert got == GOLDEN_V32
+    for fid, owner in GOLDEN_FLOWS_V32.items():
+        assert ring.owner_of_flow(fid) == owner
+
+
+def test_assignment_deterministic_across_instances():
+    a = HashRing(MEMBERS)
+    b = HashRing(list(reversed(MEMBERS)))  # construction order is irrelevant
+    keys = [f"key-{i}" for i in range(300)]
+    assert a.assignment(keys) == b.assignment(keys)
+
+
+@pytest.mark.parametrize("edit", ["remove", "add"])
+def test_membership_change_moves_at_most_one_share(edit):
+    """The consistent-hash law: a single-member edit moves ~K/N keys —
+    bounded by ceil(K/N) + slack (vnode imbalance) — NOT the ~(N-1)/N a
+    bare modulus reshuffles."""
+    K = 512
+    keys = [f"key-{i}" for i in range(K)]
+    base = HashRing([f"s{i}" for i in range(4)])
+    before = base.assignment(keys)
+    if edit == "remove":
+        other = HashRing([f"s{i}" for i in range(3)])
+    else:
+        other = HashRing([f"s{i}" for i in range(5)])
+    after = other.assignment(keys)
+    moved = sum(1 for k in keys if before[k] != after[k])
+    bound = math.ceil(K / 4) + K // 8  # slack: vnode-level imbalance
+    assert 0 < moved <= bound, f"{moved} keys moved, bound {bound}"
+    if edit == "remove":
+        # removal may only reassign the DEPARTING member's keys
+        assert all(
+            before[k] == "s3" for k in keys if before[k] != after[k]
+        )
+    else:
+        # addition may only pull keys TO the arriving member
+        assert all(
+            after[k] == "s4" for k in keys if before[k] != after[k]
+        )
+
+
+def test_incremental_edits_match_fresh_construction():
+    keys = [f"key-{i}" for i in range(256)]
+    ring = HashRing(MEMBERS)
+    ring.add("shard-4")
+    ring.remove("shard-1")
+    fresh = HashRing(["shard-0", "shard-2", "shard-3", "shard-4"])
+    assert ring.assignment(keys) == fresh.assignment(keys)
+
+
+def test_spread_covers_all_members():
+    ring = HashRing(MEMBERS, vnodes=DEFAULT_VNODES)
+    spread = ring.spread([f"key-{i}" for i in range(1000)])
+    assert set(spread) == set(MEMBERS)
+    assert all(v > 0 for v in spread.values())
+    assert sum(spread.values()) == 1000
+
+
+def test_flow_key_is_stable():
+    assert flow_key(42) == "flow/42"  # the cross-layer placement key
+
+
+def test_membership_validation():
+    with pytest.raises(ValueError):
+        HashRing([])
+    with pytest.raises(ValueError):
+        HashRing(["a", "a"])
+    ring = HashRing(["a", "b"])
+    with pytest.raises(ValueError):
+        ring.add("a")
+    with pytest.raises(ValueError):
+        ring.remove("zz")
+    ring.remove("b")
+    with pytest.raises(ValueError):
+        ring.remove("a")  # never empty

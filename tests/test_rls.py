@@ -89,3 +89,125 @@ def test_grpc_server_end_to_end(client_factory):
 def test_rule_dict_roundtrip():
     rule = make_rule()
     assert EnvoyRlsRule.from_dict(rule.to_dict()) == rule
+
+
+# ---------------------------------------------------------------------------
+# edge cases (ISSUE-6 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_empty_descriptor_list_is_ok(client):
+    svc = DefaultTokenService(client)
+    rls = SentinelEnvoyRlsService(svc)
+    rls.rules.load([make_rule()])
+    rsp = rls.should_rate_limit(pb.RateLimitRequest(domain="mesh"))
+    assert rsp.overall_code == pb.RateLimitResponse.OK
+    assert len(rsp.statuses) == 0  # one status per descriptor, none sent
+
+
+def test_unknown_domain_is_ok_not_over_limit(client):
+    svc = DefaultTokenService(client)
+    rls = SentinelEnvoyRlsService(svc)
+    rls.rules.load([make_rule(domain="mesh")])
+    rsp = rls.should_rate_limit(make_request(domain="not-mesh"))
+    assert rsp.overall_code == pb.RateLimitResponse.OK
+    assert rsp.statuses[0].code == pb.RateLimitResponse.OK
+
+
+def test_decision_exception_fails_closed(client):
+    """An exception escaping the decision path must become OVER_LIMIT,
+    not a gRPC UNKNOWN — Envoy's default failure_mode would admit an
+    errored request unmetered."""
+    svc = DefaultTokenService(client)
+    rls = SentinelEnvoyRlsService(svc)
+    rls.rules.load([make_rule(domain="mesh")])
+
+    def boom(*a, **k):
+        raise RuntimeError("decision backend down")
+
+    rls.token_service = svc  # sanity: normal path first
+    assert (
+        rls.should_rate_limit(make_request(domain="mesh")).overall_code
+        == pb.RateLimitResponse.OK
+    )
+    svc_broken = svc
+    orig = svc_broken.request_token
+    try:
+        svc_broken.request_token = boom
+        rsp = rls.should_rate_limit(make_request(domain="mesh"))
+        assert rsp.overall_code == pb.RateLimitResponse.OVER_LIMIT
+    finally:
+        svc_broken.request_token = orig
+
+
+def test_multi_descriptor_any_over_limit_semantics(client):
+    """One over-limit descriptor flips the OVERALL verdict while the
+    per-descriptor statuses stay individually truthful."""
+    svc = DefaultTokenService(client)
+    rls = SentinelEnvoyRlsService(svc)
+    rls.rules.load(
+        [
+            EnvoyRlsRule(
+                domain="mesh",
+                descriptors=[
+                    RlsResourceDescriptor(
+                        key_values=[RlsKeyValue("dest", "svc-tight")], count=1.0
+                    ),
+                    RlsResourceDescriptor(
+                        key_values=[RlsKeyValue("dest", "svc-wide")], count=100.0
+                    ),
+                ],
+            )
+        ]
+    )
+
+    def both():
+        req = pb.RateLimitRequest(domain="mesh", hits_addend=1)
+        for v in ("svc-tight", "svc-wide"):
+            d = req.descriptors.add()
+            e = d.entries.add()
+            e.key, e.value = "dest", v
+        return rls.should_rate_limit(req)
+
+    first = both()
+    assert first.overall_code == pb.RateLimitResponse.OK
+    second = both()  # svc-tight exhausted (count=1), svc-wide still fine
+    assert second.overall_code == pb.RateLimitResponse.OVER_LIMIT
+    assert second.statuses[0].code == pb.RateLimitResponse.OVER_LIMIT
+    assert second.statuses[1].code == pb.RateLimitResponse.OK
+
+
+def test_grpc_roundtrip_multi_descriptor_and_empty(client_factory):
+    """Real-gRPC (generic handler) round-trip of the edge shapes: the
+    wire path must agree with the in-proc service on empty descriptor
+    lists, unknown domains, and multi-descriptor verdicts."""
+    decision = client_factory()
+    svc = DefaultTokenService(decision)
+    server = SentinelRlsGrpcServer(svc, host="127.0.0.1", port=0)
+    server.rules.load([make_rule(count=1.0)])
+    server.start()
+    try:
+        channel, call = make_channel_stub(f"127.0.0.1:{server.port}")
+        assert (
+            call(pb.RateLimitRequest(domain="mesh")).overall_code
+            == pb.RateLimitResponse.OK
+        )
+        assert (
+            call(make_request(domain="elsewhere")).overall_code
+            == pb.RateLimitResponse.OK
+        )
+        req = make_request()  # matched descriptor...
+        d = req.descriptors.add()  # ...plus an unmatched one
+        e = d.entries.add()
+        e.key, e.value = "dest", "unknown"
+        first = call(req)
+        assert first.overall_code == pb.RateLimitResponse.OK
+        second = call(req)  # count=1 exhausted -> any-over-limit wins
+        assert second.overall_code == pb.RateLimitResponse.OVER_LIMIT
+        assert [s.code for s in second.statuses] == [
+            pb.RateLimitResponse.OVER_LIMIT,
+            pb.RateLimitResponse.OK,
+        ]
+        channel.close()
+    finally:
+        server.stop()

@@ -71,6 +71,90 @@ def test_router_snapshot_merges_shared_resources(client_factory, vt):
     assert snap["both"]["passQps"] == 6  # summed, not overwritten
 
 
+class _ExplodingShard:
+    """Shard double whose check_batch raises — the mid-batch fan-out
+    failure the ISSUE-6 satellite pins down."""
+
+    def __init__(self, exc):
+        self.exc = exc
+        self.calls = 0
+
+    def check_batch(self, resources, **kw):
+        self.calls += 1
+        raise self.exc
+
+
+def test_check_batch_shard_failure_degrades_spans_not_batch(client_factory, vt):
+    """A raising shard must not lose its spans silently NOR discard the
+    healthy shards' answers: its group fails CLOSED (BLOCK_SYSTEM) and
+    the failure is counted by (shard, kind)."""
+    from sentinel_tpu.core import errors as ERR
+    from sentinel_tpu.obs import REGISTRY
+
+    healthy = client_factory()
+    router = ShardRouter([healthy, _ExplodingShard(TimeoutError("dcn"))])
+    r0 = next(f"a{i}" for i in range(100) if shard_of(f"a{i}", 2) == 0)
+    r1 = next(f"b{i}" for i in range(100) if shard_of(f"b{i}", 2) == 1)
+    healthy.flow_rules.load([st.FlowRule(resource=r0, count=100)])
+
+    before = REGISTRY.snapshot().get(
+        'sentinel_shard_route_failures_total{kind="timeout",shard="1"}', 0
+    )
+    out = router.check_batch([r0, r1, r0, r1])
+    assert [v for v, _ in out] == [0, ERR.BLOCK_SYSTEM, 0, ERR.BLOCK_SYSTEM]
+    after = REGISTRY.snapshot()[
+        'sentinel_shard_route_failures_total{kind="timeout",shard="1"}'
+    ]
+    assert after == before + 1
+
+
+def test_check_batch_shard_failure_single_group_fails_closed(client_factory, vt):
+    from sentinel_tpu.core import errors as ERR
+
+    router = ShardRouter([client_factory(), _ExplodingShard(OSError("io"))])
+    r1 = next(f"b{i}" for i in range(100) if shard_of(f"b{i}", 2) == 1)
+    out = router.check_batch([r1, r1])  # one group, the failing shard
+    assert [v for v, _ in out] == [ERR.BLOCK_SYSTEM, ERR.BLOCK_SYSTEM]
+
+
+def test_check_batch_shard_failure_local_fallback(client_factory, vt):
+    """on_shard_error='fallback': the failed group re-checks on the local
+    fallback client — degraded enforcement, not a blanket block."""
+    healthy, fallback = client_factory(), client_factory()
+    router = ShardRouter(
+        [healthy, _ExplodingShard(OSError("io"))],
+        on_shard_error="fallback",
+        fallback=fallback,
+    )
+    r1 = next(f"b{i}" for i in range(100) if shard_of(f"b{i}", 2) == 1)
+    fallback.flow_rules.load([st.FlowRule(resource=r1, count=2)])
+    out = router.check_batch([r1, r1, r1])
+    assert [v for v, _ in out] == [0, 0, 1]  # fallback's local budget enforced
+
+
+def test_check_batch_raise_mode_preserves_legacy_behavior(client_factory, vt):
+    router = ShardRouter(
+        [client_factory(), _ExplodingShard(OSError("io"))], on_shard_error="raise"
+    )
+    r1 = next(f"b{i}" for i in range(100) if shard_of(f"b{i}", 2) == 1)
+    with pytest.raises(OSError):
+        router.check_batch([r1])
+    with pytest.raises(ValueError):
+        ShardRouter([client_factory()], on_shard_error="fallback")  # no fallback client
+    with pytest.raises(ValueError):
+        ShardRouter([client_factory()], on_shard_error="sometimes")
+
+
+def test_router_ring_agrees_with_shard_of(client_factory, vt):
+    """The router's internal ring and the module-level shard_of are the
+    same placement law — a split here would double-enforce budgets."""
+    router = ShardRouter([client_factory(), client_factory()])
+    for i in range(50):
+        name = f"res-{i}"
+        assert router.shards[shard_of(name, 2)] is router.shard_for(name)
+        assert int(router.ring.owner(name)) == shard_of(name, 2)
+
+
 def test_router_with_global_cluster_budget(client_factory, vt):
     """Both hosts defer a cluster-mode rule to ONE token service: the
     global cap holds across shards (cross-host budget via tokens, the
