@@ -544,6 +544,38 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
     return out
 
 
+def adaptive_overload_bench() -> dict:
+    """ISSUE-7 row: closed-loop adaptive protection under a 2×-capacity
+    flash crowd (adaptive/simload.py — real sync client on virtual time,
+    fixed-capacity FIFO backend).  Controller ON vs OFF at the identical
+    offered schedule: ON must keep storm p99 bounded and goodput near
+    capacity while the ladder climbs and recovers; OFF demonstrates the
+    queue collapse the controller exists to prevent.  Engine-time pure —
+    the same numbers reproduce on any host."""
+    from sentinel_tpu.adaptive.simload import (
+        run_overload_sim,
+        storm_controller_preset,
+    )
+
+    on = run_overload_sim(adaptive=True, adaptive_cfg=storm_controller_preset())
+    off = run_overload_sim(adaptive=False)
+    return {
+        "offered_x_capacity": 2.0,
+        "controller_on": on.to_dict(),
+        "controller_off": off.to_dict(),
+        "p99_collapse_ratio_off": round(
+            off.p99_storm_ms / max(off.p99_healthy_ms, 1e-9), 2
+        ),
+        "p99_ratio_on": round(on.p99_storm_ms / max(on.p99_healthy_ms, 1e-9), 2),
+        "goodput_held_frac_on": round(
+            on.goodput_storm / max(on.goodput_healthy, 1e-9), 3
+        ),
+        "ladder_path": [
+            (frm, to) for _t, frm, to in on.ladder_transitions
+        ],
+    }
+
+
 def cluster_sharded_bench(n_requests: int = 2000, workers: int = 8) -> dict:
     """ISSUE-6 satellite: the sharded cluster token fleet (cluster/shard.py)
     at N=1 vs N=4 shards — routed decisions/s, decision p50/p99, and the
@@ -815,6 +847,7 @@ def main() -> None:
                 "joint_point_p99_under_2ms": joint,
                 "client_path": client_path,
                 "cluster_sharded": cluster_sharded_bench(),
+                "adaptive_overload": adaptive_overload_bench(),
                 "platform": platform,
             }
         )
@@ -826,5 +859,9 @@ if __name__ == "__main__":
         # the fleet row alone (host path only — no device build): fast
         # enough to run on CPU, which is how BENCH_r06 captured it
         print(json.dumps({"cluster_sharded": cluster_sharded_bench()}))
+    elif "--adaptive-overload" in sys.argv:
+        # the adaptive row alone (engine-time pure — CPU-reproducible;
+        # how BENCH_r07 captured it)
+        print(json.dumps({"adaptive_overload": adaptive_overload_bench()}))
     else:
         main()

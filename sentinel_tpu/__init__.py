@@ -89,9 +89,17 @@ def __getattr__(name):
         from sentinel_tpu.runtime.client import SentinelClient
 
         return SentinelClient
+    if name in ("AdaptiveConfig", "AdaptiveController"):
+        # closed-loop system-adaptive protection (sentinel_tpu.adaptive);
+        # lazy like SentinelClient so `import sentinel_tpu` stays light
+        import sentinel_tpu.adaptive as _ad
+
+        return getattr(_ad, name)
     raise AttributeError(name)
 
 __all__ = [
+    "AdaptiveConfig",
+    "AdaptiveController",
     "AuthorityException",
     "AuthorityRule",
     "BlockException",

@@ -38,6 +38,11 @@ The catalog (README "Chaos & fault injection" documents each):
                        scenario names, enter/exit counts match the
                        expectation and the live per-shard gauge equals
                        enters - exits ∈ {0, 1}
+  ladder-monotone      degrade-ladder transitions move one rung at a time
+                       (monotone steps within the hysteresis holds), the
+                       run climbed when the scenario expected it to, and
+                       goodput never hit zero while the ladder sat below
+                       FAIL_CLOSED
 """
 
 from __future__ import annotations
@@ -272,6 +277,34 @@ def metric_deltas(ctx: ScenarioContext) -> Verdict:
     )
 
 
+def ladder_monotone(ctx: ScenarioContext) -> Verdict:
+    """Degrade-ladder discipline over one run:
+    ``extra["ladder_transitions"]`` is the controller's ordered
+    ``(now_ms, from, to)`` list.  Every move must be exactly one rung
+    (the shared hysteresis makes jumps impossible — a jump means a
+    second transition path snuck in); a climb must have happened iff
+    ``extra["expect_ladder_climb"]``; and ``extra["goodput_floor"]``
+    (min rolling-window goodput while below FAIL_CLOSED) must stay
+    positive — protection that zeroes goodput before fail-closed is
+    just an outage with extra steps."""
+    trans = ctx.extra.get("ladder_transitions", [])
+    jumps = [t for t in trans if abs(t[2] - t[1]) != 1]
+    climbed = any(t[2] > t[1] for t in trans)
+    want_climb = ctx.extra.get("expect_ladder_climb", True)
+    floor = ctx.extra.get("goodput_floor")
+    ok = (
+        not jumps
+        and climbed == bool(want_climb)
+        and (floor is None or floor > 0)
+    )
+    return _v(
+        "ladder-monotone",
+        ok,
+        f"transitions={[(t[1], t[2]) for t in trans]} jumps={len(jumps)} "
+        f"climbed={climbed} goodput_floor={floor}",
+    )
+
+
 def injected_as_planned(ctx: ScenarioContext) -> Verdict:
     return _v(
         "injected-as-planned",
@@ -293,6 +326,7 @@ CATALOG: Dict[str, Callable[[ScenarioContext], Verdict]] = {
     "seg-drops-counted": seg_drops_counted,
     "rules-intact": rules_intact,
     "metric-deltas": metric_deltas,
+    "ladder-monotone": ladder_monotone,
     "injected-as-planned": injected_as_planned,
 }
 

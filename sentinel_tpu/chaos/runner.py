@@ -32,6 +32,10 @@ Scenarios (the acceptance set):
   shard_failover      fleet shard kill/partition/rejoin: only the dead
                       shard's flows fail over to the bounded-slack lease
                       fallback, per-shard hysteresis pairs up
+  overload_storm      flash crowd at 2× backend capacity: the adaptive
+                      ladder climbs and sheds (p99 bounded, goodput
+                      held) then recovers to NORMAL; the controller-OFF
+                      control run demonstrably queue-collapses
 """
 
 from __future__ import annotations
@@ -927,6 +931,111 @@ def _scn_shard_failover(seed: int) -> ScenarioResult:
     return _result("shard_failover", seed, session, verdicts, t0)
 
 
+def _scn_overload_storm(seed: int) -> ScenarioResult:
+    """Flash crowd at 2× backend capacity against the adaptive plane
+    (adaptive/simload.py — a real sync client on virtual time over a
+    fixed-capacity FIFO backend):
+
+    * controller ON: the degrade ladder climbs rung by rung, excess
+      admissions shed CLOSED, storm p99 stays bounded (< 10× healthy),
+      goodput holds ≥ 50% of healthy, and recovery walks the ladder
+      back to NORMAL — every transition monotone and journaled in the
+      flight recorder;
+    * controller OFF: the identical offered schedule demonstrably
+      queue-collapses (p99 ≥ 10× healthy).
+
+    A seeded ``runtime.client.admit`` raise-burst rides along: chaos on
+    the admission check itself must shed CLOSED, never admit."""
+    import sentinel_tpu.runtime.client  # noqa: F401 — registers the admit/watchdog failpoints before the plan validates
+    from sentinel_tpu.adaptive.degrade import NORMAL
+    from sentinel_tpu.adaptive.simload import (
+        run_overload_sim,
+        storm_controller_preset,
+    )
+    from sentinel_tpu.obs.flight import FLIGHT
+
+    t0 = mono_s()
+    metrics = MetricsDelta()
+    session = _Session()
+    fires = 3
+    plan = FaultPlan(
+        name="overload_storm",
+        seed=seed,
+        faults=[
+            FaultSpec(
+                "runtime.client.admit", "raise",
+                every_nth=50, max_fires=fires, exc="RuntimeError",
+            )
+        ],
+    )
+    seq0 = FLIGHT.recorded_total()
+    with session.window(plan):
+        # the preset is shared with bench.adaptive_overload_bench so the
+        # gated experiment and the BENCH_r0N numbers stay one experiment
+        on = run_overload_sim(
+            adaptive=True, adaptive_cfg=storm_controller_preset()
+        )
+    off = run_overload_sim(adaptive=False)
+    journal = [
+        e
+        for e in FLIGHT.events()
+        if e["seq"] >= seq0 and e["kind"] == "adaptive.ladder"
+    ]
+    ctx = ScenarioContext(
+        metrics=metrics,
+        submitted=on.submitted,
+        passed=on.passed,
+        blocked=on.blocked,
+        injected=session.injected,
+        expect_injected={"runtime.client.admit:raise": fires},
+        extra={
+            "ladder_transitions": on.ladder_transitions,
+            "expect_ladder_climb": True,
+            "goodput_floor": on.goodput_floor,
+        },
+    )
+    verdicts = evaluate(
+        ["verdict-accounting", "ladder-monotone", "injected-as-planned"],
+        ctx,
+    )
+    checks = [
+        (
+            "p99-bounded-on",
+            on.p99_storm_ms <= 10 * max(on.p99_healthy_ms, 1.0),
+            f"storm p99 {on.p99_storm_ms:.0f}ms vs healthy "
+            f"{on.p99_healthy_ms:.0f}ms",
+        ),
+        (
+            "goodput-held-on",
+            on.goodput_storm >= 0.5 * on.goodput_healthy,
+            f"storm {on.goodput_storm:.2f}/step vs healthy "
+            f"{on.goodput_healthy:.2f}/step",
+        ),
+        (
+            "queue-collapse-off",
+            off.p99_storm_ms >= 10 * max(off.p99_healthy_ms, 1.0),
+            f"controller OFF storm p99 {off.p99_storm_ms:.0f}ms vs healthy "
+            f"{off.p99_healthy_ms:.0f}ms — no collapse means the storm "
+            "proves nothing",
+        ),
+        (
+            "ladder-recovered",
+            on.final_level == NORMAL,
+            f"final level {on.final_level}",
+        ),
+        (
+            "ladder-journaled",
+            len(journal) == len(on.ladder_transitions)
+            and len(journal) > 0,
+            f"{len(journal)} flight events vs "
+            f"{len(on.ladder_transitions)} transitions",
+        ),
+    ]
+    for nm, ok, detail in checks:
+        verdicts.append(Verdict(nm, bool(ok), "" if ok else detail))
+    return _result("overload_storm", seed, session, verdicts, t0)
+
+
 def _result(name, seed, session, verdicts, t0) -> ScenarioResult:
     return ScenarioResult(
         name=name,
@@ -990,6 +1099,11 @@ SCENARIOS: Dict[str, Scenario] = {
             "shard_failover",
             _scn_shard_failover,
             "fleet shard kill/partition/rejoin: lease fallback, per-shard hysteresis",
+        ),
+        Scenario(
+            "overload_storm",
+            _scn_overload_storm,
+            "2x-capacity flash crowd: ladder climbs, sheds, recovers; OFF collapses",
         ),
     )
 }

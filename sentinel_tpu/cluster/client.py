@@ -25,7 +25,6 @@ from sentinel_tpu.cluster.token_service import TokenResult, TokenService
 from sentinel_tpu.obs import flight as FL
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
-from sentinel_tpu.utils.time_source import mono_s
 
 _H_RPC = _OBS.histogram(
     "sentinel_cluster_rpc_ms",
@@ -82,12 +81,23 @@ class ClusterTokenClient(TokenService):
         namespace: str = C.DEFAULT_NAMESPACE,
         timeout_ms: int = C.DEFAULT_REQUEST_TIMEOUT_MS,
         reconnect_interval_s: float = 2.0,
+        reconnect_backoff_cap_s: float = 30.0,
     ):
         self.host = host
         self.port = port
         self.namespace = namespace
         self.timeout_ms = timeout_ms
         self.reconnect_interval_s = reconnect_interval_s
+        # exponential backoff with FULL jitter between reconnect attempts
+        # (adaptive/degrade.py): a fixed retry interval let N clients that
+        # lost the same shard stampede it in lockstep the moment it came
+        # back.  ``reconnect_interval_s`` is the base (attempt 0 ceiling)
+        # and stays live-tunable — tests zero it for no-throttle mode.
+        from sentinel_tpu.adaptive.degrade import Backoff
+
+        self._backoff = Backoff(
+            reconnect_interval_s, cap_s=reconnect_backoff_cap_s
+        )
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         # serializes sendall: concurrent partial writes from two threads
@@ -97,7 +107,6 @@ class ClusterTokenClient(TokenService):
         self._xid_counter = itertools.count(0)
         self._reader: Optional[threading.Thread] = None
         self._closed = False
-        self._last_attempt = 0.0
 
     def _next_xid(self) -> int:
         # xid is an int32 on the wire; wrap within the positive range
@@ -124,10 +133,11 @@ class ClusterTokenClient(TokenService):
         with self._lock:
             if self._sock is not None:
                 return True
-            now = mono_s()
-            if now - self._last_attempt < self.reconnect_interval_s:
+            # base stays live-tunable (tests zero reconnect_interval_s on
+            # a built client); cap ramp-up via the jittered backoff
+            self._backoff.base_s = self.reconnect_interval_s
+            if not self._backoff.ready():
                 return False
-            self._last_attempt = now
             try:
                 FP.hit(_FP_CONNECT)
                 s = socket.create_connection((self.host, self.port), timeout=2.0)
@@ -141,6 +151,7 @@ class ClusterTokenClient(TokenService):
                 # per-request future timeout, not the socket.
                 s.settimeout(None)
             except OSError:
+                self._backoff.failure()
                 return False
             self._sock = s
             self._reader = threading.Thread(
@@ -153,8 +164,17 @@ class ClusterTokenClient(TokenService):
                 P.ClusterRequest(self._next_xid(), C.MSG_TYPE_PING, namespace=self.namespace)
             )
         except OSError:
+            # the socket accepted the connect but died on the first write:
+            # as unhealthy as a refused connect — keep the backoff ramping
+            # so a flapping server isn't hammered at line rate
+            self._backoff.failure()
             self._teardown(kind="send_fail")
             return False
+        # NO backoff reset here: a connect (or even a buffered write)
+        # proves nothing about server health — an accept-then-die flapper
+        # would hold the backoff at attempt 0 forever and the fleet would
+        # hammer it at line rate.  The reset lives in _read_loop, on the
+        # first DECODED response (a real healthy exchange).
         return True
 
     def _teardown(self, kind: str = "conn_lost") -> None:
@@ -196,6 +216,10 @@ class ClusterTokenClient(TokenService):
                     except (ValueError, struct.error):
                         _C_RPC_FAIL["decode"].inc()
                         continue  # malformed frame; xid never resolves -> caller times out to STATUS_FAIL
+                    if self._backoff.attempt:
+                        # first decoded response = the healthy exchange
+                        # that resets the reconnect backoff ramp
+                        self._backoff.success()
                     f = self._pending.pop(rsp.xid, None)
                     if f is not None and not f.done():
                         f.set_result(rsp)

@@ -51,6 +51,7 @@ import threading
 import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+from sentinel_tpu.adaptive.degrade import Hysteresis
 from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.cluster.client import ClusterTokenClient
@@ -115,8 +116,6 @@ class _ShardState:
         self.name = name
         self.client = client
         self.lock = threading.Lock()
-        self.degraded_active = False
-        self.degraded_until = 0.0
         self.leases: Dict[int, _Lease] = {}
         #: flows with a LEASE RPC in flight — a second concurrent refresh
         #: would debit the global budget twice and keep only one grant
@@ -157,6 +156,36 @@ class _ShardState:
         self.c_lease_tokens = _OBS.counter(
             "sentinel_shard_lease_tokens_total", _LEASE_HELP, labels=labels
         )
+        # the shared degrade-hysteresis primitive (adaptive/degrade.py),
+        # scoped to THIS shard: same journal kinds ("shard.degrade.*"),
+        # counters and gauge as the hand-rolled state it replaced.  The
+        # cooldown is re-armed per enter() by the owning client (it owns
+        # retry_interval_s).
+        self.hy = Hysteresis(
+            "shard.degrade",
+            cooldown_s=5.0,
+            attrs={"shard": name},
+            counter_enter=self.c_enter,
+            counter_exit=self.c_exit,
+            gauge=self.g_degraded,
+        )
+
+    # attribute-compatible views (tests and the chaos harness poke these)
+    @property
+    def degraded_active(self) -> bool:
+        return self.hy.active
+
+    @degraded_active.setter
+    def degraded_active(self, v: bool) -> None:
+        self.hy.active = bool(v)
+
+    @property
+    def degraded_until(self) -> float:
+        return self.hy.until
+
+    @degraded_until.setter
+    def degraded_until(self, v: float) -> None:
+        self.hy.until = float(v)
 
 
 class ShardedTokenClient(TokenService):
@@ -289,27 +318,12 @@ class ShardedTokenClient(TokenService):
     # -- failover hysteresis (per shard) ------------------------------------
 
     def _enter_degraded(self, st: _ShardState) -> None:
-        with st.lock:
-            st.degraded_until = mono_s() + self.retry_interval_s
-            if not st.degraded_active:
-                st.degraded_active = True
-                st.c_enter.inc()
-                st.g_degraded.set(1)
-                OT.event("shard.degrade.enter", attrs={"shard": st.name})
-                FL.note(
-                    "shard.degrade.enter",
-                    shard=st.name,
-                    cooldown_s=self.retry_interval_s,
-                )
+        # transition mechanics (cooldown, counters, gauge, journal) live
+        # in the shared adaptive.degrade.Hysteresis — scoped to ONE shard
+        st.hy.enter(cooldown_s=self.retry_interval_s)
 
     def _exit_degraded(self, st: _ShardState) -> None:
-        with st.lock:
-            if st.degraded_active:
-                st.degraded_active = False
-                st.c_exit.inc()
-                st.g_degraded.set(0)
-                OT.event("shard.degrade.exit", attrs={"shard": st.name})
-                FL.note("shard.degrade.exit", shard=st.name)
+        st.hy.exit()
 
     # -- routing core --------------------------------------------------------
 

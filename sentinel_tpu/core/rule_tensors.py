@@ -425,17 +425,22 @@ def compile_authority_rules(
     return t
 
 
+def tightest_threshold(*vals) -> np.float32:
+    """Fold negative-means-unset system thresholds to the tightest SET
+    one (SystemRuleManager.loadSystemConf semantics); -1 when all unset.
+    The single authority for this fold — compile_system_rules and the
+    adaptive controller's live-column merge both use it."""
+    set_ = [float(v) for v in vals if float(v) >= 0]
+    return np.float32(min(set_)) if set_ else np.float32(-1.0)
+
+
 def compile_system_rules(rules: List[R.SystemRule], cfg: EngineConfig) -> SystemTensors:
     # fold multiple rules by taking the tightest threshold of each dimension,
     # as SystemRuleManager.loadSystemConf does
-    def tightest(vals):
-        vals = [v for v in vals if v >= 0]
-        return np.float32(min(vals)) if vals else np.float32(-1.0)
-
     return SystemTensors(
-        load=tightest([r.highest_system_load for r in rules]),
-        cpu=tightest([r.highest_cpu_usage for r in rules]),
-        qps=tightest([r.qps for r in rules]),
-        avg_rt=tightest([r.avg_rt for r in rules]),
-        max_thread=tightest([r.max_thread for r in rules]),
+        load=tightest_threshold(*[r.highest_system_load for r in rules]),
+        cpu=tightest_threshold(*[r.highest_cpu_usage for r in rules]),
+        qps=tightest_threshold(*[r.qps for r in rules]),
+        avg_rt=tightest_threshold(*[r.avg_rt for r in rules]),
+        max_thread=tightest_threshold(*[r.max_thread for r in rules]),
     )

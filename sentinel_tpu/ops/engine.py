@@ -2363,6 +2363,19 @@ def tick(
     return state, TickOutput(verdict=verdict, wait_ms=wait_ms)
 
 
+def replace_system_columns(ruleset: RuleSet, system: RT.SystemTensors) -> RuleSet:
+    """Swap ONLY the system-threshold columns of a live ruleset — the
+    adaptive controller's upload path (sentinel_tpu/adaptive).
+
+    The SystemTensors leaves are ordinary traced arguments of the jitted
+    tick, so publishing new VALUES (five scalars, same shapes/dtypes) is
+    a plain device transfer: no retrace, no recompile, jaxpr
+    fingerprints untouched.  Each leaf is device_put as its own buffer —
+    two leaves must never share one (the XLA argument-dedup hazard
+    documented on SentinelClient._dev_col)."""
+    return ruleset._replace(system=jax.device_put(system))
+
+
 def compile_ruleset(
     cfg: EngineConfig,
     registry,

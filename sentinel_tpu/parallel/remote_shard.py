@@ -28,7 +28,6 @@ from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.core import errors as ERR
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
-from sentinel_tpu.utils.time_source import mono_s
 from sentinel_tpu.utils.record_log import record_log
 
 _H_CHUNK = _OBS.histogram(
@@ -74,7 +73,26 @@ class RemoteShard:
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._xid = 0
-        self._down_until = 0.0
+        # span-degrade state: the shared hysteresis primitive
+        # (adaptive/degrade.py) — enter on shard loss, serve fallback
+        # through the cooldown, exit on the first healthy exchange; every
+        # transition journaled as remote_shard.degrade.enter/exit
+        from sentinel_tpu.adaptive.degrade import Hysteresis
+
+        self._hy = Hysteresis(
+            "remote_shard.degrade",
+            cooldown_s=retry_interval_s,
+            attrs={"peer": f"{host}:{port}"},
+        )
+
+    # attribute-compatible view of the hysteresis cooldown (tests poke it)
+    @property
+    def _down_until(self) -> float:
+        return self._hy.until
+
+    @_down_until.setter
+    def _down_until(self, v: float) -> None:
+        self._hy.until = float(v)
 
     # -- connection ----------------------------------------------------------
 
@@ -259,7 +277,7 @@ class RemoteShard:
         if not pending:
             return rsps
         with self._lock:
-            if mono_s() < self._down_until:
+            if self._hy.cooling:
                 return rsps
             for attempt in (0, 1):  # one reconnect, like the netty client
                 # chunks written to THIS attempt's socket; on failure they
@@ -306,6 +324,9 @@ class RemoteShard:
                             if _t:
                                 t_sent[j] = _t
                             s.sendall(FP.pipe(_FP_SEND, wires[j]))
+                    # a full healthy exchange is the probe that heals the
+                    # shard (no-op unless a prior failure entered degrade)
+                    self._hy.exit()
                     return rsps
                 except OSError:
                     self._close()
@@ -332,9 +353,7 @@ class RemoteShard:
                         # that refused it, and without the cool-down every
                         # subsequent batch would re-pay the connect+write+
                         # fail latency and forfeit another window
-                        self._down_until = (
-                            mono_s() + self.retry_interval_s
-                        )
+                        self._hy.enter(cooldown_s=self.retry_interval_s)
                         record_log().warning(
                             "shard %s:%d unreachable — degrading for %.1fs",
                             self.host,

@@ -47,11 +47,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sentinel_tpu.adaptive import degrade as DG
+from sentinel_tpu.adaptive.controller import AdaptiveConfig, AdaptiveController
 from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.core import errors as ERR
 from sentinel_tpu.core import rules as R
 from sentinel_tpu.core.config import EngineConfig
-from sentinel_tpu.core.rule_tensors import hash_param
+from sentinel_tpu.core.rule_tensors import compile_system_rules, hash_param
 from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.obs import flight as FL
@@ -119,6 +121,36 @@ _C_RESOLVE_FAILED = OBS.counter(
     "sentinel_resolve_failures_total",
     "tick resolutions that raised; their items failed CLOSED (system block)",
 )
+# -- adaptive protection / backpressure (adaptive/): shed accounting, the
+# live admission ceiling, and the tick watchdog.  Registered at import so
+# the exposition surface carries them from process start.
+_SHED_HELP = "admissions shed before device dispatch, by stage and reason"
+_C_SHED: Dict[tuple, Any] = {
+    (st, rs): OBS.counter(
+        "sentinel_shed_total", _SHED_HELP, labels={"stage": st, "reason": rs}
+    )
+    for st, rs in (
+        ("admit", "queue_full"),
+        ("admit", "low_priority"),
+        ("admit", "fail_closed"),
+        ("admit", "deadline"),
+        ("admit", "chaos"),
+        ("tick", "deadline"),
+    )
+}
+_C_WATCHDOG = OBS.counter(
+    "sentinel_watchdog_fired_total",
+    "stalled engine ticks the watchdog failed CLOSED",
+)
+
+
+def _shed_counter(stage: str, reason: str):
+    c = _C_SHED.get((stage, reason))
+    if c is None:
+        c = _C_SHED[(stage, reason)] = OBS.counter(
+            "sentinel_shed_total", _SHED_HELP, labels={"stage": stage, "reason": reason}
+        )
+    return c
 
 #: chaos failpoints (chaos/failpoints.py) on the tick loop's own failure
 #: surfaces — one flag check per site when disarmed
@@ -136,6 +168,16 @@ _FP_FANOUT = FP.register(
 _FP_SEG_RESIZE = FP.register(
     "runtime.seg.resize", "background seg_u grow-and-swap compile", FP.HIT_ACTIONS
 )
+_FP_ADMIT = FP.register(
+    "runtime.client.admit",
+    "pre-engine admission shed check (a raise sheds the request CLOSED)",
+    FP.HIT_ACTIONS,
+)
+_FP_WD_STALL = FP.register(
+    "runtime.watchdog.stall",
+    "verdict readback entry (a delay stalls the tick for the watchdog)",
+    FP.HIT_ACTIONS,
+)
 
 
 @dataclass
@@ -150,6 +192,9 @@ class AcquireRequest:
     inbound: int
     param_hash: tuple  # param_dims hashed hot-param lanes (0 = none)
     pre_verdict: int = 0  # host-decided verdict (cluster denial) to record
+    #: absolute engine-time ms past which the answer is worthless to the
+    #: caller (0 = none); expired entries shed CLOSED before dispatch
+    deadline_ms: int = 0
     future: Optional[Future] = None
 
 
@@ -185,6 +230,9 @@ class ArrayBlock:
     inbound: Optional[np.ndarray] = None
     param_hash: Optional[np.ndarray] = None  # int32 [n, param_dims]
     pre_verdict: Optional[np.ndarray] = None
+    #: block-wide absolute engine-time deadline (0 = none); the untaken
+    #: remainder of an expired block sheds CLOSED at the tick builder
+    deadline_ms: int = 0
     future: Optional[Future] = None
     # internal progress
     taken: int = 0  # items already placed into ticks
@@ -218,6 +266,13 @@ class _PendingTick:
     # reached — no double-decrement, no double-respond (_fail_tick)
     blocks_done: int = 0
     fronts_done: int = 0
+    # watchdog handshake: exactly ONE side fans this tick out.  The
+    # resolver claims "done" after readback, the watchdog (or the
+    # resolve-failure path) claims "failed" — whoever wins the state
+    # transition under state_lock owns the fan-out; the loser discards.
+    state: str = "pending"  # pending | done | failed
+    state_lock: threading.Lock = field(default_factory=threading.Lock)
+    deadline_mono: float = 0.0  # mono_s() stall deadline (0 = unwatched)
 
 
 class Entry:
@@ -370,6 +425,8 @@ class SentinelClient:
         metric_log_dir: Optional[str] = None,
         block_log: bool = False,
         pipeline_depth: int = 0,
+        watchdog_timeout_s: float = 0.0,
+        admission_queue_limit: int = 0,
     ):
         from sentinel_tpu.core.config import app_name as cfg_app_name
         from sentinel_tpu.core.config import platform_engine_config
@@ -418,14 +475,48 @@ class SentinelClient:
         self._cluster_param_by_res: Dict[str, R.ParamFlowRule] = {}
         self._auth_host_rules: Dict[str, list] = {}
         self._param_lanes_by_res: Dict[str, list] = {}
-        self._cluster_degraded_active = False
-        self._cluster_degraded_until = 0.0
+        # the shared degrade-hysteresis primitive (adaptive/degrade.py):
+        # enter-on-failure with cooldown, exit on first healthy probe —
+        # same journal kinds / counters / gauge as before the refactor
+        self._cluster_hy = DG.Hysteresis(
+            "cluster.degrade",
+            cooldown_s=5.0,
+            counter_enter=_C_DEGRADE_ENTER,
+            counter_exit=_C_DEGRADE_EXIT,
+            gauge=_G_DEGRADED,
+        )
         # guards degrade-state transitions AND every ruleset recompile, so
         # the degraded flag each compile reads matches the ruleset committed
         self._cluster_lock = threading.RLock()
         self.cluster_retry_interval_s = 5.0
 
         self._sys = SystemStatusSampler()
+        # -- adaptive protection / deadline-aware backpressure -------------
+        # disabled mode is one `is None` / one flag check per call site
+        # (same contract as obs tracing and chaos failpoints, guarded by
+        # tests); enable_adaptive() arms the closed loop.
+        self._adaptive: Optional[AdaptiveController] = None
+        #: host copy of the STATIC system-rule tensors — the base the
+        #: controller folds its live ceilings into (tightest wins)
+        self._system_static = None
+        #: hard bound on the un-ticked acquire queue (0 = unbounded);
+        #: enable_adaptive() defaults it from AdaptiveConfig.queue_max
+        self._admission_max = max(0, int(admission_queue_limit))
+        #: single pre-computed flag the submit paths check — True only
+        #: while backpressure has anything to do (bound set or ladder up)
+        self._bp_armed = self._admission_max > 0
+        #: set on the first deadline-carrying submission; the tick
+        #: builder's expiry sweep runs only while True
+        self._deadlines_live = False
+        #: tick watchdog: fail a dispatched tick CLOSED when its outputs
+        #: are not host-visible within this budget (0 = off).  Threaded
+        #: mode only — sync mode has no loop to stall independently.
+        self.watchdog_timeout_s = max(0.0, float(watchdog_timeout_s))
+        self._wd_thread: Optional[threading.Thread] = None
+        #: dispatched ticks the watchdog may fail over; populated only
+        #: while the watchdog is armed (zero cost otherwise)
+        self._inflight_ticks: Dict[int, _PendingTick] = {}
+        self._inflight_lock = threading.Lock()
         # the tick compiles only the stages the loaded rule set needs (the
         # SPI slot-chain analog: absent slots cost nothing); rule loads that
         # change the feature set swap in a freshly compiled tick
@@ -433,6 +524,7 @@ class SentinelClient:
         self._tick = E.make_tick(self.cfg, donate=True, features=self._features)
         self._state = E.init_state(self.cfg)
         self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
+        self._system_static = compile_system_rules([], self.cfg)
         self._rules_dirty = False
 
         self._front_doors: list = []
@@ -543,6 +635,14 @@ class SentinelClient:
                 daemon=True,
             )
             self._thread.start()
+            if self.watchdog_timeout_s > 0:
+                self._wd_thread = threading.Thread(
+                    target=self._watchdog_loop,
+                    args=(self._stop_evt,),
+                    name="sentinel-tpu-watchdog",
+                    daemon=True,
+                )
+                self._wd_thread.start()
         if self._metric_log_enabled and self.metric_timer is None:
             from sentinel_tpu.metrics.timer import MetricTimerListener
             from sentinel_tpu.metrics.writer import MetricWriter
@@ -584,11 +684,20 @@ class SentinelClient:
             for k, v in asdict(self.cfg).items()
             if isinstance(v, (int, float, str, bool))
         }
+        ad = self._adaptive
         return {
             "app": self.app_name,
             "mode": self.mode,
             "enabled": self.enabled,
             "degraded": self._cluster_degraded_active,
+            "adaptive": {
+                "level": DG.LEVEL_NAMES[ad.ladder.level],
+                "ceiling": (
+                    -1.0 if ad.ceiling == float("inf") else round(ad.ceiling, 3)
+                ),
+            }
+            if ad is not None
+            else None,
             "pending_ticks": len(self._pending_ticks),
             "registered_resources": self.registry.num_resources,
             "rule_fingerprints": fps,
@@ -604,6 +713,9 @@ class SentinelClient:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self._wd_thread is not None:
+            self._wd_thread.join(timeout=2.0)
+            self._wd_thread = None
         # flush deferred readbacks so no caller future is abandoned, then
         # release the resolver threads (start() re-creates the pool)
         try:
@@ -622,6 +734,183 @@ class SentinelClient:
         if self.block_log is not None:
             self.block_log.flush()
         self._started = False
+
+    # -- adaptive protection / backpressure ---------------------------------
+
+    def enable_adaptive(self, cfg: Optional[AdaptiveConfig] = None) -> AdaptiveController:
+        """Arm closed-loop system-adaptive protection (adaptive/): a
+        per-tick controller republishes the SystemSlot ceilings
+        (maxPass × minRT) as live rule-tensor column values — a scalar
+        upload, never a recompile — and drives the unified degrade
+        ladder whose rungs the admission path enforces.  Idempotent;
+        returns the controller for inspection."""
+        with self._cluster_lock:
+            if self._adaptive is not None:
+                return self._adaptive
+            self._adaptive = AdaptiveController(cfg)
+            if self._admission_max == 0:
+                self._admission_max = int(self._adaptive.cfg.queue_max)
+            self._bp_armed = True
+        # the SystemSlot stage must exist in the compiled tick even with
+        # no static system rule; _select_features now includes it
+        self._recompile_rules()
+        return self._adaptive
+
+    def disable_adaptive(self) -> None:
+        """Disarm the closed loop and restore the static thresholds."""
+        with self._cluster_lock:
+            ad, self._adaptive = self._adaptive, None
+            if ad is None:
+                return
+            ad.disarm()
+            self._bp_armed = self._admission_max > 0
+        self._recompile_rules()
+
+    def _admission_shed(self, prio: int) -> Optional[str]:
+        """Pre-engine shed decision for one submission; returns the shed
+        reason or None to admit.  Fast path (backpressure disarmed) is
+        the single ``_bp_armed`` flag check."""
+        if not self._bp_armed:
+            return None
+        try:
+            FP.hit(_FP_ADMIT)  # chaos: a raise sheds this admission CLOSED
+        except Exception:  # stlint: disable=fail-open — sheds CLOSED (the caller maps any reason to BLOCK_SYSTEM); nothing is admitted
+            return "chaos"
+        ad = self._adaptive
+        level = ad.ladder.level if ad is not None else DG.NORMAL
+        if level >= DG.FAIL_CLOSED:
+            return "fail_closed"
+        qmax = self._admission_max
+        if qmax:
+            # unlocked reads — approximate is fine; blocks count too (a
+            # submit_block flood must not slip past the bound just
+            # because its items sit in _acq_blocks, not _acquires)
+            qd = len(self._acquires) + sum(
+                len(b.res) - b.taken for b in self._acq_blocks
+            )
+            if qd >= qmax:
+                return "queue_full"
+            if (
+                level >= DG.SHED_LOW_PRIORITY
+                and not prio
+                and ad is not None
+                and qd >= qmax * ad.cfg.shed_lowprio_frac
+            ):
+                return "low_priority"
+        elif level >= DG.SHED_LOW_PRIORITY and not prio:
+            # no queue bound configured: the rung itself sheds the
+            # non-prioritized share
+            return "low_priority"
+        return None
+
+    def _shed_blocked(self, stage: str, reason: str, n: int = 1) -> None:
+        _shed_counter(stage, reason).inc(n)
+
+    def _adaptive_step(self, ad: AdaptiveController, now_ms: int, load, cpu) -> None:
+        """One closed-loop control step, on the tick thread: collect the
+        signals row, advance controller + ladder, apply rung effects,
+        and publish changed ceilings into the live system columns."""
+        with self._lock:
+            qd = len(self._acquires) + sum(
+                len(b.res) - b.taken for b in self._acq_blocks
+            )
+        sig = ad.signals.observe_tick(
+            now_ms,
+            qd,
+            len(self._pending_ticks),
+            len(self._resolve_futs),
+            load,
+            cpu,
+        )
+        want = ad.on_tick(sig)
+        level = ad.ladder.level
+        self._bp_armed = level > DG.NORMAL or self._admission_max > 0
+        if level >= DG.CLUSTER_FALLBACK and (
+            self._cluster_flow_by_res or self._cluster_param_by_res
+        ):
+            # rung effect: stop paying token-server round-trips on the
+            # admission path; fallback-enabled cluster rules enforce
+            # locally.  Re-entering every tick extends the cooldown, so
+            # probes resume only after the ladder descends.
+            self._enter_cluster_degraded()
+        if want is not None:
+            qps, max_thread = want
+            sys_np = ad.system_columns(self._system_static, qps, max_thread)
+            with self._engine_lock:
+                # re-read under the lock: a concurrent rule recompile may
+                # have swapped the whole ruleset; only the system leaves
+                # are replaced (same shapes/dtypes — no recompile)
+                self._rules_dev = E.replace_system_columns(self._rules_dev, sys_np)
+
+    # -- tick watchdog -------------------------------------------------------
+
+    def _watchdog_loop(self, stop_evt: threading.Event) -> None:
+        period = max(self.watchdog_timeout_s / 4.0, 0.01)
+        while not stop_evt.wait(period):
+            try:
+                self._watchdog_scan()
+            except Exception:  # pragma: no cover  # stlint: disable=fail-open — a dead watchdog must not take serving down; next scan retries
+                from sentinel_tpu.utils.record_log import record_log
+
+                record_log().warning("watchdog scan failed", exc_info=True)
+
+    def _watchdog_scan(self) -> None:
+        """Fail CLOSED every dispatched tick whose outputs are not
+        host-visible past its stall deadline.  The state handshake with
+        the resolver guarantees exactly one side fans the tick out."""
+        now = mono_s()
+        with self._inflight_lock:
+            stalled = [
+                p
+                for p in self._inflight_ticks.values()
+                if p.deadline_mono and now > p.deadline_mono
+            ]
+        for p in stalled:
+            if not self._claim_tick(p, "failed"):
+                continue  # resolver won the race; tick is being fanned out
+            _C_WATCHDOG.inc()
+            OT.event("watchdog.fired")
+            FL.note(
+                "watchdog.fired",
+                n_obj=p.n_obj,
+                n_blk=p.n_blk,
+                budget_s=self.watchdog_timeout_s,
+            )
+            ad = self._adaptive
+            if ad is not None:
+                ad.note_severe()  # a stalled device is overload evidence
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().error(
+                "tick watchdog: device tick stalled past %.2fs — failing "
+                "%d object / %d block item(s) CLOSED",
+                self.watchdog_timeout_s,
+                p.n_obj,
+                p.n_blk,
+            )
+            self._fail_tick(p)
+            self._untrack_tick(p)
+
+    @staticmethod
+    def _claim_tick(p: _PendingTick, state: str) -> bool:
+        """Atomically move a tick pending→done/failed; False if another
+        side already owns the fan-out."""
+        with p.state_lock:
+            if p.state != "pending":
+                return False
+            p.state = state
+            return True
+
+    def _track_tick(self, p: _PendingTick) -> None:
+        if self.watchdog_timeout_s > 0:
+            p.deadline_mono = mono_s() + self.watchdog_timeout_s
+            with self._inflight_lock:
+                self._inflight_ticks[id(p)] = p
+
+    def _untrack_tick(self, p: _PendingTick) -> None:
+        if p.deadline_mono:
+            with self._inflight_lock:
+                self._inflight_ticks.pop(id(p), None)
 
     # -- rule compilation ---------------------------------------------------
 
@@ -642,7 +931,10 @@ class SentinelClient:
             feats.add("param")
         if self.authority_rules.get():
             feats.add("authority")
-        if self.system_rules.get():
+        if self.system_rules.get() or self._adaptive is not None:
+            # the adaptive controller publishes live ceilings through the
+            # system columns — the SystemSlot stage must be compiled in
+            # even with no static rule loaded
             feats.add("system")
         if any(
             r.control_behavior in (R.CONTROL_WARM_UP, R.CONTROL_WARM_UP_RATE_LIMITER)
@@ -806,6 +1098,12 @@ class SentinelClient:
                 system_rules=self.system_rules.get(),
                 param_lanes=lane_map,
             )
+            # host copy of the STATIC system thresholds: the adaptive
+            # controller folds its live ceilings into these (tightest
+            # wins), so a recompile resets the base, never the loop
+            self._system_static = compile_system_rules(
+                self.system_rules.get(), self.cfg
+            )
             feats = self._select_features(local_flow, local_param)
             changed = static_flip or feats != self._features
             if changed:
@@ -830,28 +1128,39 @@ class SentinelClient:
         token service (client or embedded server role)."""
         self.cluster = cluster_state_manager
 
+    # attribute-compatible views of the shared hysteresis state (tests
+    # and the chaos harness read/poke these directly)
+    @property
+    def _cluster_degraded_active(self) -> bool:
+        return self._cluster_hy.active
+
+    @_cluster_degraded_active.setter
+    def _cluster_degraded_active(self, v: bool) -> None:
+        self._cluster_hy.active = bool(v)
+
+    @property
+    def _cluster_degraded_until(self) -> float:
+        return self._cluster_hy.until
+
+    @_cluster_degraded_until.setter
+    def _cluster_degraded_until(self, v: float) -> None:
+        self._cluster_hy.until = float(v)
+
     def _enter_cluster_degraded(self) -> None:
         """Token service unreachable: enforce fallback-enabled cluster rules
         locally until a probe succeeds.  Idempotent — extends the cooldown
         without recompiling if already degraded.  The flag flip and the
         recompile are atomic under _cluster_lock so a concurrent exit/enter
-        pair can't commit a stale ruleset for the winning state."""
+        pair can't commit a stale ruleset for the winning state.
+        Transition mechanics (cooldown arithmetic, counters, gauge,
+        journal) live in the shared adaptive.degrade.Hysteresis."""
         entered = False
         with self._cluster_lock:
-            self._cluster_degraded_until = (
-                mono_s() + self.cluster_retry_interval_s
+            entered = self._cluster_hy.enter(
+                cooldown_s=self.cluster_retry_interval_s
             )
-            if not self._cluster_degraded_active:
-                self._cluster_degraded_active = True
-                _C_DEGRADE_ENTER.inc()
-                _G_DEGRADED.set(1)
-                OT.event("cluster.degrade.enter")
-                FL.note(
-                    "cluster.degrade.enter",
-                    cooldown_s=self.cluster_retry_interval_s,
-                )
+            if entered:
                 self._recompile_rules()
-                entered = True
         if entered:
             # black box: freeze the state that produced the degrade —
             # outside the lock (bundle capture reads rule managers and
@@ -860,12 +1169,7 @@ class SentinelClient:
 
     def _exit_cluster_degraded(self) -> None:
         with self._cluster_lock:
-            if self._cluster_degraded_active:
-                self._cluster_degraded_active = False
-                _C_DEGRADE_EXIT.inc()
-                _G_DEGRADED.set(0)
-                OT.event("cluster.degrade.exit")
-                FL.note("cluster.degrade.exit")
+            if self._cluster_hy.exit():
                 self._recompile_rules()
 
     def _authority_pre_blocks(self, resource: str, origin: str) -> bool:
@@ -1056,10 +1360,15 @@ class SentinelClient:
         args: Optional[Sequence[Any]] = None,
         inbound: bool = False,
         origin: Optional[str] = None,
+        deadline_ms: int = 0,
         _ctx: Optional[Tuple[str, str]] = None,
         _push_ctx: bool = True,
     ) -> Entry:
         """Acquire; raises BlockException on rejection (SphU.entry).
+
+        ``deadline_ms`` (absolute engine-time ms, 0 = none): past it the
+        caller no longer wants the answer — still-queued expired entries
+        shed CLOSED before device dispatch instead of burning a tick.
 
         ``_ctx``/``_push_ctx`` support entry_async: the context is captured
         in the awaiting task and the push happens there too."""
@@ -1087,6 +1396,24 @@ class SentinelClient:
             if _push_ctx:
                 CTX.push_entry(e)
             return e  # capacity overflow → pass-through (CtSph.java:200)
+        if self._bp_armed:
+            # backpressure rungs / bounded admission (adaptive/degrade.py):
+            # shed CLOSED before any engine or cluster work — but AFTER
+            # the pass-through branch (ungoverned traffic never enters
+            # the queue, so backpressure must not turn it into a block)
+            reason = self._admission_shed(1 if prioritized else 0)
+            if reason is not None:
+                self._shed_blocked("admit", reason)
+                if self.mode == "sync":
+                    # the control loop must keep stepping even when every
+                    # submission sheds — a sync client's ONLY tick driver
+                    # is its submissions, and without this FAIL_CLOSED
+                    # could never observe calm and descend
+                    self.tick_once()
+                raise ERR.SystemBlockException(resource)
+        if deadline_ms and deadline_ms < self.time.now_ms():
+            self._shed_blocked("admit", "deadline")
+            raise ERR.SystemBlockException(resource)
 
         # ordered custom slots (runtime/slots.py): entry side here; the
         # exit side unwinds on Entry.exit OR on rejection below.  Pass-
@@ -1129,7 +1456,11 @@ class SentinelClient:
         if args:
             # hash one argument per assigned lane (rule param_idx -> lane
             # mapping from rule_tensors.param_lanes); lane 0's value also
-            # feeds the cluster token request
+            # feeds the cluster token request.  At PARAM_TAIL_OFF and
+            # above the ladder sheds the host-side param TAIL work (the
+            # hot-param value counters) — enforcement hashes still flow.
+            ad = self._adaptive
+            tail_off = ad is not None and ad.ladder.level >= DG.PARAM_TAIL_OFF
             lanes = self._param_lanes_by_res.get(resource) or [0]
             for li, idx in enumerate(lanes[:M]):
                 if 0 <= idx < len(args):
@@ -1137,7 +1468,8 @@ class SentinelClient:
                     param_hashes[li] = hash_param(v)
                     if li == 0:
                         param_value = v
-                    self._note_hot_param(resource, v)
+                    if not tail_off:
+                        self._note_hot_param(resource, v)
 
         pre_verdict, cluster_wait = 0, 0
         if hook_exc is not None:
@@ -1167,9 +1499,12 @@ class SentinelClient:
             inbound=1 if inbound else 0,
             param_hash=tuple(param_hashes),
             pre_verdict=pre_verdict,
+            deadline_ms=int(deadline_ms),
             future=Future(),
         )
         with self._lock:
+            if deadline_ms:
+                self._deadlines_live = True
             self._acquires.append(req)
 
         if self.mode == "sync":
@@ -1319,6 +1654,7 @@ class SentinelClient:
         count: int = 1,
         prioritized: bool = False,
         inbound: bool = False,
+        deadline_ms: int = 0,
     ) -> Optional[Future]:
         """Non-blocking single acquire: queue the request and return its
         Future of (verdict, wait_ms), or None for unknown resources
@@ -1329,7 +1665,16 @@ class SentinelClient:
             return None
         rid = self.registry.resource_id(resource)
         if rid is None:
-            return None
+            return None  # pass-through: never queued, never backpressured
+        if self._bp_armed:
+            reason = self._admission_shed(1 if prioritized else 0)
+            if reason is not None:
+                self._shed_blocked("admit", reason)
+                if self.mode == "sync":
+                    self.tick_once()  # keep the control loop stepping
+                f: Future = Future()
+                f.set_result((int(ERR.BLOCK_SYSTEM), 0))
+                return f
         req = AcquireRequest(
             res=rid,
             count=count,
@@ -1341,9 +1686,12 @@ class SentinelClient:
             inbound=1 if inbound else 0,
             param_hash=(0,) * self.cfg.param_dims,
             pre_verdict=0,
+            deadline_ms=int(deadline_ms),
             future=Future(),
         )
         with self._lock:
+            if deadline_ms:
+                self._deadlines_live = True
             self._acquires.append(req)
         if self.mode == "sync":
             self.tick_once()
@@ -1357,6 +1705,7 @@ class SentinelClient:
         params: Optional[Sequence[Any]] = None,
         prioritized: Optional[Sequence[bool]] = None,
         inbound: bool = False,
+        deadline_ms: int = 0,
     ) -> List[Tuple[int, int]]:
         """Vector acquire: returns [(verdict, wait_ms)] per resource.
 
@@ -1364,6 +1713,11 @@ class SentinelClient:
         """
         if not self.enabled:
             return [(ERR.PASS, 0)] * len(resources)
+        shed: List[Optional[str]] = [None] * len(resources)
+        if self._bp_armed:
+            for i in range(len(resources)):
+                pr = 1 if (prioritized is not None and prioritized[i]) else 0
+                shed[i] = self._admission_shed(pr)
         has_cluster = bool(self._cluster_flow_by_res or self._cluster_param_by_res)
         # cluster consultation happens OUTSIDE self._lock (it may block on a
         # token-server roundtrip, which must not stall the tick thread) and
@@ -1375,6 +1729,8 @@ class SentinelClient:
         if has_cluster:
             groups: Dict[Tuple[str, Any], List[int]] = {}
             for i, name in enumerate(resources):
+                if shed[i] is not None:
+                    continue  # shed CLOSED below; must consume no token
                 if name in self._cluster_flow_by_res or name in self._cluster_param_by_res:
                     if self._authority_pre_blocks(
                         name, origins[i] if origins else ""
@@ -1388,10 +1744,21 @@ class SentinelClient:
                     pre_verdicts[i], pre_waits[i] = vs[j], ws[j]
         futures = []
         with self._lock:
+            if deadline_ms:
+                # armed under the queue lock so the sweep's all-clear
+                # check serializes with the items it must cover
+                self._deadlines_live = True
             for i, name in enumerate(resources):
                 rid = self.registry.resource_id(name)
                 if rid is None:
+                    # registry capacity exhausted -> contractually a
+                    # pass-through (CtSph.java:200); it never enters the
+                    # queue, so backpressure must not turn it into a block
                     futures.append(None)
+                    continue
+                if shed[i] is not None:
+                    self._shed_blocked("admit", shed[i])
+                    futures.append("shed")
                     continue
                 origin = origins[i] if origins else ""
                 pv = params[i] if params else None
@@ -1410,6 +1777,7 @@ class SentinelClient:
                     if pv is not None
                     else (0,) * self.cfg.param_dims,
                     pre_verdict=pre_verdicts[i],
+                    deadline_ms=int(deadline_ms),
                     future=Future(),
                 )
                 self._acquires.append(req)
@@ -1420,6 +1788,9 @@ class SentinelClient:
         for i, f in enumerate(futures):
             if f is None:
                 out.append((ERR.PASS, 0))
+                continue
+            if f == "shed":
+                out.append((ERR.BLOCK_SYSTEM, 0))
                 continue
             v, w = f.result(timeout=self.entry_timeout_s)
             if pre_waits[i] > 0 and v == ERR.PASS:
@@ -1442,6 +1813,7 @@ class SentinelClient:
         inbound: Optional[np.ndarray] = None,
         param_hash: Optional[np.ndarray] = None,
         pre_verdict: Optional[np.ndarray] = None,
+        deadline_ms: int = 0,
     ) -> Optional[Future]:
         """Bulk acquire: COLUMN ARRAYS of engine resource ids (from
         ``registry.resource_id``), no per-item Python objects.  Returns a
@@ -1462,6 +1834,17 @@ class SentinelClient:
             return None
         res = np.ascontiguousarray(res, dtype=np.int32)
         n = len(res)
+        if self._bp_armed:
+            reason = self._admission_shed(1)  # blocks shed only on hard limits
+            if reason in ("fail_closed", "queue_full", "chaos"):
+                self._shed_blocked("admit", reason, n)
+                if self.mode == "sync":
+                    self.tick_once()  # keep the control loop stepping
+                f: Future = Future()
+                f.set_result(
+                    (np.full(n, ERR.BLOCK_SYSTEM, np.int8), np.zeros(n, np.int32))
+                )
+                return f
         # negative ids would wrap in scatter paths — sanitize to trash
         if (res < 0).any():
             res = np.where(res < 0, np.int32(self.cfg.trash_row), res)
@@ -1488,12 +1871,15 @@ class SentinelClient:
                 else None
             ),
             pre_verdict=col(pre_verdict),
+            deadline_ms=int(deadline_ms),
             future=Future(),
             unresolved=n,
             verdicts=np.zeros(n, np.int8),
             waits=np.zeros(n, np.int32),
         )
         with self._lock:
+            if deadline_ms:
+                self._deadlines_live = True
             self._acq_blocks.append(blk)
         if self.mode == "sync":
             self.tick_once()
@@ -1617,6 +2003,12 @@ class SentinelClient:
 
     def _tick_once_locked(self, now_ms: Optional[int]) -> None:
         while True:
+            if self._deadlines_live:
+                # deadline-aware backpressure: work that has already
+                # expired is worthless — shed it CLOSED here, BEFORE it
+                # costs device dispatch (one queue pass, only while any
+                # deadline-carrying submission is live)
+                self._sweep_expired(now_ms)
             blocks = []
             with self._lock:
                 acq = self._acquires[: self.cfg.batch_size]
@@ -1714,6 +2106,16 @@ class SentinelClient:
                     fronts.append((door, cols))
                     room -= len(cols[0])
             if not acq and not n_comp and not fronts and not blocks and now_ms is None:
+                ad = self._adaptive
+                if ad is not None and (
+                    ad.ladder.level > DG.NORMAL or ad.ceiling != float("inf")
+                ):
+                    # the closed loop must keep stepping on EMPTY ticks:
+                    # at FAIL_CLOSED everything sheds before the engine,
+                    # and without this the ladder would never observe the
+                    # calm that lets it descend
+                    load, cpu = self._sys.sample()
+                    self._adaptive_step(ad, self.time.now_ms(), load, cpu)
                 # idle: flush any deferred readbacks before returning
                 self._drain_resolves()
                 return
@@ -1773,6 +2175,50 @@ class SentinelClient:
                 if not more:
                     return
             now_ms = None  # subsequent drain loops use fresh time
+
+    def _sweep_expired(self, now_ms: Optional[int]) -> None:
+        """Shed already-expired queued work CLOSED before device dispatch
+        (the admission half of deadline-aware backpressure; the watchdog
+        covers work already ON the device)."""
+        now = now_ms if now_ms is not None else self.time.now_ms()
+        expired: List[AcquireRequest] = []
+        exp_blocks: List[ArrayBlock] = []
+        with self._lock:
+            if any(r.deadline_ms and r.deadline_ms < now for r in self._acquires):
+                keep = []
+                for r in self._acquires:
+                    (expired if r.deadline_ms and r.deadline_ms < now else keep).append(r)
+                self._acquires = keep
+            if any(
+                b.deadline_ms and b.deadline_ms < now for b in self._acq_blocks
+            ):
+                kept = []
+                for b in self._acq_blocks:
+                    (exp_blocks if b.deadline_ms and b.deadline_ms < now else kept).append(b)
+                self._acq_blocks = kept
+            if not any(r.deadline_ms for r in self._acquires) and not any(
+                b.deadline_ms for b in self._acq_blocks
+            ):
+                # no deadline-carrying work left anywhere: disarm the
+                # sweep (the flag re-arms under this same lock at the
+                # next deadline submission, so nothing can slip between)
+                self._deadlines_live = False
+        for r in expired:
+            if r.future is not None and not r.future.done():
+                r.future.set_result((int(ERR.BLOCK_SYSTEM), 0))
+        if expired:
+            self._shed_blocked("tick", "deadline", len(expired))
+        for blk in exp_blocks:
+            remaining = len(blk.res) - blk.taken
+            blk.verdicts[blk.taken :] = ERR.BLOCK_SYSTEM
+            blk.waits[blk.taken :] = 0
+            blk.taken = len(blk.res)
+            with self._blk_lock:
+                blk.unresolved -= remaining
+                fire = blk.unresolved <= 0
+            if fire and blk.future is not None and not blk.future.done():
+                blk.future.set_result((blk.verdicts, blk.waits))
+            self._shed_blocked("tick", "deadline", remaining)
 
     def update_window_shape(
         self,
@@ -2231,6 +2677,9 @@ class SentinelClient:
             (res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a, _tag,
              *aux_a) = comp
             n = len(res_a)
+            if self._adaptive is not None and n:
+                # BBR minRT input: this tick's completion RT floor
+                self._adaptive.signals.note_completions(n, float(rt_a.min()))
             if presort and n > 1:
                 _tp = OT.t0()
                 # completions carry no futures — sort in place, no unsort
@@ -2302,6 +2751,11 @@ class SentinelClient:
         load, cpu = self._sys.sample()
         t = now_ms if now_ms is not None else self.time.now_ms()
         t += FP.skew_ms(_FP_TICK_CLOCK)  # chaos: deterministic clock skew
+        ad = self._adaptive
+        if ad is not None:
+            # closed loop: signals row -> controller -> ladder + live
+            # system-column ceilings (disabled mode: the one check above)
+            self._adaptive_step(ad, t, load, cpu)
         # running average of host batch-build time (assembly + presort +
         # column upload dispatch) — the serial host share of a tick; read
         # via host_build_ms_avg (benchmark decomposition, ops dashboards)
@@ -2336,6 +2790,7 @@ class SentinelClient:
             tick_id=tick_id,
             dispatched_ns=_disp_done,
         )
+        self._track_tick(p)  # watchdog coverage (no-op while disarmed)
         if self._pipeline_depth:
             # start the device→host verdict transfer NOW so it overlaps
             # the next tick's host build + device compute (tunnel RTT /
@@ -2386,6 +2841,13 @@ class SentinelClient:
         try:
             self._resolve_tick_inner(p)
         except Exception as exc:  # stlint: disable=fail-open — items fail CLOSED (BLOCK_SYSTEM) below; nothing is admitted or stranded
+            if not self._claim_tick(p, "failed"):
+                with p.state_lock:
+                    if p.state == "failed":
+                        return  # the watchdog already failed this tick over
+                # state == "done": this thread claimed the fan-out and then
+                # broke partway — finish the remaining consumers CLOSED
+                # (_fail_tick is partial-fan-out safe)
             _C_RESOLVE_FAILED.inc()
             FL.note(
                 "resolve.fail_closed",
@@ -2404,6 +2866,8 @@ class SentinelClient:
                 exc_info=True,
             )
             self._fail_tick(p)
+        finally:
+            self._untrack_tick(p)
 
     def _fail_tick(self, p: _PendingTick) -> None:
         """Resolve every consumer of a failed tick with a fail-closed
@@ -2453,6 +2917,8 @@ class SentinelClient:
         thread.  Everything it touches is per-tick (futures, disjoint
         block slices) or lock-protected (drop counters)."""
         FP.hit(_FP_READBACK)  # chaos: a raise fails this tick closed
+        FP.hit(_FP_WD_STALL)  # chaos: a delay here stalls the readback —
+        # the stand-in for a hung device tick the watchdog must fail over
         out = p.out
         # stlint: disable-next-line=host-sync — THE designed readback point (see class docstring)
         verdict = np.asarray(out.verdict)
@@ -2488,11 +2954,22 @@ class SentinelClient:
         if _t_rb:
             OT.stage("tick.readback", _t_rb, _H_READBACK, trace=p.tick_id)
         FP.hit(_FP_FANOUT)  # chaos: raise BEFORE any consumer resolves
+        if not self._claim_tick(p, "done"):
+            return  # the watchdog failed this tick over while we read back
+        self._untrack_tick(p)
         _t_res = OT.t0()
         if p.inv_a is not None:
             # map sorted-batch verdicts back to submission order
             verdict = verdict[p.inv_a]
             wait = wait[p.inv_a]
+        if self._adaptive is not None:
+            n_real = p.n_obj + p.n_blk + sum(
+                len(cols[0]) for _d, cols in p.fronts
+            )
+            if n_real:
+                v = verdict[:n_real]
+                passed = int(((v == ERR.PASS) | (v == ERR.PASS_WAIT)).sum())
+                self._adaptive.signals.note_resolved(passed, n_real - passed)
         for i, r in enumerate(p.acq):
             if r.future is not None:
                 r.future.set_result((int(verdict[i]), int(wait[i])))
