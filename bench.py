@@ -43,6 +43,7 @@ is ~100 ms with high variance, so
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -490,6 +491,21 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
 
         fut.add_done_callback(on_done)
 
+    # measured wire bytes (sentinel_wire_bytes_total deltas): the actual
+    # host<->device transfer per tick — the number ROADMAP item 1 must
+    # shrink — next to the modeled transport_mb_per_tick estimate
+    def _wire_snapshot() -> dict:
+        out_w = {}
+        for path_l in ("device", "cluster"):
+            for d in ("tx", "rx"):
+                m = obs.REGISTRY.get(
+                    "sentinel_wire_bytes_total",
+                    {"path": path_l, "direction": d},
+                )
+                out_w[f"{path_l}_{d}"] = float(m.value) if m is not None else 0.0
+        return out_w
+
+    wire0 = _wire_snapshot()
     inflight = depth + 4
     t0 = time.perf_counter()
     for _ in range(min(inflight, n_blocks)):
@@ -498,6 +514,12 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
         c.tick_once()
     wall = time.perf_counter() - t0
     obs.disable()
+    wire1 = _wire_snapshot()
+    wire_bytes = {k: round(wire1[k] - wire0[k]) for k in wire1}
+    wire_bytes["device_mb_per_tick"] = round(
+        (wire_bytes["device_tx"] + wire_bytes["device_rx"]) / max(n_blocks, 1) / 1e6,
+        3,
+    )
     # {stage: {count, p50_ms, p99_ms, ...}} — decomposes req_p99_ms into
     # where each millisecond goes (BENCH_r0N consumers read this directly)
     stage_breakdown = obs.summarize(obs.TRACER.snapshot(), prefix="tick.")
@@ -526,6 +548,7 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
         "pipeline_depth": depth,
         "host_build_ms_avg": round(c.host_build_ms_avg, 2),
         "stage_breakdown_ms": stage_breakdown,
+        "wire_bytes": wire_bytes,
         "transport_mb_per_tick": round(up_mb + down_mb, 2),
         "transport_bound_note": (
             "measured through the TPU tunnel (~10 MB/s effective): batch "
@@ -696,6 +719,208 @@ def cluster_sharded_bench(n_requests: int = 2000, workers: int = 8) -> dict:
     return out
 
 
+# -- perf-regression sentry (--smoke + PERF_BASELINE.json) -------------------
+#
+# A fast, CPU-reproducible measurement of the serving path's throughput
+# shape, pinned against committed tolerances so the r01→r07 perf
+# trajectory cannot silently regress while the hot path is rewritten.
+# `python bench.py --smoke` measures; `--update-baseline` re-pins after an
+# INTENTIONAL perf change; tests/test_perf_sentry.py runs the comparison
+# as a slow-marked test (and a fast synthetic-regression check).
+
+PERF_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "PERF_BASELINE.json"
+)
+
+#: default tolerance per metric: min_ratio flags measured/baseline below
+#: it (throughput floors), max_ratio flags above (latency/overhead
+#: ceilings), max_abs flags an absolute ceiling.  0.6 catches a 2x
+#: regression (ratio 0.5) with CPU-timing headroom; best-of-K sampling
+#: keeps honest runs well above it.
+DEFAULT_TOLERANCES = {
+    "engine_tick_dps": {"min_ratio": 0.6},
+    "client_path_dps": {"min_ratio": 0.6},
+    # wall-clock mean over few ticks — the noisiest metric here (a busy
+    # CI box doubles it without any code change), so the ceiling only
+    # catches gross host-path regressions
+    "host_build_ms": {"max_ratio": 2.5},
+    "telemetry_overhead_pct": {"max_abs": 5.0},
+    "stats_readback_bytes": {"max_abs": 256.0},
+}
+
+
+def _best_of(fn, repeats: int = 3) -> float:
+    """max over repeats — scheduler noise only ever slows a run down, so
+    the best sample is the least-noisy throughput estimate."""
+    return max(fn() for _ in range(repeats))
+
+
+def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
+    """The sentry's measurement set (CPU-reproducible, ~tens of seconds):
+
+    - ``engine_tick_dps``: jitted engine-only tick throughput at a small
+      plain-path config (the kernel-shape guard);
+    - ``telemetry_overhead_pct``: the same run with device_telemetry off
+      vs on — the acceptance bound for the PR 8 stats row (<= 5%);
+    - ``stats_readback_bytes``: the telemetry row's added readback;
+    - ``client_path_dps`` / ``host_build_ms``: decisions/s through the
+      public SentinelClient bulk path (registry + assembly + readback).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    def engine_dps(telemetry: bool) -> float:
+        cfg = small_engine_config(
+            batch_size=B, complete_batch_size=B, device_telemetry=telemetry
+        )
+        tick = E.make_tick(cfg, donate=False, features=E.ALL_FEATURES)
+
+        class _Reg:
+            def resource_id(self, n):
+                return 1
+
+        rules = E._compile_ruleset(cfg, _Reg(), [], [], [], [], [], None)
+        state = E.init_state(cfg)
+        rng = np.random.default_rng(0)
+        acq = E.empty_acquire(cfg)._replace(
+            res=jnp.asarray(rng.integers(1, 64, B), jnp.int32),
+            count=jnp.ones(B, jnp.int32),
+            inbound=jnp.ones(B, jnp.int32),
+        )
+        comp = E.empty_complete(cfg)
+        z = jnp.float32(0.0)
+        for w in range(2):  # compile + warm
+            state, out = tick(state, rules, acq, comp, jnp.int32(w), z, z)
+        jax.block_until_ready(out.verdict)
+
+        def once() -> float:
+            nonlocal state
+            t0 = time.perf_counter()
+            for t in range(n_ticks):
+                state, out = tick(
+                    state, rules, acq, comp, jnp.int32(1000 + 7 * t), z, z
+                )
+            jax.block_until_ready(out.verdict)
+            return n_ticks * B / (time.perf_counter() - t0)
+
+        return _best_of(once)
+
+    dps_off = engine_dps(False)
+    dps_on = engine_dps(True)
+    overhead_pct = max((dps_off / max(dps_on, 1.0) - 1.0) * 100.0, 0.0)
+
+    # client path: public bulk API on a sync client (one process, CPU)
+    c = SentinelClient(cfg=small_engine_config(batch_size=1024), mode="sync")
+    c.start()
+    try:
+        names = [f"smoke-{i}" for i in range(32)]
+        ids = np.asarray([c.registry.resource_id(n) for n in names], np.int32)
+        c.flow_rules.load([FlowRule(resource=n, count=1e9) for n in names])
+        rng = np.random.default_rng(1)
+        res = ids[rng.integers(0, len(ids), 1024)].astype(np.int32)
+        fut = c.submit_block(res)  # warm both shapes
+        c.tick_once()
+
+        def once() -> float:
+            t0 = time.perf_counter()
+            for _ in range(8):
+                f = c.submit_block(res)
+                c.tick_once()
+                assert f is None or f.done()
+            return 8 * len(res) / (time.perf_counter() - t0)
+
+        client_dps = _best_of(once)
+        host_build_ms = c.host_build_ms_avg
+    finally:
+        c.stop()
+
+    return {
+        "metrics": {
+            "engine_tick_dps": round(dps_on),
+            "engine_tick_dps_telemetry_off": round(dps_off),
+            "telemetry_overhead_pct": round(overhead_pct, 2),
+            "stats_readback_bytes": E.N_STATS * 4,
+            "client_path_dps": round(client_dps),
+            "host_build_ms": round(host_build_ms, 3),
+        },
+        "batch": B,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def compare_to_baseline(measured: dict, baseline: dict) -> list:
+    """Tolerance check: measured smoke metrics vs the committed baseline.
+    Returns a list of human-readable regression strings (empty = pass).
+    Metrics present in only one side are ignored — adding a metric must
+    not fail old baselines, and a re-pin picks it up."""
+    out = []
+    mm = measured.get("metrics", measured)
+    bm = baseline.get("metrics", {})
+    tols = baseline.get("tolerances", DEFAULT_TOLERANCES)
+    for key, tol in tols.items():
+        m = mm.get(key)
+        b = bm.get(key)
+        if m is None:
+            continue
+        if "max_abs" in tol and m > tol["max_abs"]:
+            out.append(
+                f"{key}: measured {m} exceeds absolute ceiling {tol['max_abs']}"
+            )
+        if b in (None, 0):
+            continue
+        ratio = m / b
+        if "min_ratio" in tol and ratio < tol["min_ratio"]:
+            out.append(
+                f"{key}: measured {m} is {ratio:.2f}x baseline {b} "
+                f"(floor {tol['min_ratio']}x) — perf regression"
+            )
+        if "max_ratio" in tol and ratio > tol["max_ratio"]:
+            out.append(
+                f"{key}: measured {m} is {ratio:.2f}x baseline {b} "
+                f"(ceiling {tol['max_ratio']}x) — perf regression"
+            )
+    return out
+
+
+def load_perf_baseline(path: str = PERF_BASELINE_PATH) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _smoke_main(update_baseline: bool) -> int:
+    measured = smoke_bench()
+    if update_baseline:
+        doc = {
+            "metrics": measured["metrics"],
+            "tolerances": DEFAULT_TOLERANCES,
+            "platform": measured["platform"],
+        }
+        with open(PERF_BASELINE_PATH, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({"perf_smoke": measured, "baseline_written": True}))
+        return 0
+    regressions = []
+    have_baseline = os.path.exists(PERF_BASELINE_PATH)
+    if have_baseline:
+        regressions = compare_to_baseline(measured, load_perf_baseline())
+    print(
+        json.dumps(
+            {
+                "perf_smoke": measured,
+                "baseline": have_baseline,
+                "regressions": regressions,
+            }
+        )
+    )
+    return 1 if regressions else 0
+
+
 def main() -> None:
     use_tpu = _tpu_available()
     import jax
@@ -855,6 +1080,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        # the perf-regression sentry: fast CPU-reproducible measurements
+        # compared against PERF_BASELINE.json (exit 1 on regression);
+        # --update-baseline re-pins after an intentional perf change
+        sys.exit(_smoke_main("--update-baseline" in sys.argv))
     if "--cluster-sharded" in sys.argv:
         # the fleet row alone (host path only — no device build): fast
         # enough to run on CPU, which is how BENCH_r06 captured it

@@ -84,6 +84,11 @@ class SignalCollector:
         self._rt_min_ring = [float("inf")] * _RING
         self._rt_i = 0
         self._last_now_ms = 0
+        # last device telemetry row's windowed RT floor / pass sum
+        # (runtime/client feeds these from TickOutput.stats; they back the
+        # ring-based floor when no completion batch fed it this window)
+        self._dev_min_rt = 0.0
+        self._dev_win_pass = 0.0
         # the labeled cluster RPC failure counters already on the global
         # registry; get-or-create returns the live instances
         self._rpc_fail_counters = [
@@ -107,6 +112,19 @@ class SignalCollector:
         """Per-tick verdict counts from the resolver (any thread)."""
         self._pass_total += int(passed)
         self._block_total += int(blocked)
+
+    def note_device_stats(self, row) -> None:
+        """One device telemetry row (ops/engine.STAT_* float32 vector,
+        already host-resident — runtime/client reads it back with the
+        verdicts).  The on-device ENTRY-window RT floor and pass sum are
+        kept as fallbacks: a verdict-only workload (no completion batches)
+        otherwise never feeds the BBR minRT input."""
+        from sentinel_tpu.ops import engine as E
+        from sentinel_tpu.ops import window as W
+
+        mn = float(row[E.STAT_WIN_RT_MIN])
+        self._dev_min_rt = 0.0 if mn >= W.RT_MIN_INIT else mn
+        self._dev_win_pass = float(row[E.STAT_WIN_PASS])
 
     def note_completions(self, n: int, rt_min_ms: float) -> None:
         """Completion batch summary from the tick builder."""
@@ -179,7 +197,9 @@ class SignalCollector:
             pass_rate=pass_rate,
             block_rate=block_rate,
             max_pass_rate=max_rate,
-            min_rt_ms=0.0 if rt_floor == float("inf") else rt_floor,
+            min_rt_ms=(
+                self._dev_min_rt if rt_floor == float("inf") else rt_floor
+            ),
             rt_ewma_ms=self.rt_ewma_ms,
             inflight=self.inflight,
             rpc_fail_rate=max(rpc_rate, 0.0),

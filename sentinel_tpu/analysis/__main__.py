@@ -61,11 +61,12 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--tier",
-        choices=("ast", "jaxpr", "both"),
+        choices=("ast", "jaxpr", "both", "metrics"),
         default=None,
         help=(
             "which analyzer tier(s) to run (default: both without explicit "
-            "paths, ast with them)"
+            "paths, ast with them; 'metrics' runs only the metric-catalog "
+            "lint — registry names in source vs the README catalog table)"
         ),
     )
     ap.add_argument(
@@ -124,6 +125,19 @@ def main(argv=None) -> int:
         return 0
 
     tier = args.tier or ("ast" if args.paths else "both")
+    if tier == "metrics":
+        # standalone catalog lint: no Finding/baseline machinery — the
+        # catalog is a strict contract, not accumulated debt
+        from sentinel_tpu.analysis.metrics_catalog import check_catalog
+
+        problems = check_catalog(
+            os.path.join(REPO_ROOT, "sentinel_tpu"),
+            os.path.join(REPO_ROOT, "README.md"),
+        )
+        for p in problems:
+            print(f"metric-catalog: {p}")
+        print(f"-- metric catalog: {len(problems)} problem(s)")
+        return 1 if problems else 0
 
     # -- pass selection (both tiers share the --rules namespace) ------------
     ast_passes = list(ALL_PASSES)

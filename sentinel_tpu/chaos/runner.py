@@ -968,6 +968,22 @@ def _scn_overload_storm(seed: int) -> ScenarioResult:
             )
         ],
     )
+    # SLO burn-rate phase (obs/slo.py): a shed-ratio objective anchored
+    # BEFORE the storm must page on the storm's registry deltas and land
+    # an auto-captured flight bundle.  Evaluation is registry reads only
+    # — it crosses no failpoint site, so injected counts stay seed-pure.
+    from sentinel_tpu.obs.slo import CounterSum, SloEngine, SloSpec
+
+    slo_spec = SloSpec(
+        "shed_ratio",
+        objective=0.999,  # ≤0.1% shed budget: the 2× storm must page
+        bad=CounterSum(("sentinel_shed_total",)),
+        total=CounterSum(
+            ("sentinel_shed_total", "sentinel_device_verdicts_total")
+        ),
+    )
+    slo = SloEngine(specs=(slo_spec,))
+    slo.step(0)  # pre-storm anchor snapshot
     seq0 = FLIGHT.recorded_total()
     with session.window(plan):
         # the preset is shared with bench.adaptive_overload_bench so the
@@ -975,6 +991,11 @@ def _scn_overload_storm(seed: int) -> ScenarioResult:
         on = run_overload_sim(
             adaptive=True, adaptive_cfg=storm_controller_preset()
         )
+    FLIGHT.reset_rate_limit()  # pin bundle capture (prior scenarios may
+    # have triggered within the min-interval window)
+    slo_status = slo.step(6_000_000)[0]
+    slo_bundle = FLIGHT.last_bundle()
+    slo.close()
     off = run_overload_sim(adaptive=False)
     journal = [
         e
@@ -1029,6 +1050,23 @@ def _scn_overload_storm(seed: int) -> ScenarioResult:
             and len(journal) > 0,
             f"{len(journal)} flight events vs "
             f"{len(on.ladder_transitions)} transitions",
+        ),
+        (
+            "slo-burn-alert-fired",
+            slo_status.fired and slo_status.alerting,
+            f"shed-ratio burn {max(slo_status.burn.values(), default=0.0):.1f}"
+            f" never crossed the page thresholds",
+        ),
+        (
+            "slo-bundle-captured",
+            slo_bundle is not None
+            and slo_bundle.get("reason") == "slo-burn-shed_ratio"
+            and "slo" in (slo_bundle.get("providers") or {})
+            and any(
+                e["kind"] == "slo.alert" and e["seq"] >= seq0
+                for e in FLIGHT.events()
+            ),
+            "no auto-captured slo-burn bundle with an slo provider section",
         ),
     ]
     for nm, ok, detail in checks:

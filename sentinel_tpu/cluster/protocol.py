@@ -34,9 +34,25 @@ from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
 
 from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.obs.registry import REGISTRY as _OBS
 
 MAX_FRAME = 65535  # 2-byte length prefix ceiling; RES_CHECK batches chunk
 # client-side (parallel/remote_shard.py) so ordinary frames stay small
+
+#: wire byte accounting at THE codec choke point (every cluster frame —
+#: client and server, requests and responses — passes through exactly one
+#: encode and one decode), frame-length-prefix included.  Same metric name
+#: as the host<->device accounting in runtime/client.py; the path label
+#: separates them.
+_WIRE_HELP = "bytes moved, by path (device|cluster) and direction (tx|rx)"
+_C_WIRE_TX = _OBS.counter(
+    "sentinel_wire_bytes_total", _WIRE_HELP,
+    labels={"path": "cluster", "direction": "tx"},
+)
+_C_WIRE_RX = _OBS.counter(
+    "sentinel_wire_bytes_total", _WIRE_HELP,
+    labels={"path": "cluster", "direction": "rx"},
+)
 
 # param type tags
 _T_INT = 0
@@ -185,10 +201,12 @@ def encode_request(req: ClusterRequest) -> bytes:
     body = head + payload
     if len(body) > MAX_FRAME:
         raise ValueError("frame too large")
+    _C_WIRE_TX.inc(len(body) + 2)
     return struct.pack(">H", len(body)) + body
 
 
 def decode_request(body: bytes) -> ClusterRequest:
+    _C_WIRE_RX.inc(len(body) + 2)  # +2: the stripped length prefix
     xid, t = struct.unpack_from(">iB", body, 0)
     p = body[5:]
     req = ClusterRequest(xid=xid, type=t)
@@ -236,10 +254,12 @@ def encode_response(rsp: ClusterResponse) -> bytes:
     # every response payload is either fixed-size or count-bounded, so an
     # appended trace tail is skipped cleanly even by a legacy reader
     body = head + payload + _trace_tail(rsp.trace_id, rsp.span_id)
+    _C_WIRE_TX.inc(len(body) + 2)
     return struct.pack(">H", len(body)) + body
 
 
 def decode_response(body: bytes) -> ClusterResponse:
+    _C_WIRE_RX.inc(len(body) + 2)  # +2: the stripped length prefix
     xid, t, status = struct.unpack_from(">iBb", body, 0)
     p = body[6:]
     rsp = ClusterResponse(xid=xid, type=t, status=status)

@@ -209,6 +209,15 @@ class EngineConfig:
     sketch_depth: int = 2
     sketch_width: int = 1 << 14  # CMS eps = e/width of window volume
     sketch_capacity: int = 1 << 22  # max interned sketch resources
+    # device-resident telemetry (ops/engine._device_stats): the tick emits
+    # one compact float32 stats row (verdict mix by block reason, admitted/
+    # blocked token sums, seg occupancy, adaptive-ceiling utilization, and
+    # the ENTRY node's O(1) sliding-window pass/RT sums) alongside the
+    # verdicts — the client folds it into the obs registry instead of
+    # re-deriving the same numbers from a host-side verdict scan.  The row
+    # is engine.N_STATS floats (<= 256 bytes of extra readback per tick);
+    # off => TickOutput.stats is None and the tick program is unchanged
+    device_telemetry: bool = True
 
     def __post_init__(self):
         # the native completion ring transports exactly four hot-param

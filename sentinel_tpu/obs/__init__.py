@@ -35,6 +35,7 @@ from sentinel_tpu.obs.registry import (
     Histogram,
     MetricRegistry,
     register_build_info,
+    register_scrape_id,
 )
 from sentinel_tpu.obs.flight import FLIGHT, FlightRecorder, load_bundle
 from sentinel_tpu.obs.trace import (
@@ -56,6 +57,7 @@ from sentinel_tpu.obs.trace import (
 
 #: every process that imports the obs plane identifies itself on /metrics
 register_build_info()
+register_scrape_id()
 
 
 def enable(jax_annotations: bool = False) -> None:
@@ -99,6 +101,7 @@ __all__ = [
     "new_trace_id",
     "now_ns",
     "register_build_info",
+    "register_scrape_id",
     "span",
     "stage",
     "stage_ns",

@@ -278,6 +278,21 @@ def _print_postmortem(path: str, out=None) -> None:
         print("incident counters (non-zero):", file=out)
         for k, v in hot.items():
             print(f"  {k} = {v:g}", file=out)
+    # histogram p99 exemplars: the trace ids to chase in the merged
+    # Perfetto view — a bad quantile's own span, by id
+    exemplars = {
+        k: v["p99_exemplar"]
+        for k, v in sorted(metrics.items())
+        if isinstance(v, dict) and "p99_exemplar" in v
+    }
+    if exemplars:
+        print("p99 exemplars (trace-linkable):", file=out)
+        for k, e in exemplars.items():
+            print(
+                f"  {k} le={e['le']} value={e['value']:g}ms "
+                f"trace_id={e['trace_id']}",
+                file=out,
+            )
 
 
 def _print_summary(spans: List[dict], out=None) -> None:
@@ -341,8 +356,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="analyze a flight-recorder bundle (GET /api/flight / "
         "SENTINEL_FLIGHT_DIR): merged event/span timeline + providers",
     )
+    ap.add_argument(
+        "--fleet",
+        nargs="*",
+        metavar="TARGET",
+        help="scrape + merge fleet /metrics into one exposition "
+        "(targets: host:port or URL; none => SENTINEL_FLEET_TARGETS + "
+        "registered targets + this process's registry)",
+    )
     args = ap.parse_args(argv)
 
+    if args.fleet is not None:
+        from sentinel_tpu.obs.fleet import fleet_exposition
+
+        text = fleet_exposition(targets=args.fleet or None)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(f"wrote {args.out} ({len(text.splitlines())} lines)")
+        else:
+            sys.stdout.write(text)
+        return 0
     if args.postmortem:
         _print_postmortem(args.postmortem)
         return 0

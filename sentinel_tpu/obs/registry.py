@@ -149,6 +149,11 @@ class Histogram(_Metric):
         self._counts = np.zeros(self.n_buckets + 1, np.int64)
         self._sum = 0.0
         self._count = 0
+        # per-bucket last exemplar: bucket index -> (value, trace_id str).
+        # Populated only when observe() is handed an exemplar (the obs
+        # stage helpers pass the active tick trace id), so a bad quantile
+        # links straight to its Perfetto span.
+        self._exemplars: Dict[int, Tuple[float, str]] = {}
 
     def _index(self, v: float) -> int:
         if v <= self.start:
@@ -157,12 +162,14 @@ class Histogram(_Metric):
         i = e - 1 if m == 0.5 else e  # smallest i with v <= start * 2**i
         return i if i < self.n_buckets else self.n_buckets
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         i = self._index(v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[i] = (float(v), str(exemplar))
 
     def merge(self, other: "Histogram") -> None:
         """Fold another histogram's samples in (bench aggregation)."""
@@ -181,6 +188,15 @@ class Histogram(_Metric):
     def sum(self) -> float:
         return self._sum
 
+    def count_over(self, threshold: float) -> int:
+        """Samples above ``threshold`` at bucket resolution: everything in
+        buckets whose full range lies above the bucket holding the
+        threshold (a slight undercount within one bucket, never an
+        overcount) — the latency-SLO "bad events" read (obs/slo.py)."""
+        i = self._index(threshold)
+        with self._lock:
+            return int(self._counts[i + 1 :].sum())
+
     def quantile(self, q: float) -> float:
         """Bucket-resolution quantile estimate (upper bound of the bucket
         holding the q-th sample); 0.0 when empty, last finite bound for
@@ -197,6 +213,33 @@ class Histogram(_Metric):
             if cum >= rank:
                 return float(self.bounds[min(i, self.n_buckets - 1)])
         return float(self.bounds[-1])
+
+    def p99_exemplar(self) -> Optional[dict]:
+        """The exemplar linking the p99 to its trace: the record stored in
+        the bucket holding the 99th-percentile sample, else the highest
+        recorded bucket below it, else the closest recorded bucket above
+        it (exemplars are only stored for traced observations, so the
+        exact bucket may have none).  None when no exemplar was ever
+        recorded."""
+        with self._lock:
+            if not self._exemplars:
+                return None
+            counts = self._counts.copy()
+            total = self._count
+            ex = dict(self._exemplars)
+        rank = max(1, math.ceil(0.99 * total))
+        cum = 0
+        p99_i = self.n_buckets
+        for i in range(self.n_buckets + 1):
+            cum += int(counts[i])
+            if cum >= rank:
+                p99_i = i
+                break
+        below = [i for i in ex if i <= p99_i]
+        i = max(below) if below else min(ex)  # else: closest bucket above
+        v, trace_id = ex[i]
+        le = _fmt(self.bounds[i]) if i < self.n_buckets else "+Inf"
+        return {"le": le, "value": v, "trace_id": trace_id}
 
     def samples(self):
         # snapshot under the lock so bucket/sum/count agree
@@ -273,6 +316,12 @@ class MetricRegistry:
         key = (name, tuple(sorted((labels or {}).items())))
         return self._metrics.get(key)
 
+    def series(self, name: str) -> List[_Metric]:
+        """Every live series (label set) under one metric name — the SLO
+        engine's read surface (obs/slo.py sums label sets per family)."""
+        with self._lock:
+            return [m for (n, _), m in self._metrics.items() if n == name]
+
     def exposition(self) -> str:
         """Prometheus text format 0.0.4 over every registered metric."""
         with self._lock:
@@ -290,6 +339,18 @@ class MetricRegistry:
                 lines.append(f"# TYPE {name} {kinds.get(name, m.kind)}")
             for suffix, labstr, value in m.samples():
                 lines.append(f"{name}{suffix}{labstr} {_fmt(value)}")
+            if isinstance(m, Histogram):
+                # exemplar comment (the 0.0.4 text format has no exemplar
+                # syntax; OpenMetrics-style data rides a comment so plain
+                # scrapers stay compatible): the p99 bucket's trace id,
+                # the --postmortem / Perfetto jump-off point
+                e = m.p99_exemplar()
+                if e is not None:
+                    lab = m.labels + (("le", e["le"]),)
+                    lines.append(
+                        f"# EXEMPLAR {name}_bucket{_fmt_labels(lab)} "
+                        f"trace_id={e['trace_id']} value={_fmt(e['value'])}"
+                    )
         return "\n".join(lines) + "\n" if lines else ""
 
     def snapshot(self) -> dict:
@@ -306,6 +367,9 @@ class MetricRegistry:
                     "p50": m.quantile(0.5),
                     "p99": m.quantile(0.99),
                 }
+                e = m.p99_exemplar()
+                if e is not None:
+                    out[key]["p99_exemplar"] = e
             else:
                 out[key] = m.value
         return out
@@ -354,4 +418,29 @@ def register_build_info(registry: Optional[MetricRegistry] = None) -> Gauge:
     g.set(1)
     if registry is None:
         _BUILD_INFO = g
+    return g
+
+
+#: process-unique scrape identity (fleet aggregation dedupe): random so a
+#: forked/restarted process never collides with its predecessor's id
+_SCRAPE_ID_VALUE = os.urandom(8).hex()
+_SCRAPE_ID: Optional[Gauge] = None
+
+
+def register_scrape_id(registry: Optional[MetricRegistry] = None) -> Gauge:
+    """``sentinel_scrape_id{id="<hex>"} 1`` — the info-gauge the fleet
+    aggregator (obs/fleet.py) uses to recognize that two scrape targets
+    answered from the SAME process (e.g. the scraping process's own
+    command center listed as a fleet member) and merge it exactly once."""
+    global _SCRAPE_ID
+    if registry is None and _SCRAPE_ID is not None:
+        return _SCRAPE_ID
+    g = (registry or REGISTRY).gauge(
+        "sentinel_scrape_id",
+        "process-unique scrape identity (value 1; the id label carries it)",
+        labels={"id": _SCRAPE_ID_VALUE},
+    )
+    g.set(1)
+    if registry is None:
+        _SCRAPE_ID = g
     return g

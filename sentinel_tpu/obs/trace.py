@@ -394,11 +394,13 @@ def t0() -> int:
 
 def stage(name: str, t0_ns: int, hist=None, trace: int = 0, attrs: Optional[dict] = None) -> None:
     """Record a completed stage: span into the ring, duration into an
-    optional ms histogram.  Call only when ``t0_ns`` is truthy."""
+    optional ms histogram.  Call only when ``t0_ns`` is truthy.  The
+    trace id rides into the histogram as a bucket exemplar, so a bad
+    exposition quantile links back to its Perfetto span."""
     dur = now_ns() - t0_ns
     TRACER.record(name, t0_ns, dur, trace, attrs)
     if hist is not None:
-        hist.observe(dur / 1e6)
+        hist.observe(dur / 1e6, exemplar=f"{trace:x}" if trace else None)
 
 
 def stage_ns(
@@ -407,7 +409,7 @@ def stage_ns(
     """``stage`` with an explicit duration (accumulated or cross-thread)."""
     TRACER.record(name, t0_ns, dur_ns, trace, attrs)
     if hist is not None:
-        hist.observe(dur_ns / 1e6)
+        hist.observe(dur_ns / 1e6, exemplar=f"{trace:x}" if trace else None)
 
 
 def event(name: str, trace: int = 0, attrs: Optional[dict] = None) -> None:

@@ -181,6 +181,114 @@ class TickOutput(NamedTuple):
     # openness.  (Plain-int default: a jnp scalar here would initialize
     # the backend at import time.)
     seg_dropped: object = 0  # int32 scalar on the seg path
+    # device-resident telemetry row (cfg.device_telemetry): float32
+    # [N_STATS], computed on-device from tensors the tick already holds
+    # and read back alongside the verdicts — see _device_stats.  None
+    # when telemetry is off (the traced program is then unchanged).
+    stats: object = None
+
+
+# -- device-resident telemetry (TickOutput.stats) ---------------------------
+#
+# One compact float32 row per tick, summarizing what the host previously
+# re-derived by scanning the verdict array and re-reading engine state:
+# verdict mix by block reason, admitted/blocked token sums, segment
+# occupancy, adaptive-ceiling utilization, and the global ENTRY node's
+# sliding-window pass/RT sums.  The window reads are O(1) in window length
+# (per-bucket running sums maintained by ops/window.py — the "Efficient
+# Summing over Sliding Windows" shape, arXiv 1604.02450), so the whole row
+# costs a handful of small reductions against a tick that already streams
+# the full batch.  N_STATS * 4 bytes must stay <= 256 (readback budget,
+# pinned by tests/test_device_telemetry.py).
+
+STAT_VALID = 0  # non-padding items in the acquire batch
+STAT_PASS = 1  # verdict mix over valid items (first-fail slot order)
+STAT_PASS_WAIT = 2
+STAT_BLOCK_AUTHORITY = 3
+STAT_BLOCK_SYSTEM = 4
+STAT_BLOCK_PARAM = 5
+STAT_BLOCK_FLOW = 6
+STAT_BLOCK_DEGRADE = 7
+STAT_FORCED = 8  # host-injected pre_verdicts (cluster token denials)
+STAT_PASS_TOKENS = 9  # admitted token sum (count column)
+STAT_BLOCK_TOKENS = 10
+STAT_SEG_DROPPED = 11  # fail-closed seg-overflow items (0 off the seg path)
+STAT_SEG_LIVE = 12  # live compacted segments this tick (0 off the seg path)
+STAT_WIN_PASS = 13  # ENTRY-node sliding-window sums (post-tick)
+STAT_WIN_BLOCK = 14
+STAT_WIN_SUCCESS = 15
+STAT_WIN_EXCEPTION = 16
+STAT_WIN_RT_SUM = 17
+STAT_WIN_RT_MIN = 18  # W.RT_MIN_INIT when no completions in window
+STAT_ENTRY_CONC = 19  # global inbound concurrency
+STAT_CEIL_QPS = 20  # active SystemTensors qps ceiling (-1 = unset)
+STAT_CEIL_THREAD = 21  # active SystemTensors max_thread ceiling
+STAT_CEIL_UTIL = 22  # windowed ENTRY pass / qps ceiling (0 when unset)
+N_STATS = 24  # slot 23 reserved; 96 bytes per tick
+
+
+def _device_stats(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq: AcquireBatch,
+    verdict,
+    valid,
+    now_ms,
+    seg_dropped,
+    seg_live,
+):
+    """Build the TickOutput.stats row (see the STAT_* index block).
+
+    Runs AFTER the acquire effects landed, so the window sums include
+    this tick — the numbers the next host-side control decision (adaptive
+    controller, SLO engine) actually wants."""
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    erow = cfg.entry_node_row
+    entry = jnp.array([erow], dtype=jnp.int32)
+    ec = W.gather_window_counts(state.win_sec, now_ms, entry, sec_cfg)[0]
+    ert, emin = W.gather_window_rt(state.win_sec, now_ms, entry, sec_cfg)
+
+    def n_of(code):
+        return jnp.sum(valid & (verdict == jnp.int8(code)))
+
+    admitted = valid & (
+        (verdict == jnp.int8(PASS)) | (verdict == jnp.int8(PASS_WAIT))
+    )
+    forced = valid & (acq.pre_verdict > 0)
+    win_pass = ec[W.EV_PASS].astype(jnp.float32)
+    qps = jnp.asarray(rules.system.qps, jnp.float32)
+    util = jnp.where(qps > 0, win_pass / jnp.maximum(qps, 1.0), 0.0)
+    vals = [
+        jnp.sum(valid),
+        n_of(PASS),
+        n_of(PASS_WAIT),
+        n_of(BLOCK_AUTHORITY),
+        n_of(BLOCK_SYSTEM),
+        n_of(BLOCK_PARAM),
+        n_of(BLOCK_FLOW),
+        n_of(BLOCK_DEGRADE),
+        jnp.sum(forced),
+        jnp.sum(jnp.where(admitted, acq.count, 0)),
+        jnp.sum(jnp.where(valid & ~admitted, acq.count, 0)),
+        seg_dropped,
+        seg_live,
+        win_pass,
+        ec[W.EV_BLOCK],
+        ec[W.EV_SUCCESS],
+        ec[W.EV_EXCEPTION],
+        ert[0],
+        emin[0],
+        state.concurrency[erow],
+        qps,
+        jnp.asarray(rules.system.max_thread, jnp.float32),
+        util,
+        0,
+    ]
+    assert len(vals) == N_STATS
+    return jnp.stack(
+        [jnp.asarray(v, jnp.float32).reshape(()) for v in vals]
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -2247,8 +2355,15 @@ def tick(
                 rl_info,
                 param_ctx,
             )
+        stats = None
+        if cfg.device_telemetry:
+            stats = _device_stats(
+                cfg, state, rules, acq, verdict, valid, now_ms,
+                seg_dropped, ctx_a.n_seg if use_seg else 0,
+            )
         return state, TickOutput(
-            verdict=verdict, wait_ms=wait_ms, seg_dropped=seg_dropped
+            verdict=verdict, wait_ms=wait_ms, seg_dropped=seg_dropped,
+            stats=stats,
         )
 
     with_nodes = "nodes" in features
@@ -2360,7 +2475,12 @@ def tick(
         )
         state = state._replace(pcms=pcms, pcms_epochs=pcms_epochs, pconc=pconc)
 
-    return state, TickOutput(verdict=verdict, wait_ms=wait_ms)
+    stats = None
+    if cfg.device_telemetry:
+        stats = _device_stats(
+            cfg, state, rules, acq, verdict, valid, now_ms, 0, 0
+        )
+    return state, TickOutput(verdict=verdict, wait_ms=wait_ms, stats=stats)
 
 
 def replace_system_columns(ruleset: RuleSet, system: RT.SystemTensors) -> RuleSet:

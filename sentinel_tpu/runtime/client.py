@@ -142,6 +142,71 @@ _C_WATCHDOG = OBS.counter(
     "sentinel_watchdog_fired_total",
     "stalled engine ticks the watchdog failed CLOSED",
 )
+# -- device-resident telemetry (cfg.device_telemetry): the engine emits a
+# compact stats row per tick (ops/engine.STAT_*) and the readback folds it
+# here — the registry's verdict-mix/ceiling/window view comes from the
+# DEVICE's accounting, not a host-side re-scan of the verdict array.
+_DEV_VERDICTS_HELP = (
+    "per-tick verdict mix reported by the device telemetry row, by verdict"
+)
+_C_DEV_VERDICTS: Dict[str, Any] = {
+    v: OBS.counter(
+        "sentinel_device_verdicts_total", _DEV_VERDICTS_HELP, labels={"verdict": v}
+    )
+    for v in (
+        "pass",
+        "pass_wait",
+        "block_authority",
+        "block_system",
+        "block_param",
+        "block_flow",
+        "block_degrade",
+    )
+}
+_C_DEV_TOKENS = {
+    r: OBS.counter(
+        "sentinel_device_tokens_total",
+        "admitted/blocked token sums from the device telemetry row",
+        labels={"result": r},
+    )
+    for r in ("pass", "block")
+}
+_C_DEV_FORCED = OBS.counter(
+    "sentinel_device_forced_verdicts_total",
+    "host-injected pre-verdicts (cluster token denials) the device recorded",
+)
+_G_DEV_WIN_PASS = OBS.gauge(
+    "sentinel_device_entry_pass_window",
+    "ENTRY-node sliding-window pass sum as computed on-device",
+)
+_G_DEV_MIN_RT = OBS.gauge(
+    "sentinel_device_entry_min_rt_ms",
+    "ENTRY-node windowed RT floor as computed on-device (0 = no completions)",
+)
+_G_DEV_CONC = OBS.gauge(
+    "sentinel_device_entry_concurrency",
+    "global inbound concurrency as computed on-device",
+)
+_G_DEV_CEIL_UTIL = OBS.gauge(
+    "sentinel_device_ceiling_utilization",
+    "windowed ENTRY pass over the active system qps ceiling (0 = no ceiling)",
+)
+_G_DEV_SEG_LIVE = OBS.gauge(
+    "sentinel_device_seg_live",
+    "live compacted segments in the last tick (seg path only)",
+)
+# -- wire byte accounting: what actually crosses the host<->device tunnel
+# and the cluster protocol per tick — the 5.37 MB/tick ROADMAP item 1
+# must shrink, so it is measured where it moves (bench emits the deltas
+# as the stage_breakdown_ms sibling key `wire_bytes`).
+_C_WIRE = {
+    d: OBS.counter(
+        "sentinel_wire_bytes_total",
+        "bytes moved, by path (device|cluster) and direction (tx|rx)",
+        labels={"path": "device", "direction": d},
+    )
+    for d in ("tx", "rx")
+}
 
 
 def _shed_counter(stage: str, reason: str):
@@ -2347,7 +2412,9 @@ class SentinelClient:
             if c is None:
                 c = jnp.asarray(x)
                 self._const_cols[key] = c
+                _C_WIRE["tx"].inc(x.nbytes)  # first (only) upload of the const
             return c
+        _C_WIRE["tx"].inc(x.nbytes)
         return jnp.asarray(x)
 
     # -- segment-capacity adaptation ---------------------------------------
@@ -2465,6 +2532,42 @@ class SentinelClient:
         finally:
             OT.TRACER.end(_h)
             self._seg_resizing = False
+
+    def _fold_device_stats(self, s) -> None:
+        """Land one device telemetry row (ops/engine.STAT_* float32 vector,
+        already host-resident) in the obs registry: verdict-mix counters
+        plus window/ceiling gauges.  Runs on the resolver path once per
+        tick — a dozen counter bumps against a ms-scale tick."""
+        n_pass = int(s[E.STAT_PASS])
+        n_wait = int(s[E.STAT_PASS_WAIT])
+        if n_pass:
+            _C_DEV_VERDICTS["pass"].inc(n_pass)
+        if n_wait:
+            _C_DEV_VERDICTS["pass_wait"].inc(n_wait)
+        for key, idx in (
+            ("block_authority", E.STAT_BLOCK_AUTHORITY),
+            ("block_system", E.STAT_BLOCK_SYSTEM),
+            ("block_param", E.STAT_BLOCK_PARAM),
+            ("block_flow", E.STAT_BLOCK_FLOW),
+            ("block_degrade", E.STAT_BLOCK_DEGRADE),
+        ):
+            n = int(s[idx])
+            if n:
+                _C_DEV_VERDICTS[key].inc(n)
+        n = int(s[E.STAT_FORCED])
+        if n:
+            _C_DEV_FORCED.inc(n)
+        n = int(s[E.STAT_PASS_TOKENS])
+        if n:
+            _C_DEV_TOKENS["pass"].inc(n)
+        n = int(s[E.STAT_BLOCK_TOKENS])
+        if n:
+            _C_DEV_TOKENS["block"].inc(n)
+        _G_DEV_WIN_PASS.set(float(s[E.STAT_WIN_PASS]))
+        _G_DEV_MIN_RT.set(_mask_min_rt(float(s[E.STAT_WIN_RT_MIN])))
+        _G_DEV_CONC.set(float(s[E.STAT_ENTRY_CONC]))
+        _G_DEV_CEIL_UTIL.set(float(s[E.STAT_CEIL_UTIL]))
+        _G_DEV_SEG_LIVE.set(float(s[E.STAT_SEG_LIVE]))
 
     def _record_seg_dropped(self, n: int) -> None:
         """Surface fail-closed segment-overflow drops: counter + block log
@@ -2922,6 +3025,7 @@ class SentinelClient:
         out = p.out
         # stlint: disable-next-line=host-sync — THE designed readback point (see class docstring)
         verdict = np.asarray(out.verdict)
+        _C_WIRE["rx"].inc(verdict.nbytes)
         if p.dispatched_ns and OT.TRACER.enabled:
             # dispatch → verdicts host-visible: device compute + transfer,
             # plus queue wait when pipelined (spans may overlap in time —
@@ -2937,18 +3041,37 @@ class SentinelClient:
         # residual host reads (drop count, wait column) — the device span
         # above already owns the blocking verdict transfer
         _t_rb = OT.t0()
+        # device telemetry row (ops/engine.STAT_*): one 96-byte transfer in
+        # the same readback phase; replaces the host-side verdict re-scans
+        # below (PASS_WAIT probe, adaptive pass/block accounting)
+        stats = None
+        if out.stats is not None:
+            stats = np.asarray(out.stats)  # stlint: disable=host-sync — readback point
+            _C_WIRE["rx"].inc(stats.nbytes)
+            self._fold_device_stats(stats)
         if p.check_dropped:
             # fail-closed capacity overflow must be LOUD (an engine
             # rejecting traffic because seg_u is undersized is an incident,
             # not a silent counter)
-            dropped = int(np.asarray(out.seg_dropped))  # stlint: disable=host-sync — readback point
+            if stats is not None:
+                dropped = int(stats[E.STAT_SEG_DROPPED])
+            else:
+                dropped = int(np.asarray(out.seg_dropped))  # stlint: disable=host-sync — readback point
+                _C_WIRE["rx"].inc(4)
             if dropped:
                 self._record_seg_dropped(dropped)
         # the wait column is only nonzero when some verdict is PASS_WAIT
         # (engine zeroes wait for non-passing items) — skip the 4x-larger
-        # transfer entirely on the common no-pacing tick
-        if bool((verdict == ERR.PASS_WAIT).any()):
+        # transfer entirely on the common no-pacing tick.  The device
+        # telemetry row answers "any PASS_WAIT?" without scanning the
+        # verdict array on the host.
+        if stats is not None:
+            any_wait = stats[E.STAT_PASS_WAIT] > 0
+        else:
+            any_wait = bool((verdict == ERR.PASS_WAIT).any())
+        if any_wait:
             wait = np.asarray(out.wait_ms)  # stlint: disable=host-sync — readback point
+            _C_WIRE["rx"].inc(wait.nbytes)
         else:
             wait = np.zeros(verdict.shape[0], np.int32)
         if _t_rb:
@@ -2963,13 +3086,23 @@ class SentinelClient:
             verdict = verdict[p.inv_a]
             wait = wait[p.inv_a]
         if self._adaptive is not None:
-            n_real = p.n_obj + p.n_blk + sum(
-                len(cols[0]) for _d, cols in p.fronts
-            )
-            if n_real:
-                v = verdict[:n_real]
-                passed = int(((v == ERR.PASS) | (v == ERR.PASS_WAIT)).sum())
-                self._adaptive.signals.note_resolved(passed, n_real - passed)
+            if stats is not None:
+                # device accounting: valid items ARE the real items (all
+                # padding carries the trash row), so the telemetry row
+                # replaces the host-side verdict scan
+                n_real = int(stats[E.STAT_VALID])
+                passed = int(stats[E.STAT_PASS] + stats[E.STAT_PASS_WAIT])
+                if n_real:
+                    self._adaptive.signals.note_resolved(passed, n_real - passed)
+                self._adaptive.signals.note_device_stats(stats)
+            else:
+                n_real = p.n_obj + p.n_blk + sum(
+                    len(cols[0]) for _d, cols in p.fronts
+                )
+                if n_real:
+                    v = verdict[:n_real]
+                    passed = int(((v == ERR.PASS) | (v == ERR.PASS_WAIT)).sum())
+                    self._adaptive.signals.note_resolved(passed, n_real - passed)
         for i, r in enumerate(p.acq):
             if r.future is not None:
                 r.future.set_result((int(verdict[i]), int(wait[i])))

@@ -183,9 +183,18 @@ class DefaultHandlerGroup:
         """``GET /metrics`` — the standard scrape surface: every counter /
         gauge / histogram in the process-global obs registry (tick-stage
         latencies, pipeline occupancy, seg drops, cluster degrade state,
-        RPC latencies) in Prometheus text format 0.0.4."""
+        RPC latencies) in Prometheus text format 0.0.4.
+
+        ``?fleet=1`` merges in every configured fleet member
+        (``obs.fleet.add_fleet_target`` / ``SENTINEL_FLEET_TARGETS``):
+        counters sum, histograms merge bucket-wise, per-shard labels
+        survive, same-process duplicates drop (obs/fleet.py)."""
         from sentinel_tpu.obs import REGISTRY
 
+        if (req.param("fleet") or "").lower() in ("1", "true"):
+            from sentinel_tpu.obs.fleet import fleet_exposition
+
+            return CommandResponse.of_success(fleet_exposition())
         return CommandResponse.of_success(REGISTRY.exposition())
 
     @command_mapping("api/traces", "span-tracer ring dump (Chrome trace JSON)")

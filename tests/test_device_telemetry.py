@@ -1,0 +1,159 @@
+"""Device-resident telemetry (ops/engine._device_stats, TickOutput.stats):
+on-device verdict-mix/window/ceiling accounting vs a host recompute, the
+256-byte readback budget, the client-side registry fold, and the adaptive
+signals feed."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from sentinel_tpu.core import errors as ERR
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.core.rules import FlowRule, SystemRule
+from sentinel_tpu.obs import REGISTRY
+from sentinel_tpu.ops import engine as E
+
+
+class _Reg:
+    def resource_id(self, n):
+        return 1
+
+
+def _tick_once(cfg, res, counts=None, inbound=None, pre=None, rules=None):
+    rules = rules if rules is not None else E._compile_ruleset(
+        cfg, _Reg(), [], [], [], [], [], None
+    )
+    st = E.init_state(cfg)
+    tick = E.make_tick(cfg, donate=False)
+    b = len(res)
+    acq = E.empty_acquire(cfg, b=b)._replace(
+        res=jnp.asarray(res, jnp.int32),
+        count=jnp.asarray(
+            counts if counts is not None else np.ones(b), jnp.int32
+        ),
+        inbound=jnp.asarray(
+            inbound if inbound is not None else np.ones(b), jnp.int32
+        ),
+        pre_verdict=jnp.asarray(
+            pre if pre is not None else np.zeros(b), jnp.int32
+        ),
+    )
+    comp = E.empty_complete(cfg, b=b)
+    z = jnp.float32(0.0)
+    st, out = tick(st, rules, acq, comp, jnp.int32(1000), z, z)
+    return st, out, acq
+
+
+def test_stats_row_matches_host_verdict_scan():
+    """The device row's verdict mix must equal what a host scan of the
+    verdict array computes — padding excluded, forced counted."""
+    cfg = small_engine_config()
+    trash = cfg.trash_row
+    res = [1, 1, 2, 3, trash, trash, 2, 1]
+    pre = [0, 0, 0, int(ERR.BLOCK_SYSTEM), 0, 0, 0, 0]
+    _st, out, _acq = _tick_once(cfg, res, pre=pre)
+    s = np.asarray(out.stats)
+    v = np.asarray(out.verdict)
+    valid = np.asarray(res) != trash
+    assert s[E.STAT_VALID] == valid.sum()
+    assert s[E.STAT_PASS] == ((v == ERR.PASS) & valid).sum()
+    assert s[E.STAT_BLOCK_SYSTEM] == ((v == ERR.BLOCK_SYSTEM) & valid).sum()
+    assert s[E.STAT_FORCED] == 1
+    assert s[E.STAT_PASS_TOKENS] == ((v == ERR.PASS) & valid).sum()
+    assert s[E.STAT_BLOCK_TOKENS] == 1  # the forced item's count
+
+
+def test_stats_row_counts_flow_blocks_and_window_sums():
+    cfg = small_engine_config()
+    rules = E._compile_ruleset(
+        cfg, _Reg(), [FlowRule(resource="r", count=2.0)], [], [], [], [], None
+    )
+    _st, out, _acq = _tick_once(cfg, [1] * 6, rules=rules)
+    s = np.asarray(out.stats)
+    assert s[E.STAT_PASS] == 2
+    assert s[E.STAT_BLOCK_FLOW] == 4
+    # post-effects ENTRY-window sums include this tick (O(1) window read)
+    assert s[E.STAT_WIN_PASS] == 2
+    assert s[E.STAT_WIN_BLOCK] == 4
+    assert s[E.STAT_ENTRY_CONC] == 2
+
+
+def test_stats_ceiling_utilization_tracks_system_rule():
+    cfg = small_engine_config()
+    rules = E._compile_ruleset(
+        cfg, _Reg(), [], [], [], [], [SystemRule(qps=100.0)], None
+    )
+    _st, out, _acq = _tick_once(cfg, [1] * 8, rules=rules)
+    s = np.asarray(out.stats)
+    assert s[E.STAT_CEIL_QPS] == 100.0
+    assert s[E.STAT_CEIL_UTIL] == pytest.approx(8 / 100.0)
+
+
+def test_stats_readback_budget_and_off_mode():
+    """<= 256 bytes of added readback; telemetry off => stats is None
+    (the traced program reverts)."""
+    cfg = small_engine_config()
+    _st, out, _acq = _tick_once(cfg, [1, 2, 3])
+    assert np.asarray(out.stats).nbytes <= 256
+    assert E.N_STATS * 4 <= 256
+    cfg_off = small_engine_config(device_telemetry=False)
+    _st, out_off, _acq = _tick_once(cfg_off, [1, 2, 3])
+    assert out_off.stats is None
+
+
+def test_client_folds_stats_into_registry(client_factory):
+    """The registry's sentinel_device_* series must be fed by the
+    readback fold, agreeing with the client-visible verdicts."""
+
+    def _dev(name, **labels):
+        m = REGISTRY.get(name, labels or None)
+        return float(m.value) if m is not None else 0.0
+
+    pass0 = _dev("sentinel_device_verdicts_total", verdict="pass")
+    blk0 = _dev("sentinel_device_verdicts_total", verdict="block_flow")
+    c = client_factory()
+    c.flow_rules.load([FlowRule(resource="dtm/r", count=3.0)])
+    verdicts = c.check_batch(["dtm/r"] * 8, inbound=True)
+    passed = sum(1 for v, _ in verdicts if v in (ERR.PASS, ERR.PASS_WAIT))
+    assert passed == 3
+    assert _dev("sentinel_device_verdicts_total", verdict="pass") - pass0 == passed
+    assert _dev("sentinel_device_verdicts_total", verdict="block_flow") - blk0 == 5
+    assert _dev("sentinel_device_entry_pass_window") >= passed
+
+
+def test_signals_consume_device_min_rt():
+    """A verdict-only workload (no completion batches) gets its BBR minRT
+    floor from the device window row instead of 0."""
+    from sentinel_tpu.adaptive.signals import SignalCollector
+
+    col = SignalCollector()
+    row = np.zeros(E.N_STATS, np.float32)
+    row[E.STAT_WIN_RT_MIN] = 7.5
+    row[E.STAT_WIN_PASS] = 42.0
+    col.note_device_stats(row)
+    sig = col.observe_tick(1000, 0, 0, 0, 0.0, 0.0)
+    assert sig.min_rt_ms == 7.5
+    # the RT_MIN_INIT sentinel (no completions in window) masks to 0
+    row[E.STAT_WIN_RT_MIN] = 5000.0
+    col.note_device_stats(row)
+    sig = col.observe_tick(2000, 0, 0, 0, 0.0, 0.0)
+    assert sig.min_rt_ms == 0.0
+
+
+def test_wire_byte_accounting_moves_with_traffic(client_factory):
+    tx = REGISTRY.get(
+        "sentinel_wire_bytes_total", {"path": "device", "direction": "tx"}
+    )
+    rx = REGISTRY.get(
+        "sentinel_wire_bytes_total", {"path": "device", "direction": "rx"}
+    )
+    tx0, rx0 = tx.value, rx.value
+    c = client_factory()
+    c.registry.resource_id("wire/r")
+    c.check_batch(["wire/r"] * 16)
+    assert tx.value > tx0  # batch columns uploaded
+    assert rx.value >= rx0 + 16 + E.N_STATS * 4  # verdicts + stats row

@@ -282,7 +282,7 @@ def test_exposition_lines_are_well_formed():
     )
     for line in text.strip().split("\n"):
         if line.startswith("#"):
-            assert line.startswith(("# HELP ", "# TYPE "))
+            assert line.startswith(("# HELP ", "# TYPE ", "# EXEMPLAR "))
         else:
             assert pat.match(line), line
 

@@ -1,0 +1,81 @@
+"""Perf-regression sentry (bench.py --smoke + PERF_BASELINE.json): the
+comparison logic must flag a synthetic 2x engine-throughput regression,
+and (slow) the real smoke run must pass against the committed baseline —
+protecting the r01→r07 perf trajectory while the hot path is rewritten."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+import bench
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def baseline():
+    return bench.load_perf_baseline()
+
+
+def test_baseline_is_committed_and_well_formed(baseline):
+    assert os.path.basename(bench.PERF_BASELINE_PATH) == "PERF_BASELINE.json"
+    assert set(baseline) >= {"metrics", "tolerances"}
+    for key, tol in baseline["tolerances"].items():
+        assert set(tol) & {"min_ratio", "max_ratio", "max_abs"}, key
+    # every floored/ceilinged ratio metric has a baseline value to
+    # compare against (max_abs-only bounds don't need one)
+    for key, tol in baseline["tolerances"].items():
+        if "min_ratio" in tol or "max_ratio" in tol:
+            assert key in baseline["metrics"], key
+
+
+def test_detects_synthetic_2x_throughput_regression(baseline):
+    """The acceptance case: fabricate a measurement where engine
+    throughput halved — the sentry must flag it."""
+    degraded = {"metrics": dict(baseline["metrics"])}
+    degraded["metrics"]["engine_tick_dps"] = (
+        baseline["metrics"]["engine_tick_dps"] / 2.0
+    )
+    regressions = bench.compare_to_baseline(degraded, baseline)
+    assert any("engine_tick_dps" in r and "regression" in r for r in regressions)
+    # ...and ONLY that metric is flagged
+    assert all("engine_tick_dps" in r for r in regressions), regressions
+
+
+def test_passes_on_identical_measurement(baseline):
+    measured = {"metrics": dict(baseline["metrics"])}
+    assert bench.compare_to_baseline(measured, baseline) == []
+
+
+def test_detects_latency_and_absolute_ceilings(baseline):
+    worse = {"metrics": dict(baseline["metrics"])}
+    # host_build_ms carries a deliberately loose 2.5x ceiling (wall-clock
+    # noise) — 3x must still be flagged
+    worse["metrics"]["host_build_ms"] = baseline["metrics"]["host_build_ms"] * 3.0
+    worse["metrics"]["telemetry_overhead_pct"] = 9.0
+    regs = bench.compare_to_baseline(worse, baseline)
+    assert any("host_build_ms" in r for r in regs)
+    assert any("telemetry_overhead_pct" in r for r in regs)
+
+
+def test_missing_metric_is_ignored_not_fatal(baseline):
+    """A baseline pinned before a metric existed must not fail the run
+    (and vice versa) — re-pinning picks new metrics up."""
+    measured = {"metrics": dict(baseline["metrics"])}
+    measured["metrics"].pop("client_path_dps", None)
+    assert bench.compare_to_baseline(measured, baseline) == []
+
+
+@pytest.mark.slow
+def test_real_smoke_run_passes_committed_baseline(baseline):
+    """The sentry's real half: measure this machine and compare.  Slow
+    (tens of seconds of jitted tick loops) and timing-sensitive by
+    nature — the tolerances carry the noise headroom."""
+    measured = bench.smoke_bench()
+    regressions = bench.compare_to_baseline(measured, baseline)
+    assert regressions == [], "\n".join(regressions)
+    # the PR 8 acceptance bound, measured fresh: device telemetry costs
+    # <= 5% of the engine tick
+    assert measured["metrics"]["telemetry_overhead_pct"] <= 5.0
