@@ -496,7 +496,7 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
     # shrink — next to the modeled transport_mb_per_tick estimate
     def _wire_snapshot() -> dict:
         out_w = {}
-        for path_l in ("device", "cluster"):
+        for path_l in ("device", "cluster", "timeline"):
             for d in ("tx", "rx"):
                 m = obs.REGISTRY.get(
                     "sentinel_wire_bytes_total",
@@ -520,6 +520,10 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
         (wire_bytes["device_tx"] + wire_bytes["device_rx"]) / max(n_blocks, 1) / 1e6,
         3,
     )
+    # the new per-resource timeline channel's wire cost, separated out so
+    # ROADMAP item 1's transport work sees it (rx = device readback of the
+    # top-K matrix, tx = metric-log bytes written behind the tick)
+    timeline_bytes = wire_bytes["timeline_rx"] + wire_bytes["timeline_tx"]
     # {stage: {count, p50_ms, p99_ms, ...}} — decomposes req_p99_ms into
     # where each millisecond goes (BENCH_r0N consumers read this directly)
     stage_breakdown = obs.summarize(obs.TRACER.snapshot(), prefix="tick.")
@@ -549,6 +553,7 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
         "host_build_ms_avg": round(c.host_build_ms_avg, 2),
         "stage_breakdown_ms": stage_breakdown,
         "wire_bytes": wire_bytes,
+        "timeline_bytes": timeline_bytes,
         "transport_mb_per_tick": round(up_mb + down_mb, 2),
         "transport_bound_note": (
             "measured through the TPU tunnel (~10 MB/s effective): batch "
@@ -746,6 +751,10 @@ DEFAULT_TOLERANCES = {
     "host_build_ms": {"max_ratio": 2.5},
     "telemetry_overhead_pct": {"max_abs": 5.0},
     "stats_readback_bytes": {"max_abs": 256.0},
+    # the per-resource timeline matrix (top-K selection + bucket gather,
+    # ops/engine._device_res_stats) at K=128 — the PR 9 acceptance bound
+    "timeline_overhead_pct": {"max_abs": 5.0},
+    "timeline_readback_bytes": {"max_abs": 4096.0},
 }
 
 
@@ -760,9 +769,13 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
 
     - ``engine_tick_dps``: jitted engine-only tick throughput at a small
       plain-path config (the kernel-shape guard);
-    - ``telemetry_overhead_pct``: the same run with device_telemetry off
-      vs on — the acceptance bound for the PR 8 stats row (<= 5%);
-    - ``stats_readback_bytes``: the telemetry row's added readback;
+    - ``telemetry_overhead_pct``: device_telemetry off vs the scalar
+      stats row alone — the acceptance bound for the PR 8 row (<= 5%);
+    - ``timeline_overhead_pct``: the scalar row alone vs + the K=128
+      per-resource timeline matrix — the PR 9 acceptance bound (<= 5%;
+      the config widens max_resources to 256 so K is genuinely 128);
+    - ``stats_readback_bytes`` / ``timeline_readback_bytes``: added
+      readback per tick of each channel;
     - ``client_path_dps`` / ``host_build_ms``: decisions/s through the
       public SentinelClient bulk path (registry + assembly + readback).
     """
@@ -774,9 +787,14 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
     from sentinel_tpu.ops import engine as E
     from sentinel_tpu.runtime.client import SentinelClient
 
-    def engine_dps(telemetry: bool) -> float:
+    def engine_dps(telemetry: bool, timeline_k: int = 0) -> float:
         cfg = small_engine_config(
-            batch_size=B, complete_batch_size=B, device_telemetry=telemetry
+            batch_size=B,
+            complete_batch_size=B,
+            device_telemetry=telemetry,
+            timeline_k=timeline_k,
+            max_resources=256,
+            max_nodes=512,
         )
         tick = E.make_tick(cfg, donate=False, features=E.ALL_FEATURES)
 
@@ -808,11 +826,16 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             jax.block_until_ready(out.verdict)
             return n_ticks * B / (time.perf_counter() - t0)
 
-        return _best_of(once)
+        # the overhead percentages divide two of these runs, so scheduler
+        # noise in EITHER direction doubles; extra repeats keep the
+        # telemetry/timeline bounds honest rather than flaky
+        return _best_of(once, repeats=5)
 
     dps_off = engine_dps(False)
     dps_on = engine_dps(True)
+    dps_tl = engine_dps(True, timeline_k=128)
     overhead_pct = max((dps_off / max(dps_on, 1.0) - 1.0) * 100.0, 0.0)
+    tl_overhead_pct = max((dps_on / max(dps_tl, 1.0) - 1.0) * 100.0, 0.0)
 
     # client path: public bulk API on a sync client (one process, CPU)
     c = SentinelClient(cfg=small_engine_config(batch_size=1024), mode="sync")
@@ -843,8 +866,11 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
         "metrics": {
             "engine_tick_dps": round(dps_on),
             "engine_tick_dps_telemetry_off": round(dps_off),
+            "engine_tick_dps_timeline_k128": round(dps_tl),
             "telemetry_overhead_pct": round(overhead_pct, 2),
+            "timeline_overhead_pct": round(tl_overhead_pct, 2),
             "stats_readback_bytes": E.N_STATS * 4,
+            "timeline_readback_bytes": 128 * E.TL_COLS * 4,
             "client_path_dps": round(client_dps),
             "host_build_ms": round(host_build_ms, 3),
         },

@@ -26,7 +26,9 @@ Scenarios (the acceptance set):
   seg_overflow_storm  fail-closed segment-capacity overflow + live
                       seg_u grow-and-swap under injected resize delay
   datasource_flap     rule-file refresh loop faults; rules hold, then
-                      the post-heal update applies
+                      the post-heal update applies; a second window
+                      faults the timeline metric-log writes, which fail
+                      OPEN (decisions untouched, failures counted)
   shard_reconnect     mid-window shard partition: answered chunks stay
                       resolved, unanswered degrade, no replay
   shard_failover      fleet shard kill/partition/rejoin: only the dead
@@ -584,14 +586,21 @@ def _scn_seg_overflow_storm(seed: int) -> ScenarioResult:
 def _scn_datasource_flap(seed: int) -> ScenarioResult:
     """The rule-file refresh loop faults for a burst: the loaded rule set
     must hold (enforcement unchanged), and the first healthy refresh must
-    apply the update that accumulated during the flap."""
+    apply the update that accumulated during the flap.  A second fault
+    window then breaks the TIMELINE metric-log's disk writes
+    (``datasource.metriclog.write``): the timeline fails OPEN — entry
+    verdicts are untouched, every failed flush is counted in
+    ``sentinel_timeline_write_failures_total``, and the injected counts
+    stay a pure function of the seed (flushes fire on virtual-time
+    second boundaries the scenario controls)."""
     import json as _json
 
     from sentinel_tpu.core import rules as R
     from sentinel_tpu.datasource.base import FileRefreshableDataSource
 
     t0 = mono_s()
-    client = _make_client()
+    tl_dir = tempfile.mkdtemp(prefix="sentinel_chaos_timeline_")
+    client = _make_client(timeline_log=True, timeline_dir=tl_dir)
     vt = client.time
     resource = "chaos/ds"
 
@@ -645,20 +654,52 @@ def _scn_datasource_flap(seed: int) -> ScenarioResult:
             got = _drain_entries(client, resource, 6)  # limit 5 -> 5/1
             totals["passed"] += got["passed"]
             totals["blocked"] += got["blocked"]
+        # phase 2: timeline metric-log disk writes fail — the timeline
+        # must fail OPEN.  Each virtual-second advance makes the next
+        # tick flush exactly one completed second of rows, so the site's
+        # hit order (and therefore the injected count) is seed-pure.
+        plan_tl = FaultPlan(
+            name="datasource_flap_timeline",
+            seed=seed + 1,
+            faults=[
+                FaultSpec(
+                    "datasource.metriclog.write", "raise",
+                    burst_start=0, burst_len=2, exc="OSError",
+                )
+            ],
+        )
+        with session.window(plan_tl):
+            for _ in range(2):  # two flushes, both injected to fail
+                vt.advance(1100)
+                got = _drain_entries(client, resource, 6)  # limit 5 -> 5/1
+                extra["timeline_fall_open_decisions"] = (
+                    extra.get("timeline_fall_open_decisions", True)
+                    and got == {"passed": 5, "blocked": 1}
+                )
+                totals["passed"] += got["passed"]
+                totals["blocked"] += got["blocked"]
     finally:
         if ds is not None:
             ds.close()
         os.unlink(path)
         client.stop()
-    extra["expect_metric_deltas"] = {}
+        import shutil
+
+        shutil.rmtree(tl_dir, ignore_errors=True)
+    extra["expect_metric_deltas"] = {
+        "sentinel_timeline_write_failures_total": 2,
+    }
     ctx = ScenarioContext(
         metrics=metrics,
         client=client,
-        submitted=14,
+        submitted=26,
         passed=totals["passed"],
         blocked=totals["blocked"],
         injected=session.injected,
-        expect_injected={"datasource.refresh.read:raise": 3},
+        expect_injected={
+            "datasource.refresh.read:raise": 3,
+            "datasource.metriclog.write:raise": 2,
+        },
         extra=extra,
     )
     verdicts = evaluate(
@@ -667,8 +708,16 @@ def _scn_datasource_flap(seed: int) -> ScenarioResult:
             "rules-intact",
             "pipeline-drained",
             "injected-as-planned",
+            "metric-deltas",
         ],
         ctx,
+    )
+    verdicts.append(
+        Verdict(
+            "timeline-fails-open",
+            bool(extra.get("timeline_fall_open_decisions")),
+            "entry verdicts must not change while metric-log writes fail",
+        )
     )
     return _result("datasource_flap", seed, session, verdicts, t0)
 

@@ -218,6 +218,18 @@ class EngineConfig:
     # is engine.N_STATS floats (<= 256 bytes of extra readback per tick);
     # off => TickOutput.stats is None and the tick program is unchanged
     device_telemetry: bool = True
+    # per-resource timeline rows (obs/timeline.py): with device telemetry
+    # on, each tick additionally emits a float32 [K, TL_COLS] matrix —
+    # the top-K resource rows by windowed pass+block (selected ON-DEVICE
+    # from the O(1) sliding-window sums the tick already maintains) with
+    # their CURRENT second-window bucket's cumulative pass/block/success/
+    # exception/rt/concurrency.  The host folds successive bucket reads
+    # into exact per-second records and serves them from an indexed
+    # on-disk metric log (GET /api/metric).  Clamped to the resource-row
+    # space; 0 disables the matrix (TickOutput.res_stats is None and the
+    # traced program is unchanged vs. timeline off).  K*32 bytes of extra
+    # readback per tick (4 KiB at the default 128).
+    timeline_k: int = 128
 
     def __post_init__(self):
         # the native completion ring transports exactly four hot-param

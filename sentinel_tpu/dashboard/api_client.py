@@ -98,6 +98,25 @@ class SentinelApiClient:
                 continue
         return out
 
+    def fetch_timeline(
+        self,
+        ip: str,
+        port: int,
+        resource: Optional[str] = None,
+        start_ms: int = 0,
+        end_ms: Optional[int] = None,
+    ) -> List[dict]:
+        """``GET /api/metric`` — the machine's per-resource per-second
+        timeline rows (obs/timeline.py; dicts with ts/resource/pass/
+        block/success/exception/rt_sum/rt_min/concurrency).  The
+        device-batched successor of ``fetch_metric``'s text lines."""
+        return json.loads(
+            self._get(
+                ip, port, "api/metric",
+                resource=resource, start=start_ms, end=end_ms,
+            )
+        )
+
     def fetch_prometheus(self, ip: str, port: int) -> str:
         """``GET /metrics`` — the machine's obs-registry exposition
         (Prometheus text format); raw text so the dashboard can re-serve

@@ -104,6 +104,38 @@ class MetricFetcher:
                     self._last_fetched_ms[m.key] = end
         return saved
 
+    def fetch_timelines(
+        self,
+        resource: Optional[str] = None,
+        start_ms: int = 0,
+        end_ms: Optional[int] = None,
+        app: Optional[str] = None,
+    ) -> int:
+        """One sweep of every healthy machine's ``GET /api/metric``
+        (obs/timeline.py rows), saved into the repository PER MACHINE —
+        ``repository.query_timeline`` then merges machines on second
+        boundaries with per-machine provenance.  Returns #rows saved;
+        unreachable machines are counted in ``fetch_fail``."""
+        saved = 0
+        apps = [app] if app is not None else self.discovery.apps()
+        for a in apps:
+            for m in self.discovery.machines(a, only_healthy=True):
+                try:
+                    rows = self.api.fetch_timeline(
+                        m.ip, m.port, resource, start_ms, end_ms
+                    )
+                    self.fetch_ok += 1
+                    _C_FETCH_OK.inc()
+                    _G_LAST_SUCCESS.set(wall_ms_now())
+                except OSError:
+                    self.fetch_fail += 1
+                    _C_FETCH_ERR.inc()
+                    continue
+                if rows:
+                    self.repository.save_timeline(a, m.key, rows)
+                    saved += len(rows)
+        return saved
+
     def scrape_prometheus(self, app: Optional[str] = None) -> Dict[str, str]:
         """One sweep of every healthy machine's ``GET /metrics`` — the
         obs-plane exposition (tick-stage histograms, pipeline occupancy,

@@ -23,6 +23,9 @@ class InMemoryMetricsRepository:
         self._data: Dict[str, Dict[str, Dict[int, MetricNode]]] = defaultdict(
             lambda: defaultdict(dict)
         )
+        # app -> machine -> resource -> {second_ts -> timeline row dict}
+        # (the /api/metric channel, kept per machine — see save_timeline)
+        self._timelines: Dict[str, Dict[str, Dict[str, Dict[int, dict]]]] = {}
         self._lock = threading.Lock()
 
     def save_all(self, app: str, nodes: List[MetricNode]) -> None:
@@ -72,3 +75,45 @@ class InMemoryMetricsRepository:
         for per_res in per_app.values():
             for t in [t for t in per_res if t < cutoff]:
                 del per_res[t]
+
+    # -- per-machine timelines (obs/timeline.py rows) ------------------------
+
+    def save_timeline(self, app: str, machine: str, rows: List[dict]) -> None:
+        """Store fetched ``/api/metric`` rows keyed (app, machine,
+        resource, second) — machines stay separate so queries can merge
+        with per-machine provenance (or inspect one machine)."""
+        if not rows:
+            return
+        with self._lock:
+            per_m = self._timelines.setdefault(app, {}).setdefault(machine, {})
+            newest = 0
+            for r in rows:
+                per_m.setdefault(r["resource"], {})[int(r["ts"])] = dict(r)
+                newest = max(newest, int(r["ts"]))
+            cutoff = newest - self.retention_ms
+            for per_res in per_m.values():
+                for t in [t for t in per_res if t < cutoff]:
+                    del per_res[t]
+
+    def query_timeline(
+        self, app: str, resource: str, start_ms: int, end_ms: int
+    ) -> List[dict]:
+        """Fleet view of one resource's timeline: machines aligned on
+        second boundaries and summed (obs.fleet.merge_timelines — each
+        merged row's ``sources`` maps machine → pass+block volume)."""
+        from sentinel_tpu.obs.fleet import merge_timelines
+
+        with self._lock:
+            per_source = {
+                machine: [
+                    dict(row)
+                    for t, row in sorted(per_m.get(resource, {}).items())
+                    if start_ms <= t <= end_ms
+                ]
+                for machine, per_m in self._timelines.get(app, {}).items()
+            }
+        return merge_timelines(per_source)
+
+    def timeline_machines(self, app: str) -> List[str]:
+        with self._lock:
+            return sorted(self._timelines.get(app, {}))

@@ -261,6 +261,16 @@ def _print_postmortem(path: str, out=None) -> None:
         print(f"  {(t_ns - t_ref) / 1e6:>12.3f}ms  {kind:<5} {text}", file=out)
     provs = b.get("providers") or {}
     for name, section in sorted(provs.items()):
+        if name == "timeline" and isinstance(section, dict) and "rows" in section:
+            # the last ~30 s of per-resource per-second rows as a table —
+            # what each hot resource was doing going into the incident
+            print(
+                f"provider [timeline] (last {section.get('window_s', '?')}s, "
+                f"{len(section.get('resources', []))} resources):",
+                file=out,
+            )
+            _print_timeline_rows(section["rows"], out)
+            continue
         print(f"provider [{name}]: {json.dumps(section, sort_keys=True)}", file=out)
     metrics = b.get("metrics") or {}
     hot = {
@@ -293,6 +303,37 @@ def _print_postmortem(path: str, out=None) -> None:
                 f"trace_id={e['trace_id']}",
                 file=out,
             )
+
+
+def _print_timeline_rows(rows: List[dict], out=None) -> None:
+    """Per-second timeline rows (obs/timeline.py dicts) as one table —
+    shared by ``--timeline`` and the post-mortem's provider section."""
+    out = out or sys.stdout
+    if not rows:
+        print("  (no timeline rows)", file=out)
+        return
+    w = max(len(str(r.get("resource", ""))) for r in rows) + 2
+    print(
+        f"  {'second'.ljust(15)}{'resource'.ljust(w)}{'pass':>8}{'block':>8}"
+        f"{'succ':>6}{'exc':>6}{'avgRt':>8}{'minRt':>8}{'conc':>6}  sources",
+        file=out,
+    )
+    for r in rows:
+        succ = float(r.get("success", 0))
+        avg = float(r.get("rt_sum", 0.0)) / succ if succ else 0.0
+        src = r.get("sources")
+        src_s = (
+            " ".join(f"{k}={v:g}" for k, v in sorted(src.items())) if src else ""
+        )
+        print(
+            f"  {str(r.get('ts', 0)).ljust(15)}"
+            f"{str(r.get('resource', '')).ljust(w)}"
+            f"{r.get('pass', 0):>8g}{r.get('block', 0):>8g}"
+            f"{r.get('success', 0):>6g}{r.get('exception', 0):>6g}"
+            f"{avg:>8.2f}{r.get('rt_min', 0.0):>8.2f}"
+            f"{r.get('concurrency', 0):>6g}  {src_s}",
+            file=out,
+        )
 
 
 def _print_summary(spans: List[dict], out=None) -> None:
@@ -364,7 +405,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         "(targets: host:port or URL; none => SENTINEL_FLEET_TARGETS + "
         "registered targets + this process's registry)",
     )
+    ap.add_argument(
+        "--timeline",
+        nargs="*",
+        metavar="TARGET",
+        help="fetch + merge fleet /api/metric per-second timelines "
+        "(targets as for --fleet; none => SENTINEL_FLEET_TARGETS + "
+        "registered targets + this process's live recorders); filter "
+        "with --resource / --start / --end",
+    )
+    ap.add_argument("--resource", help="--timeline: restrict to one resource")
+    ap.add_argument(
+        "--start", type=int, default=0, help="--timeline: range start (wall ms)"
+    )
+    ap.add_argument(
+        "--end", type=int, default=2**62, help="--timeline: range end (wall ms)"
+    )
     args = ap.parse_args(argv)
+
+    if args.timeline is not None:
+        from sentinel_tpu.obs.fleet import fleet_timeline
+
+        rows = fleet_timeline(
+            resource=args.resource,
+            start_ms=args.start,
+            end_ms=args.end,
+            targets=args.timeline or None,
+        )
+        if args.as_json or args.out:
+            text = json.dumps(rows, indent=2)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(text)
+                print(f"wrote {args.out} ({len(rows)} rows)")
+            else:
+                print(text)
+        else:
+            _print_timeline_rows(rows)
+        return 0
 
     if args.fleet is not None:
         from sentinel_tpu.obs.fleet import fleet_exposition

@@ -250,6 +250,110 @@ def render_exposition(merged: Merged) -> str:
     return "\n".join(lines) + "\n" if lines else ""
 
 
+# -- per-resource timeline merge (obs/timeline.py rows) ----------------------
+
+#: timeline row keys that SUM across sources (counts + RT total)
+_TL_SUM_KEYS = ("pass", "block", "success", "exception", "rt_sum", "concurrency")
+
+
+def merge_timelines(per_source: Dict[str, List[dict]]) -> List[dict]:
+    """Fold per-source ``/api/metric`` rows into ONE fleet timeline.
+
+    Sources (shards / machines) are aligned on second boundaries and
+    summed per (resource, second): counts, rt_sum and concurrency add,
+    ``rt_min`` takes the smallest nonzero minimum (0 = that source saw no
+    completions).  Every merged row keeps per-source provenance:
+    ``row["sources"]`` maps source name → that source's pass+block volume
+    for the second, so a fleet spike attributes to the shard that served
+    it."""
+    merged: Dict[tuple, dict] = {}
+    for source, rows in sorted(per_source.items()):
+        for r in rows:
+            key = (int(r.get("ts", 0)), str(r.get("resource", "")))
+            vol = float(r.get("pass", 0)) + float(r.get("block", 0))
+            cur = merged.get(key)
+            if cur is None:
+                cur = merged[key] = {
+                    "ts": key[0],
+                    "resource": key[1],
+                    **{k: r.get(k, 0) for k in _TL_SUM_KEYS},
+                    "rt_min": r.get("rt_min", 0.0),
+                    "sources": {},
+                }
+            else:
+                for k in _TL_SUM_KEYS:
+                    cur[k] += r.get(k, 0)
+                a, b = cur["rt_min"], r.get("rt_min", 0.0)
+                cur["rt_min"] = min(a or b, b or a)
+            cur["sources"][source] = round(
+                cur["sources"].get(source, 0.0) + vol, 3
+            )
+    return [merged[k] for k in sorted(merged)]
+
+
+def _timeline_url(target: str, resource, start_ms: int, end_ms: int) -> str:
+    base = target if target.startswith(("http://", "https://")) else f"http://{target}"
+    base = base.rstrip("/")
+    if base.endswith("/metrics"):
+        base = base[: -len("/metrics")]
+    qs = f"start={start_ms}&end={end_ms}"
+    if resource:
+        import urllib.parse as _up
+
+        qs += f"&resource={_up.quote(str(resource), safe='')}"
+    return f"{base}/api/metric?{qs}"
+
+
+def fleet_timeline(
+    resource: Optional[str] = None,
+    start_ms: int = 0,
+    end_ms: int = 2**62,
+    targets: Optional[List[str]] = None,
+    fetch: Optional[Callable[[str], str]] = None,
+    include_local: bool = True,
+) -> List[dict]:
+    """One merged per-resource timeline for the whole fleet: every live
+    local recorder (``obs.timeline.live_recorders``) plus each target's
+    ``GET /api/metric``.  Scrape failures degrade to a counted gap
+    (source absent from provenance), like ``fleet_exposition``."""
+    import json as _json
+
+    per_source: Dict[str, List[dict]] = {}
+    if include_local:
+        from sentinel_tpu.obs.timeline import live_recorders
+
+        for i, rec in enumerate(live_recorders()):
+            rows = rec.find(resource, start_ms, end_ms)
+            if rows:
+                # recorders may share an app name (one process, several
+                # clients): suffix collisions so no source's rows are
+                # silently replaced instead of merged
+                name = base = f"local/{rec.name or i}"
+                n = 1
+                while name in per_source:
+                    n += 1
+                    name = f"{base}#{n}"
+                per_source[name] = [r.to_dict() for r in rows]
+    local_keys = list(per_source)
+    for t in targets if targets is not None else fleet_targets():
+        url = _timeline_url(t, resource, start_ms, end_ms)
+        try:
+            raw = (fetch or _http_fetch)(url)
+            rows = _json.loads(raw)
+        except Exception:  # stlint: disable=fail-open — a dead member leaves a counted gap in the fleet timeline, never an error page
+            continue
+        if isinstance(rows, list) and rows:
+            # self-scrape dedupe (the fleet_exposition scrape-id analog —
+            # timeline rows carry no process identity, so compare the
+            # rows themselves): a target whose row list is identical to a
+            # LOCAL source's is this process listed as its own member and
+            # must not double-count.  Target-vs-target is never deduped.
+            if any(rows == per_source[k] for k in local_keys):
+                continue
+            per_source[t] = rows
+    return merge_timelines(per_source)
+
+
 # -- fleet targets -----------------------------------------------------------
 
 _TARGETS: List[str] = []

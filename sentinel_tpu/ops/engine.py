@@ -186,6 +186,11 @@ class TickOutput(NamedTuple):
     # and read back alongside the verdicts — see _device_stats.  None
     # when telemetry is off (the traced program is then unchanged).
     stats: object = None
+    # per-resource timeline matrix (cfg.timeline_k): float32
+    # [K, TL_COLS] — the top-K resource rows by windowed pass+block with
+    # their current second-window bucket's cumulative stats — see
+    # _device_res_stats.  None when telemetry or timeline_k is off.
+    res_stats: object = None
 
 
 # -- device-resident telemetry (TickOutput.stats) ---------------------------
@@ -289,6 +294,83 @@ def _device_stats(
     return jnp.stack(
         [jnp.asarray(v, jnp.float32).reshape(()) for v in vals]
     )
+
+
+# -- per-resource timeline rows (TickOutput.res_stats) ----------------------
+#
+# The reference's third observability channel is the per-second,
+# per-resource metric log (MetricWriter/MetricSearcher).  Re-deriving it
+# host-side would mean re-scanning up to max_resources rows every second;
+# instead the tick emits a compact [K, TL_COLS] matrix of the top-K
+# hottest resource rows — the FPGA-sketch flow-stat shape (arXiv
+# 2504.16896): selection by windowed pass+block over the O(1)
+# sliding-window sums already on device (arXiv 1604.02450), stats read
+# from the CURRENT window bucket.  Bucket reads are CUMULATIVE, so the
+# host's write-behind fold (obs/timeline.py) keeps the LAST read per
+# (row, bucket) and lands exact per-second records once the engine clock
+# leaves the second — robust to ticks that skip a bucket, lossy only for
+# resources that fall out of the top K mid-bucket.
+
+TL_RID = 0  # resource row id (registry maps it back to the name)
+TL_PASS = 1  # current-bucket cumulative counts (token-weighted)
+TL_BLOCK = 2
+TL_SUCCESS = 3
+TL_EXCEPTION = 4
+TL_RT_SUM = 5  # current-bucket RT sum (ms)
+TL_RT_MIN = 6  # current-bucket RT min (W.RT_MIN_INIT = none)
+TL_CONC = 7  # live concurrency (gauge, not bucketed)
+TL_COLS = 8
+
+
+def timeline_k(cfg: EngineConfig) -> int:
+    """Effective top-K row count (0 = res_stats emission off).  Clamped
+    to the resource-row space [1, max_resources) — small test configs
+    simply emit every resource row."""
+    if not cfg.device_telemetry or cfg.timeline_k <= 0:
+        return 0
+    return min(int(cfg.timeline_k), cfg.max_resources - 1)
+
+
+def _device_res_stats(cfg: EngineConfig, state: EngineState, now_ms):
+    """Build the TickOutput.res_stats matrix (see the TL_* index block).
+
+    Runs AFTER the effects landed, so the current bucket's cumulative
+    counts include this tick.  Stale buckets (no write since the window
+    wrapped) read as zero — the epoch check below is the batched form of
+    LeapArray's isWindowDeprecated."""
+    K = timeline_k(cfg)
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    win = state.win_sec
+    wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+    bidx = wid % cfg.second_sample_count
+    # rank resource rows [1, max_resources) by windowed pass+block; row 0
+    # is the global ENTRY node (already covered by the scalar stats row)
+    mask = W.valid_mask(win, now_ms, sec_cfg)  # [nb]
+    counts = win.counts[1 : cfg.max_resources]
+    score = jnp.sum(
+        (counts[:, :, W.EV_PASS] + counts[:, :, W.EV_BLOCK]) * mask[None, :],
+        axis=1,
+    )
+    _, idx = jax.lax.top_k(score, K)
+    rows = idx.astype(jnp.int32) + 1
+    fresh = win.epochs[bidx] == wid
+    c = jnp.where(fresh, win.counts[rows, bidx, :], 0)  # [K, NE]
+    rt_sum = jnp.where(fresh, win.rt_sum[rows, bidx], 0.0)
+    rt_min = jnp.where(
+        fresh, win.rt_min[rows, bidx], jnp.float32(W.RT_MIN_INIT)
+    )
+    cols = [
+        rows,
+        c[:, W.EV_PASS],
+        c[:, W.EV_BLOCK],
+        c[:, W.EV_SUCCESS],
+        c[:, W.EV_EXCEPTION],
+        rt_sum,
+        rt_min,
+        state.concurrency[rows],
+    ]
+    assert len(cols) == TL_COLS
+    return jnp.stack([jnp.asarray(x, jnp.float32) for x in cols], axis=1)
 
 
 # ---------------------------------------------------------------------------
@@ -2356,14 +2438,17 @@ def tick(
                 param_ctx,
             )
         stats = None
+        res_stats = None
         if cfg.device_telemetry:
             stats = _device_stats(
                 cfg, state, rules, acq, verdict, valid, now_ms,
                 seg_dropped, ctx_a.n_seg if use_seg else 0,
             )
+            if timeline_k(cfg) > 0:
+                res_stats = _device_res_stats(cfg, state, now_ms)
         return state, TickOutput(
             verdict=verdict, wait_ms=wait_ms, seg_dropped=seg_dropped,
-            stats=stats,
+            stats=stats, res_stats=res_stats,
         )
 
     with_nodes = "nodes" in features
@@ -2476,11 +2561,16 @@ def tick(
         state = state._replace(pcms=pcms, pcms_epochs=pcms_epochs, pconc=pconc)
 
     stats = None
+    res_stats = None
     if cfg.device_telemetry:
         stats = _device_stats(
             cfg, state, rules, acq, verdict, valid, now_ms, 0, 0
         )
-    return state, TickOutput(verdict=verdict, wait_ms=wait_ms, stats=stats)
+        if timeline_k(cfg) > 0:
+            res_stats = _device_res_stats(cfg, state, now_ms)
+    return state, TickOutput(
+        verdict=verdict, wait_ms=wait_ms, stats=stats, res_stats=res_stats
+    )
 
 
 def replace_system_columns(ruleset: RuleSet, system: RT.SystemTensors) -> RuleSet:

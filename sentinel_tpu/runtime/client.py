@@ -57,6 +57,7 @@ from sentinel_tpu.core.rule_tensors import compile_system_rules, hash_param
 from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.obs import flight as FL
+from sentinel_tpu.obs import timeline as TLM
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as OBS
 from sentinel_tpu.runtime import context as CTX
@@ -326,6 +327,7 @@ class _PendingTick:
     n_blk: int  # block item count (fronts start at n_obj + n_blk)
     tick_id: int = 0  # obs trace correlation id (0 = tracing disabled)
     dispatched_ns: int = 0  # obs: dispatch-complete stamp for the device span
+    now_ms: int = 0  # engine timestamp the tick ran at (timeline fold key)
     # fan-out progress (count of blocks/fronts fully resolved): a failed
     # resolve must fail CLOSED only the consumers the normal path hadn't
     # reached — no double-decrement, no double-respond (_fail_tick)
@@ -488,6 +490,8 @@ class SentinelClient:
         entry_timeout_s: float = 5.0,
         metric_log: bool = False,
         metric_log_dir: Optional[str] = None,
+        timeline_log: Any = False,  # bool | obs.timeline.MetricLog
+        timeline_dir: Optional[str] = None,
         block_log: bool = False,
         pipeline_depth: int = 0,
         watchdog_timeout_s: float = 0.0,
@@ -674,6 +678,14 @@ class SentinelClient:
         # observability plane (MetricTimerListener / EagleEye block log)
         self._metric_log_enabled = metric_log
         self._metric_log_dir = metric_log_dir
+        # per-resource timeline (obs/timeline.py): created in start() when
+        # the engine emits res_stats; an on-disk MetricLog is attached
+        # only when asked for (timeline_log=True / a prebuilt MetricLog /
+        # timeline_dir) — the in-memory ring serves /api/metric regardless
+        self._timeline_log_opt = timeline_log
+        self._timeline_dir = timeline_dir
+        self.timeline = None
+        self._timeline_provider = None
         self.metric_timer = None
         self.block_log = None
         if block_log:
@@ -688,6 +700,35 @@ class SentinelClient:
             return
         self._started = True
         self._stop_evt = threading.Event()  # allow stop() → start() restart
+        if self.timeline is None and E.timeline_k(self.cfg) > 0:
+            log = None
+            if isinstance(self._timeline_log_opt, TLM.MetricLog):
+                log = self._timeline_log_opt
+            elif self._timeline_log_opt or self._timeline_dir:
+                import os as _os
+
+                from sentinel_tpu.utils.record_log import log_dir
+
+                # pid-suffixed like the text MetricWriter's file names: two
+                # same-app processes sharing a log dir must never append to
+                # (or "recover" = truncate) each other's live segments
+                log = TLM.MetricLog(
+                    _os.path.join(
+                        self._timeline_dir or log_dir(),
+                        f"{self.app_name}-timeline.pid{_os.getpid()}",
+                    )
+                )
+            self.timeline = TLM.TimelineRecorder(
+                self.registry.resource_name,
+                self.cfg.second_window_ms,
+                self.cfg.second_sample_count,
+                log=log,
+                name=self.app_name,
+            )
+            # flight bundles carry the last ~30 s of top-K rows — the
+            # post-mortem's "what was each hot resource doing" table
+            self._timeline_provider = self.timeline.flight_section
+            FL.FLIGHT.register_provider("timeline", self._timeline_provider)
         if self.mode == "threaded":
             # Warm the compile cache before serving: the first jitted tick
             # can take tens of seconds; without this, early entry() futures
@@ -796,6 +837,16 @@ class SentinelClient:
         if self.metric_timer is not None:
             self.metric_timer.stop()
             self.metric_timer = None
+        if self.timeline is not None:
+            if self._timeline_provider is not None:
+                FL.FLIGHT.unregister_provider(
+                    "timeline", self._timeline_provider
+                )
+                self._timeline_provider = None
+            # flush the still-open second so shutdown loses no rows, then
+            # release the log handles (start() rebuilds the recorder)
+            self.timeline.close()
+            self.timeline = None
         if self.block_log is not None:
             self.block_log.flush()
         self._started = False
@@ -2892,6 +2943,7 @@ class SentinelClient:
             n_blk=n_blk,
             tick_id=tick_id,
             dispatched_ns=_disp_done,
+            now_ms=int(t),
         )
         self._track_tick(p)  # watchdog coverage (no-op while disarmed)
         if self._pipeline_depth:
@@ -3049,6 +3101,16 @@ class SentinelClient:
             stats = np.asarray(out.stats)  # stlint: disable=host-sync — readback point
             _C_WIRE["rx"].inc(stats.nbytes)
             self._fold_device_stats(stats)
+        # per-resource timeline matrix (ops/engine.TL_*): K rows in the
+        # same readback phase, folded write-behind into per-second records
+        # (obs/timeline.py) — its wire cost is accounted under
+        # path="timeline" so the transport work sees it separately
+        if out.res_stats is not None and self.timeline is not None:
+            rs = np.asarray(out.res_stats)  # stlint: disable=host-sync — readback point
+            TLM._C_WIRE["rx"].inc(rs.nbytes)
+            self.timeline.note_tick(
+                rs, p.now_ms, self.time.wall_ms(p.now_ms) - p.now_ms
+            )
         if p.check_dropped:
             # fail-closed capacity overflow must be LOUD (an engine
             # rejecting traffic because seg_u is undersized is an incident,

@@ -138,6 +138,33 @@ class DefaultHandlerGroup:
             nodes = self.metric_searcher.find(start, max_lines)
         return CommandResponse.of_success("\n".join(n.to_line() for n in nodes))
 
+    @command_mapping("api/metric", "per-resource per-second timeline rows")
+    def api_metric(self, req: CommandRequest) -> CommandResponse:
+        """``GET /api/metric?resource=&start=&end=`` — the device-driven
+        per-second metric timeline (obs/timeline.py): one JSON row per
+        (second, resource) with pass/block/success/exception counts,
+        rt_sum/rt_min and concurrency, served read-through from the
+        indexed on-disk MetricLog + the recorder's open buckets.  The
+        reference's ``/metric?startTime&endTime`` channel, binary-backed
+        and top-K device-batched; ``obs.fleet.merge_timelines`` aligns
+        and sums these rows across a fleet."""
+        tl = getattr(self.client, "timeline", None)
+        if tl is None:
+            return CommandResponse.of_success([])
+        resource = req.param("resource") or None
+        start = int(req.param("start", "0"))
+        end_raw = req.param("end")
+        end = int(end_raw) if end_raw else 2**62
+        # bounded like the sibling `metric` handler's maxLines: an
+        # unbounded default range over a full 8x8MiB log would decode and
+        # serialize tens of MB per dashboard poll.  Newest rows win — the
+        # catch-up pull wants the recent edge, not the pruned past.
+        max_rows = int(req.param("maxRows", "6000"))
+        rows = tl.find(resource, start, end)
+        if max_rows > 0:
+            rows = rows[-max_rows:]
+        return CommandResponse.of_success([r.to_dict() for r in rows])
+
     @command_mapping("clusterNode", "per-resource statistics snapshot")
     def cluster_node(self, req: CommandRequest) -> CommandResponse:
         snap = self.client.stats.snapshot()

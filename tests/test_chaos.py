@@ -43,6 +43,7 @@ def _import_instrumented_modules():
     import sentinel_tpu.cluster.server  # noqa: F401
     import sentinel_tpu.cluster.shard  # noqa: F401
     import sentinel_tpu.datasource.stores  # noqa: F401
+    import sentinel_tpu.obs.timeline  # noqa: F401
     import sentinel_tpu.parallel.remote_shard  # noqa: F401
     import sentinel_tpu.runtime.client  # noqa: F401
     import sentinel_tpu.transport.heartbeat  # noqa: F401

@@ -1,0 +1,499 @@
+"""Per-resource metric timelines (PR 9): device top-K stat rows
+(ops/engine._device_res_stats), the indexed binary MetricLog with
+rotation/retention/crash recovery, the write-behind TimelineRecorder's
+exact per-second fold, the GET /api/metric query surface, fleet merge
+with per-shard provenance, and the fail-OPEN disk-write contract."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.core.rules import FlowRule
+from sentinel_tpu.obs import REGISTRY
+from sentinel_tpu.obs import timeline as TL
+from sentinel_tpu.obs.fleet import merge_timelines
+from sentinel_tpu.ops import engine as E
+from sentinel_tpu.ops import window as W
+
+BIG = 1 << 62
+
+
+class _Reg:
+    def resource_id(self, n):
+        return 1
+
+
+def _tick(cfg, res, rules=None, t=1000, state=None):
+    rules = rules if rules is not None else E._compile_ruleset(
+        cfg, _Reg(), [], [], [], [], [], None
+    )
+    st = state if state is not None else E.init_state(cfg)
+    tick = E.make_tick(cfg, donate=False)
+    b = len(res)
+    acq = E.empty_acquire(cfg, b=b)._replace(
+        res=jnp.asarray(res, jnp.int32),
+        count=jnp.ones(b, jnp.int32),
+        inbound=jnp.ones(b, jnp.int32),
+    )
+    comp = E.empty_complete(cfg, b=b)
+    z = jnp.float32(0.0)
+    return tick(st, rules, acq, comp, jnp.int32(t), z, z)
+
+
+# ---------------------------------------------------------------------------
+# engine emission
+# ---------------------------------------------------------------------------
+
+
+def test_res_stats_matches_host_window_read():
+    """The device matrix's rows must equal a host read of the current
+    window bucket for the top-K rows by windowed pass+block."""
+    cfg = small_engine_config()
+    rules = E._compile_ruleset(
+        cfg, _Reg(), [FlowRule(resource="r", count=2.0)], [], [], [], [], None
+    )
+    st, out = _tick(cfg, [1, 1, 1, 2, 2, 3], rules=rules, t=1000)
+    rs = np.asarray(out.res_stats)
+    assert rs.shape == (E.timeline_k(cfg), E.TL_COLS)
+    # host recompute: windowed pass+block per resource row, current bucket
+    sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
+    counts = np.asarray(
+        W.window_counts(st.win_sec, jnp.int32(1000), sec_cfg)
+    )
+    by_rid = {int(r[E.TL_RID]): r for r in rs}
+    # resource 1: rule count=2 -> 2 pass / 1 block; resources 2,3 pass
+    for rid, want_pass, want_block in ((1, 2, 1), (2, 2, 0), (3, 1, 0)):
+        row = by_rid[rid]
+        assert row[E.TL_PASS] == want_pass
+        assert row[E.TL_BLOCK] == want_block
+        assert counts[rid, W.EV_PASS] == want_pass
+    # top-K ordering: the busiest row (3 events) ranks first
+    assert int(rs[0, E.TL_RID]) == 1
+    # the matrix's byte cost is the documented K * TL_COLS * 4
+    assert rs.nbytes == E.timeline_k(cfg) * E.TL_COLS * 4
+
+
+def test_res_stats_off_mode_and_clamp():
+    cfg_off = small_engine_config(timeline_k=0)
+    _st, out = _tick(cfg_off, [1, 2])
+    assert out.res_stats is None and out.stats is not None
+    # telemetry off kills the matrix too
+    assert E.timeline_k(small_engine_config(device_telemetry=False)) == 0
+    # K clamps to the resource-row space
+    assert E.timeline_k(small_engine_config()) == 63
+    assert E.timeline_k(small_engine_config(timeline_k=7)) == 7
+
+
+def test_res_stats_stale_bucket_reads_zero():
+    """A row whose current bucket was never written this window must
+    read zero, not a dead epoch's left-over counts."""
+    cfg = small_engine_config()
+    st, _out = _tick(cfg, [1, 1], t=1000)
+    # much later tick, empty batch: row 1's old bucket is deprecated
+    st2, out2 = _tick(
+        cfg, [cfg.trash_row], t=100_000, state=st
+    )
+    rs = np.asarray(out2.res_stats)
+    by_rid = {int(r[E.TL_RID]): r for r in rs}
+    assert by_rid[1][E.TL_PASS] == 0
+    assert by_rid[1][E.TL_BLOCK] == 0
+
+
+# ---------------------------------------------------------------------------
+# binary codec + log lifecycle
+# ---------------------------------------------------------------------------
+
+#: pinned golden: the on-disk record layout is a compatibility contract —
+#: if this fails, the codec changed and RECORD_MAGIC must be bumped
+_GOLDEN_ROW = TL.MetricRow(1700000000000, "res/a", 3, 2, 1, 0, 12.5, 1.25, 4)
+_GOLDEN_HEX = (
+    "4c5433000068e5cf8b0100000300000002000000010000000000000000004841"
+    "0000a03f0400000005007265732f616dfde5a3"
+)
+
+
+def test_codec_golden_roundtrip():
+    buf = TL.pack_record(_GOLDEN_ROW)
+    assert buf.hex() == _GOLDEN_HEX
+    row, nxt = TL.unpack_record(buf)
+    assert nxt == len(buf)
+    assert row == _GOLDEN_ROW
+    # corruption anywhere inside the record is rejected by the CRC
+    bad = bytearray(buf)
+    bad[20] ^= 0xFF
+    assert TL.unpack_record(bytes(bad)) is None
+    # truncation (torn tail) is rejected, not misread
+    assert TL.unpack_record(buf[:-3]) is None
+
+
+def test_log_rotation_retention_and_cross_segment_query(tmp_path):
+    log = TL.MetricLog(str(tmp_path), max_segment_bytes=120, max_segments=3)
+    for sec in range(10):
+        log.append([TL.MetricRow(1000 * (sec + 1), "r", sec + 1, 0, 0, 0)])
+    segs = log.segments()
+    assert len(segs) == 3  # rotated at the size cap, pruned to retention
+    rows = log.find("r", 0, BIG)
+    assert len(rows) >= 2  # retention pruned the oldest seconds...
+    assert [r.pass_count for r in rows] == [
+        r.sec_ms // 1000 for r in rows
+    ]  # ...but surviving rows span segments and stay exact
+    assert rows[-1].sec_ms == 10_000
+    # range queries seek: an end before the newest segment excludes it
+    assert all(r.sec_ms <= 9000 for r in log.find("r", 0, 9000))
+    log.close()
+
+
+def test_torn_tail_truncated_on_reopen(tmp_path):
+    log = TL.MetricLog(str(tmp_path))
+    log.append([TL.MetricRow(1000, "a", 1, 0, 0, 0)])
+    log.append([TL.MetricRow(2000, "a", 2, 0, 0, 0)])
+    log.close()
+    seg = TL.MetricLog(str(tmp_path)).segments()[-1]
+    with open(seg, "ab") as f:  # a crash mid-append leaves half a record
+        f.write(TL.pack_record(TL.MetricRow(3000, "a", 3, 0, 0, 0))[:20])
+    log2 = TL.MetricLog(str(tmp_path))
+    rows = log2.find("a", 0, BIG)
+    assert [r.pass_count for r in rows] == [1, 2]  # torn record gone
+    # and the truncated segment accepts clean appends again
+    log2.append([TL.MetricRow(3000, "a", 30, 0, 0, 0)])
+    assert [r.pass_count for r in log2.find("a", 0, BIG)] == [1, 2, 30]
+    log2.close()
+
+
+def test_index_disagreement_rebuilt_on_reopen(tmp_path):
+    log = TL.MetricLog(str(tmp_path))
+    for sec in (1000, 2000, 3000):
+        log.append([TL.MetricRow(sec, "a", sec // 1000, 0, 0, 0)])
+    log.close()
+    idx_path = log.segments()[-1].replace(".mlog", ".idx")
+    with open(idx_path, "wb") as f:  # lie: offsets point mid-record
+        f.write(TL._IDX.pack(2000, 7))
+    log2 = TL.MetricLog(str(tmp_path))
+    assert TL._read_idx(idx_path) != [(2000, 7)]  # rebuilt from records
+    assert [r.pass_count for r in log2.find("a", 2000, 3000)] == [2, 3]
+    log2.close()
+
+
+def test_recorder_write_failure_fails_open(tmp_path):
+    """An injected disk-write failure drops rows from DISK only: the
+    failure is counted, and the memory ring still answers queries."""
+    from sentinel_tpu.chaos import failpoints as FP
+    from sentinel_tpu.chaos.plans import FaultPlan, FaultSpec
+
+    fail = REGISTRY.counter("sentinel_timeline_write_failures_total", "")
+    f0 = fail.value
+    log = TL.MetricLog(str(tmp_path))
+    rec = TL.TimelineRecorder(lambda rid: f"res-{rid}", 500, 2, log=log)
+    mat = np.zeros((1, E.TL_COLS), np.float32)
+    mat[0] = [1, 4, 1, 0, 0, 0, 5000.0, 0]
+    plan = FaultPlan(
+        name="t", seed=1,
+        faults=[FaultSpec("datasource.metriclog.write", "raise", max_fires=1)],
+    )
+    FP.arm(plan)
+    try:
+        rec.note_tick(mat, 1100, 0)
+        rec.note_tick(mat, 2100, 0)  # flushes sec 1000 -> injected failure
+    finally:
+        FP.disarm()
+    assert fail.value - f0 == 1
+    assert log.find("res-1", 0, BIG) == []  # dropped from disk
+    got = rec.find("res-1", 1000, 1000)  # ...but not from the recorder
+    assert len(got) == 1 and got[0].pass_count == 4
+    rec.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: exact per-second rows through the full client + /api/metric
+# ---------------------------------------------------------------------------
+
+
+def _api_metric(client, **params):
+    from sentinel_tpu.transport.command import CommandRequest
+    from sentinel_tpu.transport.handlers import build_default_handlers
+
+    rsp = build_default_handlers(client).handle(
+        "api/metric", CommandRequest(parameters={k: str(v) for k, v in params.items()})
+    )
+    assert rsp.success
+    return rsp.result
+
+
+def test_api_metric_rows_exactly_match_injected_counts(tmp_path, vt, client_factory):
+    """ISSUE 9 acceptance: known per-resource traffic through a
+    SentinelClient; GET /api/metric returns per-second rows whose
+    pass/block/rt sums EXACTLY match the injected counts — including
+    across one log rotation."""
+    log = TL.MetricLog(str(tmp_path), max_segment_bytes=150, max_segments=8)
+    c = client_factory(timeline_log=log)
+    c.flow_rules.load([FlowRule(resource="tl/r", count=3.0)])
+    wall0 = vt.wall_epoch_ms + 1000
+    # second 1: 5 attempts -> 3 pass / 2 block
+    c.check_batch(["tl/r"] * 5, inbound=True)
+    vt.advance(1100)
+    # second 2: 4 attempts -> 3 pass / 1 block, plus completions with RT
+    c.check_batch(["tl/r"] * 4, inbound=True)
+    rid = c.registry.resource_id("tl/r")
+    c.submit_completion_block(
+        np.asarray([rid, rid], np.int32), np.asarray([2.0, 4.0], np.float32)
+    )
+    c.tick_once()
+    vt.advance(1100)
+    # second 3: traffic on another resource ticks the flush forward
+    c.check_batch(["tl/other"] * 2, inbound=True)
+    vt.advance(1100)
+    c.check_batch(["tl/other"], inbound=True)
+
+    rows = _api_metric(c, resource="tl/r", start=wall0, end=wall0 + 1999)
+    assert [(r["ts"] - vt.wall_epoch_ms, r["pass"], r["block"]) for r in rows] == [
+        (1000, 3, 2),
+        (2000, 3, 1),
+    ]
+    sec2 = rows[1]
+    assert sec2["success"] == 2 and sec2["rt_sum"] == pytest.approx(6.0)
+    assert sec2["rt_min"] == pytest.approx(2.0)
+    other = _api_metric(c, resource="tl/other", start=0, end=BIG)
+    assert sum(r["pass"] for r in other) == 3
+    # unfiltered query returns both resources; range filtering holds
+    all_rows = _api_metric(c, start=wall0 + 1000, end=wall0 + 1000)
+    assert {r["resource"] for r in all_rows} == {"tl/r"}
+    c.stop()  # final flush; reopen the log COLD and re-verify across rotation
+    assert len(TL.MetricLog(str(tmp_path)).segments()) > 1, "no rotation happened"
+    cold = TL.MetricLog(str(tmp_path), max_segment_bytes=150)
+    disk = cold.find("tl/r", 0, BIG)
+    assert [(r.pass_count, r.block_count) for r in disk][:2] == [(3, 2), (3, 1)]
+    cold.close()
+
+
+def test_api_metric_max_rows_keeps_newest(vt, client_factory):
+    c = client_factory()
+    c.registry.resource_id("cap/r")
+    for _ in range(4):
+        c.check_batch(["cap/r"], inbound=True)
+        vt.advance(1100)
+    c.check_batch(["cap/r"], inbound=True)
+    rows = _api_metric(c, resource="cap/r", start=0, end=BIG, maxRows=2)
+    assert len(rows) == 2
+    all_rows = _api_metric(c, resource="cap/r", start=0, end=BIG)
+    assert rows == all_rows[-2:]  # the cap keeps the newest edge
+
+
+def test_fleet_timeline_local_collisions_and_self_dedupe(vt, client_factory):
+    """Two same-app recorders both contribute (suffixed, not replaced);
+    a target serving a local recorder's own rows is dropped as a
+    self-scrape duplicate."""
+    from sentinel_tpu.obs.fleet import fleet_timeline
+
+    a = client_factory(app_name="same")
+    b = client_factory(app_name="same")
+    a.flow_rules.load([FlowRule(resource="fl/r", count=100.0)])
+    a.check_batch(["fl/r"] * 3, inbound=True)
+    b.check_batch(["fl/r"] * 2, inbound=True)
+    vt.advance(1100)
+    a.check_batch(["fl/r"], inbound=True)
+    b.check_batch(["fl/r"], inbound=True)
+    import json
+
+    self_rows = json.dumps(
+        [r.to_dict() for r in a.timeline.find("fl/r", 0, BIG)]
+    )
+    merged = fleet_timeline(
+        resource="fl/r", targets=["self:1"], fetch=lambda url: self_rows
+    )
+    by_sec = {m["ts"]: m for m in merged}
+    first = by_sec[vt.wall_epoch_ms + 1000]
+    # both local recorders merged (3 + 2), the self-scrape target dropped
+    assert first["pass"] == 5
+    assert set(first["sources"]) == {"local/same", "local/same#2"}
+
+
+def test_wire_bytes_move_on_timeline_path(client_factory):
+    rx = REGISTRY.get(
+        "sentinel_wire_bytes_total", {"path": "timeline", "direction": "rx"}
+    )
+    rx0 = rx.value
+    c = client_factory()
+    c.registry.resource_id("tlw/r")
+    c.check_batch(["tlw/r"] * 4)
+    assert rx.value >= rx0 + E.timeline_k(c.cfg) * E.TL_COLS * 4
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+# ---------------------------------------------------------------------------
+
+
+def test_merge_timelines_aligns_sums_and_keeps_provenance():
+    a = [
+        {"ts": 1000, "resource": "r", "pass": 3, "block": 1, "success": 2,
+         "exception": 0, "rt_sum": 4.0, "rt_min": 2.0, "concurrency": 1},
+        {"ts": 2000, "resource": "r", "pass": 1, "block": 0, "success": 0,
+         "exception": 0, "rt_sum": 0.0, "rt_min": 0.0, "concurrency": 0},
+    ]
+    b = [
+        {"ts": 1000, "resource": "r", "pass": 2, "block": 2, "success": 1,
+         "exception": 1, "rt_sum": 1.0, "rt_min": 0.5, "concurrency": 3},
+        {"ts": 1000, "resource": "q", "pass": 7, "block": 0, "success": 0,
+         "exception": 0, "rt_sum": 0.0, "rt_min": 0.0, "concurrency": 0},
+    ]
+    merged = merge_timelines({"shard-a": a, "shard-b": b})
+    assert [(m["ts"], m["resource"]) for m in merged] == [
+        (1000, "q"), (1000, "r"), (2000, "r"),
+    ]
+    r1 = merged[1]
+    assert (r1["pass"], r1["block"], r1["success"], r1["exception"]) == (5, 3, 3, 1)
+    assert r1["rt_sum"] == pytest.approx(5.0)
+    assert r1["rt_min"] == pytest.approx(0.5)  # smallest NONZERO min
+    assert r1["concurrency"] == 4
+    assert r1["sources"] == {"shard-a": 4.0, "shard-b": 4.0}
+    # a source with zero completions must not zero the fleet rt_min
+    assert merged[2]["rt_min"] == 0.0
+    assert merged[0]["sources"] == {"shard-b": 7.0}
+
+
+def test_fleet_merged_timeline_over_live_4shard_fleet(vt, client_factory):
+    """ISSUE 9 acceptance: a live 4-shard ShardFleet's per-shard
+    timelines merge into one fleet timeline with per-shard provenance —
+    each cluster flow's rows attribute to exactly its ring owner."""
+    from sentinel_tpu.cluster.shard import ShardFleet
+
+    f = ShardFleet(
+        client_factory,
+        n_shards=4,
+        retry_interval_s=300.0,
+        timeout_ms=5000,
+        reconnect_interval_s=0.0,
+        lease_slack=0.0,  # no standing leases: window counts == requests
+    )
+    fids = (101, 202, 303, 404)
+    try:
+        f.load_flow_rules(
+            "default",
+            [
+                FlowRule(
+                    resource=f"res-{fid}",
+                    count=1000.0,
+                    cluster_mode=True,
+                    cluster_flow_id=fid,
+                    cluster_threshold_type=1,
+                )
+                for fid in fids
+            ],
+        )
+        from sentinel_tpu.cluster import constants as CC
+
+        for fid in fids:  # second 1: 3 requests per flow
+            for _ in range(3):
+                assert f.client.request_token(fid).status == CC.STATUS_OK
+        vt.advance(1100)
+        for fid in fids:  # second 2: 2 requests per flow
+            for _ in range(2):
+                f.client.request_token(fid)
+        vt.advance(1100)
+        for fid in fids:  # second 3: tick each owner past the boundary
+            f.client.request_token(fid)
+
+        per_shard = {
+            name: [r.to_dict() for r in svc.client.timeline.find(None, 0, BIG)]
+            for name, svc in f.services.items()
+        }
+        merged = merge_timelines(per_shard)
+        shard_names = set(f.services)
+        for fid in fids:
+            rows = [m for m in merged if m["resource"] == f"$cluster/flow/{fid}"]
+            assert [r["pass"] for r in rows] == [3, 2, 1]
+            # provenance: every row of one flow names exactly one live
+            # shard — its consistent-hash ring owner
+            owners = {src for r in rows for src in r["sources"]}
+            assert len(owners) == 1 and owners <= shard_names
+            for r in rows:
+                assert sum(r["sources"].values()) == r["pass"] + r["block"]
+    finally:
+        f.stop()
+
+
+def test_repository_and_fetcher_store_timelines_per_machine():
+    from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
+    from sentinel_tpu.dashboard.metric_fetcher import MetricFetcher
+    from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+
+    rowset = {
+        "1.1.1.1:1": [{"ts": 1000, "resource": "r", "pass": 2, "block": 1,
+                       "success": 0, "exception": 0, "rt_sum": 0.0,
+                       "rt_min": 0.0, "concurrency": 0}],
+        "2.2.2.2:1": [{"ts": 1000, "resource": "r", "pass": 5, "block": 0,
+                       "success": 0, "exception": 0, "rt_sum": 0.0,
+                       "rt_min": 0.0, "concurrency": 0}],
+    }
+
+    class _Api:
+        def fetch_timeline(self, ip, port, resource=None, start_ms=0, end_ms=None):
+            if ip == "3.3.3.3":
+                raise OSError("down")
+            return rowset[f"{ip}:{port}"]
+
+    d = AppManagement()
+    for ip in ("1.1.1.1", "2.2.2.2", "3.3.3.3"):
+        d.register(MachineInfo(app="app", ip=ip, port=1))
+    repo = InMemoryMetricsRepository()
+    fetcher = MetricFetcher(d, repo, api=_Api())
+    saved = fetcher.fetch_timelines(resource="r")
+    assert saved == 2 and fetcher.fetch_fail == 1
+    assert repo.timeline_machines("app") == ["1.1.1.1:1", "2.2.2.2:1"]
+    merged = repo.query_timeline("app", "r", 0, BIG)
+    assert len(merged) == 1
+    assert merged[0]["pass"] == 7 and merged[0]["block"] == 1
+    assert merged[0]["sources"] == {"1.1.1.1:1": 3.0, "2.2.2.2:1": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder enrichment
+# ---------------------------------------------------------------------------
+
+
+def test_flight_bundle_timeline_section_and_postmortem_table(
+    tmp_path, vt, client_factory, capsys
+):
+    import json
+
+    from sentinel_tpu.obs.flight import FLIGHT
+    from sentinel_tpu.obs.__main__ import _print_postmortem
+
+    c = client_factory()
+    c.flow_rules.load([FlowRule(resource="fb/r", count=2.0)])
+    c.check_batch(["fb/r"] * 4, inbound=True)
+    vt.advance(1100)
+    c.check_batch(["fb/r"], inbound=True)
+    bundle = FLIGHT.dump_bundle(reason="test")
+    tl = bundle["providers"]["timeline"]
+    assert tl["window_s"] == 30
+    assert "fb/r" in tl["resources"]
+    hot = [r for r in tl["rows"] if r["resource"] == "fb/r"]
+    assert sum(r["pass"] for r in hot) == 3
+    assert sum(r["block"] for r in hot) == 2
+    # --postmortem renders the section as a per-second table
+    path = tmp_path / "bundle.json"
+    path.write_text(json.dumps(bundle))
+    _print_postmortem(str(path))
+    out = capsys.readouterr().out
+    assert "provider [timeline]" in out
+    assert "fb/r" in out and "resource" in out
+
+
+def test_recorder_closes_and_deregisters(client_factory):
+    from sentinel_tpu.obs.timeline import live_recorders
+
+    c = client_factory()
+    c.registry.resource_id("lr/r")
+    rec = c.timeline
+    assert rec in live_recorders()
+    c.stop()
+    assert rec not in live_recorders()
+    assert c.timeline is None
