@@ -43,6 +43,7 @@ is ~100 ms with high variance, so
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -724,6 +725,156 @@ def cluster_sharded_bench(n_requests: int = 2000, workers: int = 8) -> dict:
     return out
 
 
+# -- sketch statistics tier @ 1M ruled resources (--sketch-tier) -------------
+
+
+def sketch_tier_bench(B: int = 2048, n_ticks: int = 12) -> dict:
+    """The BENCH ``sketch_tier`` row: ONE MILLION ruled tail resources
+    enforced by the salsa sketch tier (sentinel_tpu/sketch) on a
+    minute-scale window, reporting decisions/s, persistent HBM bytes vs
+    the exact tier and the seed int32 CMS, and the MEASURED per-resource
+    estimate error against an exact host shadow of the same stream.
+
+    CPU-reproducible (plain path): the tick runs the real tail-rule
+    check (threshold gathers + O(1) running-sum estimates + within-tick
+    rank) and both sketch write sides, with a Zipf stream over the 1 M
+    ruled ids."""
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core import rule_tensors as RT
+    from sentinel_tpu.core.config import EngineConfig
+    from sentinel_tpu.core.errors import BLOCK_FLOW
+    from sentinel_tpu.ops import engine as E
+    from sentinel_tpu.ops import gsketch as GS
+    from sentinel_tpu.ops import window as W
+    from sentinel_tpu.sketch import salsa as SA
+
+    N_TAIL = 1_000_000
+    cfg = EngineConfig(
+        max_resources=16368,
+        max_nodes=16376,
+        batch_size=B,
+        complete_batch_size=B,
+        enable_minute_window=False,  # the sketch carries the minute scale
+        sketch_stats=True,
+        sketch_salsa=True,
+        sketch_depth=2,
+        sketch_width=1 << 16,
+        sketch_capacity=1 << 21,
+        sketch_sample_count=60,
+        sketch_window_ms=1000,
+        hotset_k=64,
+    )
+    scfg = E.sketch_config(cfg)
+
+    class _Reg:
+        def resource_id(self, n):
+            return 1
+
+    ruleset = E._compile_ruleset(cfg, _Reg(), [], [], [], [], [], None)
+    # per-second limit, scaled to the 60 s interval at compile; low
+    # enough that the Zipf head crosses it mid-run — the reported
+    # tail_blocked_sample proves the enforcement path produces verdicts
+    qps_limit = 2.0
+    t0 = time.perf_counter()
+    tail_rules = [(cfg.node_rows + 1 + r, qps_limit) for r in range(N_TAIL)]
+    ruleset = ruleset._replace(
+        tail=jax.device_put(RT.compile_tail_flow_rules(tail_rules, cfg))
+    )
+    compile_rules_s = time.perf_counter() - t0
+
+    features = frozenset({"tail_flow"})
+    tick = E.make_tick(cfg, donate=False, features=features)
+    state = E.init_state(cfg)
+    rng = np.random.default_rng(5)
+    batches = []
+    exact = np.zeros(N_TAIL + 1, np.int64)  # host shadow: exact attempts
+    n_batches = 6
+    for _ in range(n_batches):
+        z = rng.zipf(1.1, size=B).astype(np.int64)
+        k = (z - 1) % N_TAIL + 1
+        batches.append(
+            E.empty_acquire(cfg)._replace(
+                res=jnp.asarray(cfg.node_rows + k, jnp.int32),
+                count=jnp.ones(B, jnp.int32),
+            )
+        )
+    comp = E.empty_complete(cfg)
+    zf = jnp.float32(0.0)
+    for w in range(2):  # compile + warm (outside the shadow accounting)
+        state, out = tick(
+            state, ruleset, batches[w], comp, jnp.int32(w), zf, zf
+        )
+    jax.block_until_ready(out.verdict)
+
+    state = E.init_state(cfg)
+    blocks = 0
+    t0 = time.perf_counter()
+    for t in range(n_ticks):
+        a = batches[t % n_batches]
+        state, out = tick(
+            state, ruleset, a, comp, jnp.int32(1_000 + 37 * t), zf, zf
+        )
+    jax.block_until_ready(out.verdict)
+    wall = time.perf_counter() - t0
+    # shadow the same stream on the host (attempts per ruled id)
+    for t in range(n_ticks):
+        ids = np.asarray(batches[t % n_batches].res) - cfg.node_rows
+        np.add.at(exact, ids, 1)
+    blocks = int(np.asarray(out.verdict == BLOCK_FLOW).sum())
+
+    # measured error: sketch windowed attempts (pass + block estimates)
+    # vs the exact shadow, over the hottest 2k + 2k random touched ids
+    touched = np.flatnonzero(exact)
+    hot = touched[np.argsort(exact[touched])[-2000:]]
+    cold = rng.choice(touched, size=min(2000, len(touched)), replace=False)
+    sample = np.unique(np.concatenate([hot, cold]))
+    est = np.asarray(
+        SA.estimate(
+            state.gs,
+            jnp.int32(1_000 + 37 * n_ticks),
+            jnp.asarray(cfg.node_rows + sample, jnp.int32),
+            scfg,
+        )
+    )
+    attempts_est = est[:, W.EV_PASS] + est[:, W.EV_BLOCK]
+    errs = attempts_est - exact[sample]
+    V = float(exact.sum())
+    eps_bound = math.e / cfg.sketch_width * V
+    exact_tier_bytes = N_TAIL * scfg.sample_count * (W.NUM_EVENTS * 4 + 8)
+    seed_cms_bytes = 4 * scfg.sample_count * scfg.depth * scfg.width * GS.PLANES
+    lv = np.asarray(SA.level_histogram(state.gs, scfg))
+    return {
+        "resources_ruled": N_TAIL,
+        "window": f"{scfg.sample_count}x{scfg.window_ms}ms",
+        "width_x_depth": [cfg.sketch_width, cfg.sketch_depth],
+        "batch": B,
+        "dps": round(n_ticks * B / wall),
+        "tick_ms": round(wall / n_ticks * 1000.0, 3),
+        "tail_rule_compile_s": round(compile_rules_s, 2),
+        "tail_blocked_sample": blocks,
+        "hbm_bytes": {
+            "salsa_tier": SA.hbm_bytes(scfg),
+            "seed_cms_int32": seed_cms_bytes,
+            "exact_tier_equivalent": exact_tier_bytes,
+        },
+        "merged_words": [int(x) for x in lv],
+        "error_vs_exact": {
+            "stream_volume": V,
+            "sampled_resources": int(len(sample)),
+            "underestimates": int((errs < 0).sum()),  # must be 0
+            "mean_abs": round(float(errs.mean()), 3),
+            "max_abs": int(errs.max()),
+            "mean_pct_of_volume": round(float(errs.mean()) / V * 100.0, 5),
+            "max_pct_of_volume": round(float(errs.max()) / V * 100.0, 5),
+            "eps_bound_abs": round(eps_bound, 1),
+            "within_eps_bound_frac": round(float((errs <= eps_bound).mean()), 4),
+        },
+        "platform": jax.devices()[0].platform,
+    }
+
+
 # -- perf-regression sentry (--smoke + PERF_BASELINE.json) -------------------
 #
 # A fast, CPU-reproducible measurement of the serving path's throughput
@@ -755,6 +906,16 @@ DEFAULT_TOLERANCES = {
     # ops/engine._device_res_stats) at K=128 — the PR 9 acceptance bound
     "timeline_overhead_pct": {"max_abs": 5.0},
     "timeline_readback_bytes": {"max_abs": 4096.0},
+    # sketch tier (sentinel_tpu/sketch): full salsa path — CMS writes on
+    # both tick sides, tail-rule threshold reads, and the hot-candidate
+    # top-K — vs the same config with the sketch off.  A loose ceiling:
+    # at smoke scale the extra one-hot contractions are a visible
+    # fraction of a small tick; the ratio guard vs the pinned baseline
+    # is what catches regressions
+    "sketch_overhead_pct": {"max_ratio": 2.0},
+    # mean salsa overestimate as % of stream volume on a seeded Zipf
+    # stream — must stay inside the CMS bound e/width (≈0.27% at 1024)
+    "sketch_estimate_err_pct": {"max_abs": 100.0 * math.e / 1024},
 }
 
 
@@ -787,7 +948,7 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
     from sentinel_tpu.ops import engine as E
     from sentinel_tpu.runtime.client import SentinelClient
 
-    def engine_dps(telemetry: bool, timeline_k: int = 0) -> float:
+    def engine_dps(telemetry: bool, timeline_k: int = 0, sketch: bool = False) -> float:
         cfg = small_engine_config(
             batch_size=B,
             complete_batch_size=B,
@@ -795,6 +956,8 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             timeline_k=timeline_k,
             max_resources=256,
             max_nodes=512,
+            sketch_stats=sketch,
+            sketch_width=1024,
         )
         tick = E.make_tick(cfg, donate=False, features=E.ALL_FEATURES)
 
@@ -805,8 +968,14 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
         rules = E._compile_ruleset(cfg, _Reg(), [], [], [], [], [], None)
         state = E.init_state(cfg)
         rng = np.random.default_rng(0)
+        res = rng.integers(1, 64, B).astype(np.int32)
+        if sketch:
+            # half the traffic rides the sketched tail, so the measured
+            # tick pays the real CMS write + hot-candidate top-K work
+            tail = cfg.node_rows + rng.integers(0, 4096, B)
+            res = np.where(rng.random(B) < 0.5, tail, res).astype(np.int32)
         acq = E.empty_acquire(cfg)._replace(
-            res=jnp.asarray(rng.integers(1, 64, B), jnp.int32),
+            res=jnp.asarray(res),
             count=jnp.ones(B, jnp.int32),
             inbound=jnp.ones(B, jnp.int32),
         )
@@ -834,8 +1003,11 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
     dps_off = engine_dps(False)
     dps_on = engine_dps(True)
     dps_tl = engine_dps(True, timeline_k=128)
+    dps_sk = engine_dps(True, sketch=True)
     overhead_pct = max((dps_off / max(dps_on, 1.0) - 1.0) * 100.0, 0.0)
     tl_overhead_pct = max((dps_on / max(dps_tl, 1.0) - 1.0) * 100.0, 0.0)
+    sk_overhead_pct = max((dps_on / max(dps_sk, 1.0) - 1.0) * 100.0, 0.0)
+    sk_err_pct = _sketch_estimate_err_pct()
 
     # client path: public bulk API on a sync client (one process, CPU)
     c = SentinelClient(cfg=small_engine_config(batch_size=1024), mode="sync")
@@ -873,10 +1045,48 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             "timeline_readback_bytes": 128 * E.TL_COLS * 4,
             "client_path_dps": round(client_dps),
             "host_build_ms": round(host_build_ms, 3),
+            "sketch_overhead_pct": round(sk_overhead_pct, 2),
+            "sketch_estimate_err_pct": sk_err_pct,
         },
         "batch": B,
         "platform": jax.devices()[0].platform,
     }
+
+
+def _sketch_estimate_err_pct(width: int = 1024, volume: int = 4096) -> float:
+    """Mean salsa-tier overestimate on a seeded Zipf stream, as % of the
+    stream volume — the sentry's accuracy guard (must stay inside the
+    CMS bound e/width; see DEFAULT_TOLERANCES)."""
+    import jax.numpy as jnp
+
+    from sentinel_tpu.ops import gsketch as GS
+    from sentinel_tpu.ops import window as W
+    from sentinel_tpu.sketch import salsa as SA
+
+    scfg = GS.SketchConfig(sample_count=2, window_ms=500, depth=2, width=width)
+    s = SA.init_sketch(scfg)
+    rng = np.random.default_rng(7)
+    ids = (rng.zipf(1.2, size=volume).astype(np.int64) - 1) % 50_000 + 1_000_000
+    exact: dict = {}
+    for lo in range(0, len(ids), 512):
+        chunk = ids[lo : lo + 512]
+        s = SA.add(
+            s,
+            jnp.int32(100),
+            jnp.asarray(chunk, jnp.int32),
+            jnp.ones((len(chunk), 1), jnp.int32),
+            (W.EV_PASS,),
+            jnp.ones((len(chunk),), bool),
+            scfg,
+        )
+        for i in chunk:
+            exact[int(i)] = exact.get(int(i), 0) + 1
+    qs = sorted(exact)
+    est = np.asarray(
+        SA.estimate(s, jnp.int32(100), jnp.asarray(qs, jnp.int32), scfg)
+    )[:, W.EV_PASS]
+    errs = np.asarray([e - exact[q] for q, e in zip(qs, est)], np.float64)
+    return round(float(errs.mean()) / volume * 100.0, 4)
 
 
 def compare_to_baseline(measured: dict, baseline: dict) -> list:
@@ -1111,7 +1321,11 @@ if __name__ == "__main__":
         # compared against PERF_BASELINE.json (exit 1 on regression);
         # --update-baseline re-pins after an intentional perf change
         sys.exit(_smoke_main("--update-baseline" in sys.argv))
-    if "--cluster-sharded" in sys.argv:
+    if "--sketch-tier" in sys.argv:
+        # the 1 M-ruled-resource sketch-tier row alone (plain path —
+        # CPU-reproducible; how BENCH_r10 captured it)
+        print(json.dumps({"sketch_tier": sketch_tier_bench()}))
+    elif "--cluster-sharded" in sys.argv:
         # the fleet row alone (host path only — no device build): fast
         # enough to run on CPU, which is how BENCH_r06 captured it
         print(json.dumps({"cluster_sharded": cluster_sharded_bench()}))

@@ -34,6 +34,7 @@ _ENTRY_MODULES = {
     "tick/plain": "sentinel_tpu/ops/engine.py",
     "tick/mxu": "sentinel_tpu/ops/engine.py",
     "tick/fused-seg": "sentinel_tpu/ops/engine.py",
+    "tick/sketch-salsa": "sentinel_tpu/sketch/salsa.py",
     "tick/cluster-token": "sentinel_tpu/cluster/token_service.py",
     "segscan/excl-cumsum": "sentinel_tpu/ops/segscan.py",
     "segscan/incl-min": "sentinel_tpu/ops/segscan.py",
@@ -221,6 +222,12 @@ def _build_entries() -> List[TracedEntry]:
     )
     entries.append(tick_entry("tick/plain", cfg_plain, E.ALL_FEATURES))
     entries.append(tick_entry("tick/mxu", cfg_mxu, E.ALL_FEATURES))
+    # the sketch statistics tier: salsa packed counters + O(1) running
+    # sums + tail-rule enforcement + hot-candidate top-K, all in-trace
+    cfg_sketch = small_engine_config(
+        sketch_stats=True, sketch_width=256, hotset_k=8
+    )
+    entries.append(tick_entry("tick/sketch-salsa", cfg_sketch, E.ALL_FEATURES))
     entries.append(
         tick_entry("tick/fused-seg", cfg_seg, E.ALL_FEATURES, cost=False)
     )

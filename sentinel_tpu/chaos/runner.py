@@ -38,6 +38,10 @@ Scenarios (the acceptance set):
                       ladder climbs and sheds (p99 bounded, goodput
                       held) then recovers to NORMAL; the controller-OFF
                       control run demonstrably queue-collapses
+  hotset_promote_fail sketch-tier promotion faults: ruled tail resources
+                      stay sketched with stats failing OPEN and
+                      tail-rule verdicts failing CLOSED; a clean load
+                      heals and enforces exactly
 """
 
 from __future__ import annotations
@@ -1123,6 +1127,140 @@ def _scn_overload_storm(seed: int) -> ScenarioResult:
     return _result("overload_storm", seed, session, verdicts, t0)
 
 
+def _scn_hotset_promote_fail(seed: int) -> ScenarioResult:
+    """Hot-set promotion failures (``runtime.hotset.promote`` raises):
+    the ruled tail resources must stay sketched with stats failing OPEN
+    (the sketch keeps observing them) and tail-rule verdicts failing
+    CLOSED (the CMS threshold tables keep blocking).  After the armed
+    window — all traffic is appended AFTER it, keeping injected counts a
+    pure function of the seed (one promotion attempt per ruled tail
+    resource in the load) — a clean rule load proves promotion heals and
+    the healed resource enforces exactly."""
+    from sentinel_tpu.core import rules as R
+    from sentinel_tpu.core.config import small_engine_config
+
+    t0 = mono_s()
+    # tiny exact space (1-row promotion reserve) + sketch tail; the
+    # manager's own promote loop is parked far above any scenario volume
+    # so every runtime.hotset.promote hit comes from the rule loads
+    client = _make_client(
+        cfg=small_engine_config(
+            max_resources=8, max_nodes=16, sketch_stats=True,
+            sketch_width=256, hotset_promote_qps=1.0e9,
+        )
+    )
+    vt = client.time
+    metrics = MetricsDelta()
+    session = _Session()
+    totals = {"passed": 0, "blocked": 0}
+    extra = {}
+    try:
+        # exhaust organic exact rows; two ruled + one heal resource intern
+        # as sketch ids
+        i = 0
+        while not client.registry.is_sketch_id(
+            client.registry.resource_id(f"burn-{i}")
+        ):
+            i += 1
+        for n in ("tail-a", "tail-b"):
+            assert client.registry.is_sketch_id(client.registry.resource_id(n))
+        plan = FaultPlan(
+            name="hotset_promote_fail",
+            seed=seed,
+            faults=[
+                FaultSpec(
+                    "runtime.hotset.promote", "raise",
+                    burst_start=0, burst_len=2, exc="RuntimeError",
+                )
+            ],
+        )
+        with session.window(plan):
+            # the ONLY armed-site traffic: one promotion attempt per
+            # ruled tail resource, in load order — both injected to fail
+            client.flow_rules.load(
+                [
+                    R.FlowRule(resource="tail-a", count=2.0),
+                    R.FlowRule(resource="tail-b", count=2.0),
+                ]
+            )
+        still_tail = all(
+            client.registry.is_sketch_id(client.registry.peek_resource_id(n))
+            for n in ("tail-a", "tail-b")
+        )
+        extra["stayed_sketched"] = still_tail
+        # appended after the window: verdicts fail CLOSED (tail tables
+        # enforce the un-promoted rules) ...
+        closed = True
+        for n in ("tail-a", "tail-b"):
+            got = _drain_entries(client, n, 6)
+            totals["passed"] += got["passed"]
+            totals["blocked"] += got["blocked"]
+            closed = closed and 1 <= got["passed"] <= 2
+        extra["tail_verdicts_closed"] = closed
+        # ... and stats fail OPEN (the sketch kept observing them)
+        extra["stats_open"] = all(
+            client.stats.resource(n)["passQps"] >= 1 for n in ("tail-a", "tail-b")
+        )
+        # heal: a CLEAN reload retries promotion — the first rule in load
+        # order claims the one reserve row and enforces EXACTLY; the
+        # other stays on its conservative tail fallback
+        client.flow_rules.load(
+            [
+                R.FlowRule(resource="tail-a", count=2.0),
+                R.FlowRule(resource="tail-b", count=2.0),
+            ]
+        )
+        healed = not client.registry.is_sketch_id(
+            client.registry.peek_resource_id("tail-a")
+        ) and client.registry.is_sketch_id(
+            client.registry.peek_resource_id("tail-b")
+        )
+        vt.advance(1_100)
+        got = _drain_entries(client, "tail-a", 4)
+        totals["passed"] += got["passed"]
+        totals["blocked"] += got["blocked"]
+        extra["heal_promotes_and_enforces"] = healed and got == {
+            "passed": 2,
+            "blocked": 2,
+        }
+    finally:
+        client.stop()
+    extra["expect_metric_deltas"] = {
+        "sentinel_sketch_promotion_failures_total": 2,
+    }
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=client,
+        submitted=16,
+        passed=totals["passed"],
+        blocked=totals["blocked"],
+        injected=session.injected,
+        expect_injected={"runtime.hotset.promote:raise": 2},
+        extra=extra,
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "pipeline-drained",
+            "injected-as-planned",
+            "metric-deltas",
+        ],
+        ctx,
+    )
+    for nm, key, detail in (
+        ("promote-fails-stay-sketched", "stayed_sketched",
+         "failed promotions must leave resources on sketch ids"),
+        ("tail-verdicts-fail-closed", "tail_verdicts_closed",
+         "un-promoted tail rules must still block from the CMS tables"),
+        ("stats-fail-open", "stats_open",
+         "the sketch must keep observing resources promotion failed for"),
+        ("heal-promotes-exactly", "heal_promotes_and_enforces",
+         "a clean load must promote into the reserve and enforce exactly"),
+    ):
+        verdicts.append(Verdict(nm, bool(extra.get(key)), detail))
+    return _result("hotset_promote_fail", seed, session, verdicts, t0)
+
+
 def _result(name, seed, session, verdicts, t0) -> ScenarioResult:
     return ScenarioResult(
         name=name,
@@ -1191,6 +1329,11 @@ SCENARIOS: Dict[str, Scenario] = {
             "overload_storm",
             _scn_overload_storm,
             "2x-capacity flash crowd: ladder climbs, sheds, recovers; OFF collapses",
+        ),
+        Scenario(
+            "hotset_promote_fail",
+            _scn_hotset_promote_fail,
+            "hot-set promotion faults: stats fail open, tail verdicts fail closed",
         ),
     )
 }

@@ -209,6 +209,30 @@ class EngineConfig:
     sketch_depth: int = 2
     sketch_width: int = 1 << 14  # CMS eps = e/width of window volume
     sketch_capacity: int = 1 << 22  # max interned sketch resources
+    # SALSA self-adjusting sketch tier (sentinel_tpu/sketch/salsa.py):
+    # int8 cells packed 4-per-int32 that merge with neighbors on
+    # saturation (width bitmap tracked per word), plus O(1) windowed
+    # reads from incrementally maintained running sums — ~4x the width
+    # per HBM byte vs the plain int32 CMS and read cost independent of
+    # the window shape.  False falls back to the seed ops/gsketch.py.
+    sketch_salsa: bool = True
+    # sketch tier window shape; 0 inherits the second window.  The 1 M+
+    # tier runs minute-scale windows here (e.g. 60 x 1000 ms) without
+    # touching the exact tier's shape; tail-rule thresholds scale by the
+    # interval (rule_tensors.compile_tail_flow_rules)
+    sketch_sample_count: int = 0
+    sketch_window_ms: int = 0
+    # hot-set manager (sentinel_tpu/sketch/hotset.py): the tick emits the
+    # top-K sketched resources of each batch by windowed pass estimate
+    # (TickOutput.hot, device top_k over ids the batch actually carried);
+    # the host manager promotes heavy ones into exact rows and demotes
+    # cold promoted rows back to the tail.  0 disables emission (the
+    # traced program is unchanged).
+    hotset_k: int = 32
+    hotset_eval_s: float = 1.0  # manager evaluation cadence (host seconds)
+    hotset_promote_qps: float = 100.0  # windowed pass estimate to qualify
+    hotset_demote_qps: float = 1.0  # exact windowed pass to demote below
+    hotset_cooldown_s: float = 30.0  # re-promotion hysteresis after demote
     # device-resident telemetry (ops/engine._device_stats): the tick emits
     # one compact float32 stats row (verdict mix by block reason, admitted/
     # blocked token sums, seg occupancy, adaptive-ceiling utilization, and
@@ -256,6 +280,30 @@ class EngineConfig:
                 "seg_static_ranks=True requires seg_effects=True (it "
                 "specializes the segment check phase's rank scans)"
             )
+        if self.sketch_stats and self.sketch_salsa and self.sketch_width % 64:
+            raise ValueError(
+                "sketch_salsa packs 4 int8 lanes/word and 16 words per "
+                "bitmap int32, so sketch_width must be a multiple of 64; "
+                f"got {self.sketch_width}"
+            )
+        if self.sketch_stats and self.node_rows + self.sketch_capacity >= 1 << 24:
+            # TickOutput.hot rides sketch ids through a float32 column
+            # (engine._device_hot_candidates); an id at or above 2^24
+            # would round and fold/promote the WRONG resource
+            raise ValueError(
+                "node_rows + sketch_capacity must stay below 2^24 (sketch "
+                "ids must be float32-exact for the hot-candidate rows); "
+                f"got {self.node_rows} + {self.sketch_capacity}"
+            )
+
+    @property
+    def sketch_shape(self) -> tuple:
+        """(sample_count, window_ms) of the sketch tier's bucket grid —
+        the sketch knobs when set, else the second window's shape."""
+        return (
+            self.sketch_sample_count or self.second_sample_count,
+            self.sketch_window_ms or self.second_window_ms,
+        )
 
     # dtype policy: counters int32, rt sums float32
     @property

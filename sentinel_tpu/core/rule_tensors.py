@@ -103,7 +103,14 @@ def compile_tail_flow_rules(
 ) -> TailFlowTensors:
     """tail_rules: [(sketch_resource_id, count), ...] — QPS grade only
     (other grades/behaviors require exact windows; they promote or drop
-    with a warning at the call site)."""
+    with a warning at the call site).
+
+    ``count`` is a QPS; the compiled cell threshold is count * the sketch
+    tier's window interval in seconds, since enforcement compares it
+    against the sketch's WINDOWED pass sum (a minute-window sketch tier
+    must admit 60x the per-second rate per interval).  Vectorized over
+    rules — the 1 M-ruled-resource tier compiles in one numpy pass, not a
+    per-rule Python loop."""
     import numpy as _np
 
     thr = np.full((cfg.sketch_depth, cfg.sketch_width), TAIL_UNRULED, dtype=np.float32)
@@ -112,12 +119,24 @@ def compile_tail_flow_rules(
 
         from sentinel_tpu.ops.param import cms_cell
 
+        nb, wms = cfg.sketch_shape
+        scale = (nb * wms) / 1000.0
         ids = _np.asarray([rid for rid, _ in tail_rules], dtype=_np.int32)
-        cols = _np.asarray(cms_cell(_jnp.asarray(ids), cfg.sketch_depth, cfg.sketch_width))
-        for i, (_rid, count) in enumerate(tail_rules):
-            for d in range(cfg.sketch_depth):
-                c = int(cols[i, d])
-                thr[d, c] = min(thr[d, c], float(count))
+        counts = _np.asarray(
+            [count for _rid, count in tail_rules], dtype=_np.float32
+        ) * _np.float32(scale)
+        # the enforcement read clamps the windowed estimate at 2^24 - 1
+        # (estimate_plane_mxu), so a scaled threshold at or above the
+        # clamp could never trip — clamp thresholds just BELOW it instead
+        # (the rule then enforces at the cap, conservative, rather than
+        # silently not at all)
+        counts = _np.minimum(counts, _np.float32((1 << 24) - 2))
+        cols = _np.asarray(
+            cms_cell(_jnp.asarray(ids), cfg.sketch_depth, cfg.sketch_width)
+        )
+        for d in range(cfg.sketch_depth):
+            # colliding rules take the MIN threshold per cell (conservative)
+            _np.minimum.at(thr[d], cols[:, d], counts)
     return TailFlowTensors(thr=thr)
 
 

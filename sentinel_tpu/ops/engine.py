@@ -61,6 +61,7 @@ from sentinel_tpu.core.rules import (
 from sentinel_tpu.ops import degrade as D
 from sentinel_tpu.ops import fused as FU
 from sentinel_tpu.ops import gsketch as GS
+from sentinel_tpu.sketch import impl_for as _sketch
 from sentinel_tpu.ops import rtq as RQ
 from sentinel_tpu.ops import param as P
 from sentinel_tpu.ops import rowmin as RM
@@ -191,6 +192,13 @@ class TickOutput(NamedTuple):
     # their current second-window bucket's cumulative stats — see
     # _device_res_stats.  None when telemetry or timeline_k is off.
     res_stats: object = None
+    # hot-set candidates (cfg.hotset_k + sketch_stats): float32 [K, 2]
+    # (sketch resource id, windowed pass estimate) — the top-K SKETCHED
+    # ids of this batch by sketch estimate, the device half of the
+    # promotion loop (sentinel_tpu/sketch/hotset.py).  Ids stay f32-exact
+    # (node_rows + sketch_capacity < 2^24).  None when off (traced
+    # program unchanged).
+    hot: object = None
 
 
 # -- device-resident telemetry (TickOutput.stats) ---------------------------
@@ -406,7 +414,7 @@ def init_state(cfg: EngineConfig) -> EngineState:
             (cfg.param_sample_count,), -(cfg.param_sample_count + 1), dtype=jnp.int32
         ),
         pconc=jnp.zeros((cfg.param_depth, cfg.param_width), dtype=jnp.int32),
-        gs=GS.init_sketch(sketch_config(cfg))
+        gs=_sketch(cfg).init_sketch(sketch_config(cfg))
         if cfg.sketch_stats
         else GS.SketchState(
             counts=jnp.zeros((1, 1, 1, GS.PLANES), jnp.int32),
@@ -425,12 +433,39 @@ def rtq_config(cfg: EngineConfig) -> RQ.RtqConfig:
 
 
 def sketch_config(cfg: EngineConfig) -> GS.SketchConfig:
+    nb, wms = cfg.sketch_shape
     return GS.SketchConfig(
-        sample_count=cfg.second_sample_count,
-        window_ms=cfg.second_window_ms,
+        sample_count=nb,
+        window_ms=wms,
         depth=cfg.sketch_depth,
         width=cfg.sketch_width,
     )
+
+
+def hotset_k(cfg: EngineConfig) -> int:
+    """Effective hot-candidate row count (0 = TickOutput.hot off)."""
+    if not cfg.sketch_stats or cfg.hotset_k <= 0:
+        return 0
+    return int(cfg.hotset_k)
+
+
+def _device_hot_candidates(cfg: EngineConfig, state: EngineState, acq, valid, now_ms):
+    """Build TickOutput.hot: [K, 2] (sketch id, windowed pass estimate).
+
+    Runs AFTER the acquire effects landed, so the estimate includes this
+    tick.  Only ids the batch actually carried can surface — the sketch
+    alone cannot be inverted back to ids, so candidate discovery rides
+    the traffic stream (the heavy-hitter side channel every CMS deployment
+    needs); the host manager folds successive ticks, which covers any
+    resource hot enough to matter within one evaluation period."""
+    K = min(hotset_k(cfg), acq.res.shape[0])
+    SK = _sketch(cfg)
+    est = SK.estimate_plane_mxu(
+        cfg, state.gs, now_ms, acq.res, W.EV_PASS, sketch_config(cfg)
+    )
+    score = jnp.where(valid & (acq.res >= cfg.node_rows), est, -1.0)
+    v, i = jax.lax.top_k(score, K)
+    return jnp.stack([acq.res[i].astype(jnp.float32), v], axis=1)
 
 
 def empty_acquire(cfg: EngineConfig, b: Optional[int] = None) -> AcquireBatch:
@@ -795,7 +830,7 @@ def _process_completions(
         ).astype(jnp.int32)
         vals = jnp.stack([comp.success, comp.error, rt_q], axis=1)
         state = state._replace(
-            gs=GS.add(
+            gs=_sketch(cfg).add(
                 state.gs,
                 now_ms,
                 comp.res,
@@ -1154,7 +1189,7 @@ def _process_completions_fused(
     if sk_out is not None:
         upd = jnp.round(sk_out).astype(jnp.int32)  # [depth, width, 3]
         state = state._replace(
-            gs=GS.add_dense(
+            gs=_sketch(cfg).add_dense(
                 state.gs,
                 now_ms,
                 upd,
@@ -1363,13 +1398,17 @@ def _acquire_effects_fused(
     )
 
     if sk_out is not None:
+        # the completion phase already refreshed the sketch bucket at this
+        # now_ms (its write is unconditional under sketch_stats), so the
+        # acquire side skips the masked-multiply copy of the counts tensor
         state = state._replace(
-            gs=GS.add_dense(
+            gs=_sketch(cfg).add_dense(
                 state.gs,
                 now_ms,
                 jnp.round(sk_out).astype(jnp.int32),
                 (W.EV_PASS, W.EV_BLOCK),
                 sketch_config(cfg),
+                pre_refreshed=True,
             )
         )
 
@@ -2014,7 +2053,7 @@ def _check_tail_flow(
         # 0*inf = NaN on the MXU path and kill enforcement silently
         ruled = elig & (thr < RT.TAIL_UNRULED / 2)
 
-        est = GS.estimate_plane_mxu(
+        est = _sketch(cfg).estimate_plane_mxu(
             cfg, state.gs, now_ms, acq.res, W.EV_PASS, sketch_config(cfg)
         )
         cnt = acq.count.astype(jnp.float32)
@@ -2446,9 +2485,12 @@ def tick(
             )
             if timeline_k(cfg) > 0:
                 res_stats = _device_res_stats(cfg, state, now_ms)
+        hot = None
+        if hotset_k(cfg) > 0:
+            hot = _device_hot_candidates(cfg, state, acq, valid, now_ms)
         return state, TickOutput(
             verdict=verdict, wait_ms=wait_ms, seg_dropped=seg_dropped,
-            stats=stats, res_stats=res_stats,
+            stats=stats, res_stats=res_stats, hot=hot,
         )
 
     with_nodes = "nodes" in features
@@ -2493,8 +2535,10 @@ def tick(
             ],
             axis=1,
         )
+        # completion phase already refreshed this now_ms's bucket — skip
+        # the second masked-multiply copy of the whole counts tensor
         state = state._replace(
-            gs=GS.add(
+            gs=_sketch(cfg).add(
                 state.gs,
                 now_ms,
                 acq.res,
@@ -2502,6 +2546,7 @@ def tick(
                 (W.EV_PASS, W.EV_BLOCK),
                 valid,
                 sketch_config(cfg),
+                pre_refreshed=True,
             )
         )
 
@@ -2568,8 +2613,12 @@ def tick(
         )
         if timeline_k(cfg) > 0:
             res_stats = _device_res_stats(cfg, state, now_ms)
+    hot = None
+    if hotset_k(cfg) > 0:
+        hot = _device_hot_candidates(cfg, state, acq, valid, now_ms)
     return state, TickOutput(
-        verdict=verdict, wait_ms=wait_ms, stats=stats, res_stats=res_stats
+        verdict=verdict, wait_ms=wait_ms, stats=stats, res_stats=res_stats,
+        hot=hot,
     )
 
 
@@ -2742,8 +2791,20 @@ def migrate_state(
         win_min = carry(state.win_min, o_min, n_min, out.win_min)
 
     # shape-stable fields carry over verbatim; gs/rtq keep their state when
-    # the grid is unchanged, else restart fresh
-    gs = state.gs if out.gs.counts.shape == state.gs.counts.shape else out.gs
+    # the grid is unchanged, else restart fresh (gs is impl-polymorphic —
+    # GS.SketchState or sketch/salsa.SalsaState — so compare leaf shapes)
+    gs = (
+        state.gs
+        if type(out.gs) is type(state.gs)
+        and all(
+            a.shape == b.shape
+            for a, b in zip(
+                jax.tree_util.tree_leaves(out.gs),
+                jax.tree_util.tree_leaves(state.gs),
+            )
+        )
+        else out.gs
+    )
     rtq = state.rtq if out.rtq.counts.shape == state.rtq.counts.shape else out.rtq
     return out._replace(
         win_sec=win_sec,

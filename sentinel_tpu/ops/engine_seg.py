@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.ops import fused as FU
 from sentinel_tpu.ops import gsketch as GS
+from sentinel_tpu.sketch import impl_for as _sketch
 from sentinel_tpu.ops import param as P
 from sentinel_tpu.ops import rowmin as RM
 from sentinel_tpu.ops import rtq as RQ
@@ -510,7 +511,7 @@ def run_checks_seg(
             )
             thrs.append(jnp.where(tail_u, t, RT.TAIL_UNRULED))
         thr_u = jnp.max(jnp.stack(thrs, axis=0), axis=0)
-        est_u = GS.estimate_plane_mxu(
+        est_u = _sketch(cfg).estimate_plane_mxu(
             cfg, state.gs, now_ms, tres_u, W.EV_PASS, E.sketch_config(cfg)
         )
         i_tthr = exp.add_f(thr_u)
@@ -1078,7 +1079,7 @@ def process_completions_seg(
             ]
         )  # [depth, width, 3]
         state = state._replace(
-            gs=GS.add_dense(
+            gs=_sketch(cfg).add_dense(
                 state.gs,
                 now_ms,
                 upd,
@@ -1327,13 +1328,16 @@ def acquire_effects_seg(
                 for d in range(cfg.sketch_depth)
             ]
         )
+        # the completion phase already refreshed this now_ms's sketch
+        # bucket (its write is unconditional under sketch_stats)
         state = state._replace(
-            gs=GS.add_dense(
+            gs=_sketch(cfg).add_dense(
                 state.gs,
                 now_ms,
                 upd,
                 (W.EV_PASS, W.EV_BLOCK),
                 E.sketch_config(cfg),
+                pre_refreshed=True,
             )
         )
 

@@ -87,14 +87,22 @@ def add(
     valid: jax.Array,  # bool [N]
     cfg: SketchConfig,
     max_int: int = 65535,
+    pre_refreshed: bool = False,
 ) -> SketchState:
     """Only the named planes are contracted — the acquire path lands
     (pass, block), the completion path (success, exception, rt_q); paying
-    for all PLANES on both would double the sketch's MAC bill."""
-    state = refresh(state, now_ms, cfg)
+    for all PLANES on both would double the sketch's MAC bill.
+
+    ``pre_refreshed``: the caller guarantees a sketch write with the SAME
+    ``now_ms`` already ran this trace (the tick lands completions before
+    acquire effects), so the current bucket's epoch is already stamped and
+    the masked-multiply copy of the whole counts tensor in ``refresh`` can
+    be skipped — the second write per tick becomes a pure column add."""
+    if not pre_refreshed:
+        state = refresh(state, now_ms, cfg)
     idx = _wid(now_ms, cfg) % cfg.sample_count
     cols = cms_cell(res, cfg.depth, cfg.width)  # [N, depth]
-    plan = MX.make_plan(cfg.width, 512)
+    plan = MX.plan_for(cfg.width, 512)
     col = state.counts[idx]  # [depth, width, PLANES]
     upds = []
     for d in range(cfg.depth):
@@ -120,10 +128,13 @@ def add_dense(
     upd: jax.Array,  # int32 [depth, width, len(plane_idx)] — precomputed histogram
     plane_idx: Tuple[int, ...],
     cfg: SketchConfig,
+    pre_refreshed: bool = False,
 ) -> SketchState:
     """Land a precomputed per-cell delta (from the fused effects kernel,
-    ops/fused.py) into the current bucket — the dense companion of add()."""
-    state = refresh(state, now_ms, cfg)
+    ops/fused.py) into the current bucket — the dense companion of add().
+    ``pre_refreshed``: see add()."""
+    if not pre_refreshed:
+        state = refresh(state, now_ms, cfg)
     idx = _wid(now_ms, cfg) % cfg.sample_count
     new_col = state.counts[idx].at[:, :, jnp.asarray(plane_idx)].add(upd)
     return state._replace(counts=state.counts.at[idx].set(new_col))

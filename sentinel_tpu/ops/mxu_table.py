@@ -28,7 +28,8 @@ Cost: B × N MACs per plane (N = table rows).  B=128K, N=256K → 34 GMAC ≈
 from __future__ import annotations
 
 import math
-from typing import NamedTuple, Optional, Sequence
+import threading
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +51,27 @@ def make_plan(n: int, n_lo: int = 512) -> TablePlan:
     n_lo = max(n_lo, 128)
     n_hi = max((n + n_lo - 1) // n_lo, 1)
     return TablePlan(n=n, n_hi=n_hi, n_lo=n_lo)
+
+
+_PLANS: Dict[Tuple[int, int], TablePlan] = {}
+_PLANS_LOCK = threading.Lock()
+
+
+def plan_for(n: int, n_lo: int = 512) -> TablePlan:
+    """Cached make_plan (same check-then-act-under-lock shape as
+    parallel/router._RINGS): hot per-call sites (the sketch add path runs
+    once per tick per side) share one TablePlan instance instead of
+    re-deriving it — the plan is a pure function of (n, n_lo), so a cached
+    instance also guarantees the traced constants are identical across
+    calls (tick-identity, no retrace)."""
+    key = (n, n_lo)
+    plan = _PLANS.get(key)
+    if plan is None:
+        with _PLANS_LOCK:
+            plan = _PLANS.get(key)
+            if plan is None:
+                plan = _PLANS[key] = make_plan(n, n_lo)
+    return plan
 
 
 def onehots(idx: jax.Array, plan: TablePlan, valid=None, dtype=jnp.bfloat16):

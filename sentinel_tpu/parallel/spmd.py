@@ -40,6 +40,25 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=("res",))
 
 
+def _sketch_shardings(cfg: EngineConfig, mesh: Mesh, rep):
+    """Sharding pytree for EngineState.gs, per the live sketch impl."""
+    if not cfg.sketch_stats:
+        return GS.SketchState(counts=rep, epochs=rep)
+    if cfg.sketch_salsa:
+        from sentinel_tpu.sketch import salsa as SA
+
+        return SA.SalsaState(
+            words=NamedSharding(mesh, PS(None, None, None, "res")),
+            lvlmap=NamedSharding(mesh, PS(None, None, None, "res")),
+            run=NamedSharding(mesh, PS(None, None, "res")),
+            epochs=rep,
+        )
+    return GS.SketchState(
+        counts=NamedSharding(mesh, PS(None, None, "res", None)),
+        epochs=rep,
+    )
+
+
 def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
     """Sharding pytree matching EngineState: node-row tensors split on
     'res', per-rule tensors replicated."""
@@ -69,15 +88,13 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
         pcms=NamedSharding(mesh, PS(None, "res", None)),
         pcms_epochs=rep,
         pconc=NamedSharding(mesh, PS(None, "res")),
-        # the global sketch shards on its width axis (counts [nb, depth,
-        # width, planes]) so tail-resource observability scales with chips;
-        # with the sketch off the state is a unit dummy — replicate it
-        gs=GS.SketchState(
-            counts=NamedSharding(mesh, PS(None, None, "res", None))
-            if cfg.sketch_stats
-            else rep,
-            epochs=rep,
-        ),
+        # the global sketch shards on its width axis so tail-resource
+        # observability scales with chips; with the sketch off the state
+        # is a unit dummy — replicate it.  The salsa tier (sketch/salsa)
+        # shards its packed words/bitmap on the word axis and the running
+        # sums on the logical width axis — all width-aligned, so the
+        # shards stay co-local with the seed layout's
+        gs=_sketch_shardings(cfg, mesh, rep),
         rtq=RQ.RtqState(counts=rep, epochs=rep),
     )
 

@@ -652,6 +652,15 @@ class SentinelClient:
         self._started = False
         self.stats = ClientStats(self)
 
+        # hot-set manager (sketch/hotset.py): folds the device's
+        # TickOutput.hot candidate rows and promotes/demotes between the
+        # exact tier and the sketch tail on its own cadence
+        self.hotset = None
+        if self.cfg.sketch_stats and E.hotset_k(self.cfg) > 0:
+            from sentinel_tpu.sketch.hotset import HotSetManager
+
+            self.hotset = HotSetManager(self)
+
         # segment-compacted path bookkeeping: the tick builder presorts
         # batches by the engine's segment keys (see _presort_cols) and
         # tracks observed live-segment counts so seg_u can grow to fit the
@@ -1110,10 +1119,16 @@ class SentinelClient:
             local_flow + self.degrade_rules.get(),
             key=_tail_can_serve,  # False (must-promote) sorts first
         )
+        # promotion routes through the hot-set guard (sketch/hotset.py):
+        # a failed promotion leaves the rule on its sketch id, where the
+        # tail tables still enforce it conservatively (fail-closed
+        # verdicts) and the sketch keeps observing it (fail-open stats)
+        from sentinel_tpu.sketch.hotset import guarded_promote
+
         for r in candidates:
             rid = self.registry.peek_resource_id(r.resource)
             if rid is not None and self.registry.is_sketch_id(rid):
-                self.registry.promote_resource(r.resource)
+                guarded_promote(self.registry, r.resource)
 
         param = self.param_flow_rules.get() + self.gateway_param_rules.get()
         local_param = [r for r in param if not r.cluster_mode]
@@ -2116,6 +2131,13 @@ class SentinelClient:
         _tick_mutex — sync-mode clients call this from request threads."""
         with self._tick_mutex:
             self._tick_once_locked(now_ms)
+        # hot-set promote/demote loop: one cheap cadence check per
+        # iteration, outside the tick mutex (the manager takes its own
+        # locks; a promotion-triggered rule recompile must not hold up
+        # the serving path's mutex holders)
+        hs = self.hotset
+        if hs is not None:
+            hs.maybe_evaluate()
 
     def _tick_once_locked(self, now_ms: Optional[int]) -> None:
         while True:
@@ -3111,6 +3133,12 @@ class SentinelClient:
             self.timeline.note_tick(
                 rs, p.now_ms, self.time.wall_ms(p.now_ms) - p.now_ms
             )
+        # hot-set candidate rows ([K, 2] id/estimate): folded into the
+        # promotion loop's candidate map (sketch/hotset.py)
+        if out.hot is not None and self.hotset is not None:
+            hot = np.asarray(out.hot)  # stlint: disable=host-sync — readback point
+            _C_WIRE["rx"].inc(hot.nbytes)
+            self.hotset.fold(hot)
         if p.check_dropped:
             # fail-closed capacity overflow must be LOUD (an engine
             # rejecting traffic because seg_u is undersized is an incident,
@@ -3251,17 +3279,21 @@ class ClientStats:
         return None if row is None else self._row_stats(row)
 
     def _sketch_stats(self, rids, now_ms: Optional[int] = None) -> list:
-        """Windowed CMS estimates for sketch-id resources (ops/gsketch.py);
-        pass/block are small overestimates bounded by the sketch (eps,delta)."""
+        """Windowed CMS estimates for sketch-id resources (the salsa tier
+        or the seed ops/gsketch.py, per cfg.sketch_salsa); pass/block are
+        small overestimates bounded by the sketch (eps, delta)."""
         from sentinel_tpu.ops import engine as E
         from sentinel_tpu.ops import gsketch as GS
+        from sentinel_tpu.sketch import impl_for
 
         c = self._c
         scfg = E.sketch_config(c.cfg)
         now = jnp.int32(c.time.now_ms() if now_ms is None else now_ms)
         with c._engine_lock:
             est = np.asarray(
-                GS.estimate(c._state.gs, now, jnp.asarray(rids, jnp.int32), scfg)
+                impl_for(c.cfg).estimate(
+                    c._state.gs, now, jnp.asarray(rids, jnp.int32), scfg
+                )
             )
         interval_s = scfg.interval_ms / 1000.0
         out = []

@@ -51,6 +51,10 @@ class Registry:
         # global CMS sketch (ops/gsketch.py) instead of exact rows
         self._sketch_names: Dict[int, str] = {}
         self._next_sketch = cfg.node_rows
+        # exact rows freed by demotion, quarantined until their engine
+        # window state has fully expired (reuse-after ordered by free
+        # time): (row, reusable_at mono_s)
+        self._quarantined_rows: List[Tuple[int, float]] = []
         # origins are a separate id space (matched against limitApp)
         self._origins: Dict[str, int] = {}
         self._origin_names: List[str] = []
@@ -96,26 +100,75 @@ class Registry:
     def peek_resource_id(self, name: str) -> Optional[int]:
         return self._resources.get(name)
 
+    def _claim_quarantined_row(self) -> Optional[int]:
+        """Reusable demoted row, or None (caller holds the lock).  Rows
+        become reusable only after their quarantine lapses — the engine's
+        window buckets for the old occupant must have rotated out (and
+        in-flight entries completed) before a new name inherits the row,
+        or the newcomer would start life with the old stats/concurrency."""
+        from sentinel_tpu.utils.time_source import mono_s
+
+        if self._quarantined_rows and mono_s() >= self._quarantined_rows[0][1]:
+            return self._quarantined_rows.pop(0)[0]
+        return None
+
     def promote_resource(self, name: str) -> Optional[int]:
         """Move a sketch-id resource into the exact row space (so rules can
         bind to real windows) — the SALSA-style hot-promotion half of tail
         enforcement.  Returns the exact row, or None when the exact space
         is full (the rule then enforces approximately via the tail CMS
         tables).  In-flight events carrying the old sketch id land in the
-        sketch one last time — an observability-only transient."""
+        sketch one last time — an observability-only transient.
+
+        Demoted rows past quarantine are reclaimed first, so a hot-set
+        promote/demote cycle does not burn through the reserve."""
         with self._lock:
             rid = self._resources.get(name)
             if rid is None or rid < self.cfg.node_rows:
                 return rid  # unknown or already exact
-            if self._next_res >= self.cfg.max_resources:
-                return None  # even the reserve is spent
-            new = self._next_res
-            self._next_res += 1
+            new = self._claim_quarantined_row()
+            if new is None:
+                if self._next_res >= self.cfg.max_resources:
+                    return None  # even the reserve is spent
+                new = self._next_res
+                self._next_res += 1
             self._resources[name] = new
             while len(self._resource_names) <= new:
                 self._resource_names.append(None)
             self._resource_names[new] = name
             self._sketch_names.pop(rid, None)
+            return new
+
+    def demote_resource(self, name: str, quarantine_s: float) -> Optional[int]:
+        """Move an exact-row resource back into the sketch tail (the
+        hot-set manager's cold path).  The freed row is quarantined for
+        ``quarantine_s`` REAL (wall-clock) seconds before promotion may
+        reuse it — the caller sizes it to outlive every engine window
+        holding the old occupant's counts plus in-flight entries on the
+        row (HotSetManager uses 2x the longest window interval + 30 s).
+        Virtual-time harnesses whose engine clock is decoupled from wall
+        time must pass a quarantine matched to their own advance rate
+        (engine windows rotate on ENGINE time, this quarantine on wall
+        time).  Returns the new sketch id, or None when the resource
+        cannot demote (unknown, the ENTRY row, or sketch capacity
+        exhausted)."""
+        from sentinel_tpu.utils.time_source import mono_s
+
+        with self._lock:
+            rid = self._resources.get(name)
+            if rid is None or rid >= self.cfg.node_rows:
+                return rid if rid is not None else None  # already sketch
+            if rid <= 0:
+                return None  # ENTRY row never demotes
+            if self._next_sketch - self.cfg.node_rows >= self.cfg.sketch_capacity:
+                return None
+            new = self._next_sketch
+            self._next_sketch += 1
+            self._resources[name] = new
+            self._sketch_names[new] = name
+            if rid < len(self._resource_names):
+                self._resource_names[rid] = None
+            self._quarantined_rows.append((rid, mono_s() + quarantine_s))
             return new
 
     def resource_name(self, rid: int) -> Optional[str]:

@@ -1,0 +1,53 @@
+"""sentinel_tpu.sketch — the self-adjusting sketch statistics tier.
+
+The exact tier (ops/window.py rows) serves ruled + hot resources; this
+package makes the sketched TAIL load-bearing for everything else, so the
+engine enforces flow rules on 1 M+ resources with bounded error instead
+of capping at the exact row space:
+
+  salsa.py   SALSA-style self-adjusting counters (arXiv 2102.12531):
+             cells start at int8, packed four to an int32 word, and merge
+             with their neighbors on saturation (int8 -> int16 -> int32),
+             tracked by a 2-bit-per-word width bitmap — width x depth HBM
+             stretches ~4x at the same error target.  Windowed reads are
+             O(1): a running window sum is maintained incrementally at
+             bucket rotation (subtract-expired / add-new, the "Efficient
+             Summing over Sliding Windows" shape, arXiv 1604.02450)
+             instead of summing all sample_count buckets per read.
+
+  hotset.py  Host-side hot-set manager: the tick emits a device-computed
+             top-K heavy-hitter estimate over sketched traffic
+             (TickOutput.hot); the manager promotes heavy sketched
+             resources into the exact tier, demotes cold promoted rows
+             back to the tail, and damps flapping with
+             adaptive.degrade.Hysteresis.
+
+Enforcement bias (documented + tested): the sketch only OVERESTIMATES —
+CMS collisions, SALSA merges, and lazy bucket expiry all err upward — so
+tail-rule blocks fire early, never late.  Promotion failures fail OPEN
+for statistics (the resource stays sketched and observed) and CLOSED for
+tail-rule verdicts (the tail tables keep enforcing conservatively).
+
+``impl_for(cfg)`` dispatches the engine's sketch call sites between the
+seed CMS (ops/gsketch.py, ``sketch_salsa=False``) and the SALSA tier —
+both expose the same (init/add/add_dense/estimate/estimate_plane_mxu)
+surface over ops/gsketch.SketchConfig.
+"""
+
+from __future__ import annotations
+
+
+def impl_for(cfg):
+    """The sketch kernel module for an EngineConfig: salsa (default) or
+    the plain CMS seed.  Import is deferred so ops modules can import
+    this package without cycles."""
+    if getattr(cfg, "sketch_salsa", False):
+        from sentinel_tpu.sketch import salsa
+
+        return salsa
+    from sentinel_tpu.ops import gsketch
+
+    return gsketch
+
+
+__all__ = ["impl_for"]

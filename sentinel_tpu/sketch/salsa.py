@@ -1,0 +1,354 @@
+"""SALSA-style self-adjusting windowed count-min sketch (TPU-batched).
+
+The seed tail sketch (ops/gsketch.py) spends one int32 per
+(bucket, depth, column, plane) cell and sums all ``sample_count`` buckets
+on every windowed read.  At minute windows over 1 M+ resources that is
+the whole HBM bill, so this module replaces both sides:
+
+STORAGE — self-adjusting counters (arXiv 2102.12531, "SALSA"): logical
+columns start as int8 cells, FOUR packed into each int32 word.  When a
+cell saturates its current width, the word's cells merge with their
+neighbors (sums — the CMS overestimate direction) and the word re-packs
+one level wider:
+
+    level 0   4 x int8   (cell cap 255)        — the steady state
+    level 1   2 x int16  (cell cap 65535)      — lanes {0,1} / {2,3} merge
+    level 2   1 x int32  (clamped, see _cap2)  — all four lanes merge
+
+A per-word 2-bit level rides a packed width bitmap (16 words per int32).
+Light columns — almost all of them under Zipf traffic — stay at int8, so
+the per-bucket plane costs W bytes instead of 4W: width x depth stretches
+~4x at the same HBM and error target.  Merging only ever widens a
+counter's coverage, so estimates stay upper bounds (min-over-depth CMS
+semantics intact; heavy neighborhoods degrade toward width/4, the
+documented SALSA trade).
+
+READS — O(1) windowed sums (arXiv 1604.02450): ``run`` holds the decoded
+window total per logical column, maintained INCREMENTALLY — adds land
+their decoded delta, and a bucket subtracts its decoded contents exactly
+once, when it rotates out.  Reads gather ``run`` directly; no per-read
+sum over sample_count buckets, and the estimate cost is independent of
+the window shape.
+
+Lazy expiry (documented transient): after an idle gap longer than the
+window interval, buckets that expired WITHOUT being rotated into still
+sit in ``run`` until traffic rotates them out (one per window_ms).  Until
+then estimates OVERESTIMATE by at most one pre-gap window volume — the
+conservative direction for enforcement (blocks fire early, never late).
+``sweep_expired`` purges them eagerly for callers that care (tests,
+post-idle maintenance).
+
+Every estimate here is >= the true windowed count: CMS collision, SALSA
+merge, and lazy expiry all err upward.  Tail-rule enforcement built on it
+therefore fails CLOSED (tests/test_salsa.py pins the invariant).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.ops import mxu_table as MX
+from sentinel_tpu.ops.gsketch import PLANES, RT_PLANE, RT_SCALE, SketchConfig, _wid
+from sentinel_tpu.ops.param import cms_cell
+
+#: words per packed int32 of the width bitmap (2 bits per word level)
+_BMP = 16
+
+
+def _cap2(cfg: SketchConfig) -> int:
+    """Level-2 cell clamp, sized so the OVERFLOW-FREE invariant holds by
+    construction: ``run`` sums at most sample_count decoded buckets, each
+    cell <= cap2, so run <= sample_count * cap2 <= int32 max — the
+    running sums can never wrap negative and silently invert the
+    fail-closed bias to fail-open for the heaviest cell.  At the minute
+    window (nb=60) this still allows ~35 M token-weighted events per
+    cell per SECOND-long bucket, far past the device's total peak."""
+    return ((1 << 31) - 1) // max(cfg.sample_count, 2)
+
+
+class SalsaState(NamedTuple):
+    words: jax.Array  # int32 [nb, depth, PLANES, Wp]  packed counter words
+    lvlmap: jax.Array  # int32 [nb, depth, PLANES, Wp // 16]  2-bit width bitmap
+    run: jax.Array  # int32 [depth, PLANES, W]  O(1) running window sums
+    epochs: jax.Array  # int32 [nb]  window-id per bucket column
+
+
+def _wp(cfg: SketchConfig) -> int:
+    if cfg.width % (4 * _BMP):
+        raise ValueError(
+            f"salsa sketch width must be a multiple of {4 * _BMP} "
+            f"(4 int8 lanes/word, {_BMP} words/bitmap-int32); got {cfg.width}"
+        )
+    return cfg.width // 4
+
+
+def init_sketch(cfg: SketchConfig) -> SalsaState:
+    wp = _wp(cfg)
+    return SalsaState(
+        words=jnp.zeros((cfg.sample_count, cfg.depth, PLANES, wp), jnp.int32),
+        lvlmap=jnp.zeros(
+            (cfg.sample_count, cfg.depth, PLANES, wp // _BMP), jnp.int32
+        ),
+        run=jnp.zeros((cfg.depth, PLANES, cfg.width), jnp.int32),
+        epochs=jnp.full((cfg.sample_count,), -(cfg.sample_count + 1), jnp.int32),
+    )
+
+
+# -- width bitmap ------------------------------------------------------------
+
+
+def pack_levels(lvl: jax.Array) -> jax.Array:
+    """int32 levels [..., Wp] in {0,1,2} -> packed bitmap [..., Wp//16]
+    (2-bit fields, word k at bits [2k, 2k+2))."""
+    g = lvl.reshape(lvl.shape[:-1] + (-1, _BMP)).astype(jnp.int32)
+    out = jnp.zeros(g.shape[:-1], jnp.int32)
+    for k in range(_BMP):
+        out = out | (g[..., k] << (2 * k))
+    return out
+
+
+def unpack_levels(packed: jax.Array, wp: int) -> jax.Array:
+    """Packed bitmap [..., Wp//16] -> int32 levels [..., Wp]."""
+    lanes = jnp.stack([(packed >> (2 * k)) & 3 for k in range(_BMP)], axis=-1)
+    return lanes.reshape(packed.shape[:-1] + (wp,))
+
+
+# -- packed-word arithmetic --------------------------------------------------
+
+
+def _decode(words: jax.Array, lvl: jax.Array) -> jax.Array:
+    """words/lvl int32 [..., Wp] -> logical column values int32 [..., 4*Wp].
+
+    Merged cells report the SHARED counter for every logical column they
+    cover — the decoded value is an upper bound per column by
+    construction (width-bitmap round-trip pinned by tests)."""
+    b0 = jnp.stack([(words >> (8 * k)) & 0xFF for k in range(4)], axis=-1)
+    h = jnp.stack([(words >> (16 * k)) & 0xFFFF for k in range(2)], axis=-1)
+    b1 = jnp.repeat(h, 2, axis=-1)  # lanes {0,1} <- half0, {2,3} <- half1
+    b2 = jnp.broadcast_to(words[..., None], words.shape + (4,))
+    lv = lvl[..., None]
+    out = jnp.where(lv == 0, b0, jnp.where(lv == 1, b1, b2))
+    return out.reshape(out.shape[:-2] + (out.shape[-2] * 4,))
+
+
+def _land_words(words: jax.Array, lvl: jax.Array, upd: jax.Array, cap2: int):
+    """Add logical deltas ``upd`` [..., W] (>= 0) into packed words
+    [..., Wp], escalating word levels on saturation (the self-adjusting
+    merge).  Returns (words', lvl', decoded_before, decoded_after) — the
+    decoded pair is what the caller folds into the running window sum.
+    ``cap2`` bounds level-2 cells so run never overflows (_cap2)."""
+    u = upd.reshape(upd.shape[:-1] + (-1, 4))  # [..., Wp, 4]
+    dec_before = _decode(words, lvl)
+    # stored sums at each coarser granularity, from the STORED
+    # representation (an expanded decode would double-count merged cells)
+    l0 = jnp.stack([(words >> (8 * k)) & 0xFF for k in range(4)], axis=-1)
+    l1 = jnp.stack([(words >> (16 * k)) & 0xFFFF for k in range(2)], axis=-1)
+    s1 = jnp.where(
+        lvl[..., None] == 0, l0[..., 0::2] + l0[..., 1::2], l1
+    )  # [..., Wp, 2]
+    s2 = jnp.where(
+        lvl == 0, jnp.sum(l0, axis=-1), jnp.where(lvl == 1, jnp.sum(l1, axis=-1), words)
+    )
+    u1 = u[..., 0::2] + u[..., 1::2]
+    u2 = jnp.sum(u, axis=-1)
+    t0 = l0 + u  # candidate int8 lanes (meaningful only at level 0)
+    t1 = s1 + u1
+    t2 = jnp.minimum(s2 + u2, cap2)
+    fit0 = (lvl == 0) & jnp.all(t0 <= 255, axis=-1)
+    fit1 = ~fit0 & (lvl <= 1) & jnp.all(t1 <= 65535, axis=-1)
+    new_lvl = jnp.where(fit0, 0, jnp.where(fit1, 1, 2))
+    w0 = t0[..., 0] | (t0[..., 1] << 8) | (t0[..., 2] << 16) | (t0[..., 3] << 24)
+    w1 = t1[..., 0] | (t1[..., 1] << 16)
+    new_words = jnp.where(new_lvl == 0, w0, jnp.where(new_lvl == 1, w1, t2))
+    da = jnp.where(
+        new_lvl[..., None] == 0,
+        t0,
+        jnp.where(new_lvl[..., None] == 1, jnp.repeat(t1, 2, axis=-1), t2[..., None]),
+    )
+    dec_after = da.reshape(dec_before.shape)
+    return new_words, new_lvl, dec_before, dec_after
+
+
+# -- window maintenance ------------------------------------------------------
+
+
+def refresh(state: SalsaState, now_ms, cfg: SketchConfig) -> SalsaState:
+    """Rotate the current bucket column: when it still holds an expired
+    window, subtract its decoded contents from the running sums (the
+    1604.02450 subtract-expired step) and zero its words + bitmap.
+
+    Masked single-column math, no lax.cond (a cond's identity branch
+    would copy every carried buffer each tick — ops/window.refresh)."""
+    wp = _wp(cfg)
+    wid = _wid(now_ms, cfg)
+    idx = wid % cfg.sample_count
+    fresh = state.epochs[idx] == wid
+    keep = fresh.astype(jnp.int32)
+    dec = _decode(state.words[idx], unpack_levels(state.lvlmap[idx], wp))
+    return SalsaState(
+        words=state.words.at[idx].multiply(keep),
+        lvlmap=state.lvlmap.at[idx].multiply(keep),
+        run=state.run - jnp.where(fresh, 0, dec),
+        epochs=state.epochs.at[idx].set(wid),
+    )
+
+
+def sweep_expired(state: SalsaState, now_ms, cfg: SketchConfig) -> SalsaState:
+    """Eagerly purge EVERY expired bucket from the running sums (not just
+    the current rotation target).  O(nb * W) — the cost refresh avoids on
+    the hot path; callers use it after known idle gaps or in tests to
+    collapse the lazy-expiry overestimate immediately."""
+    wp = _wp(cfg)
+    wid = _wid(now_ms, cfg)
+    live = (state.epochs > wid - cfg.sample_count) & (state.epochs <= wid)
+    lvl = unpack_levels(state.lvlmap, wp)
+    dec = _decode(state.words, lvl)  # [nb, depth, P, W]
+    gone = jnp.sum(dec * jnp.where(live, 0, 1)[:, None, None, None], axis=0)
+    keep = live.astype(jnp.int32)[:, None, None, None]
+    return SalsaState(
+        words=state.words * keep,
+        lvlmap=state.lvlmap * keep,
+        run=state.run - gone,
+        epochs=state.epochs,
+    )
+
+
+# -- writes ------------------------------------------------------------------
+
+
+def add_dense(
+    state: SalsaState,
+    now_ms,
+    upd: jax.Array,  # int32 [depth, width, len(plane_idx)] logical histogram
+    plane_idx: Tuple[int, ...],
+    cfg: SketchConfig,
+    pre_refreshed: bool = False,
+) -> SalsaState:
+    """Land a precomputed logical-width histogram into the current bucket,
+    escalating saturated words and folding the decoded delta into the
+    running window sums.  ``pre_refreshed``: see ops/gsketch.add."""
+    if not pre_refreshed:
+        state = refresh(state, now_ms, cfg)
+    wp = _wp(cfg)
+    idx = _wid(now_ms, cfg) % cfg.sample_count
+    # scatter the touched planes into a full-plane update: untouched
+    # planes land zeros, which _land_words treats as an exact no-op —
+    # simpler than plane-sliced advanced indexing on the packed tensors
+    u_full = jnp.zeros((cfg.depth, PLANES, cfg.width), jnp.int32)
+    u_full = u_full.at[:, jnp.asarray(plane_idx), :].set(
+        jnp.swapaxes(upd, 1, 2).astype(jnp.int32)
+    )
+    lvl = unpack_levels(state.lvlmap[idx], wp)
+    new_words, new_lvl, dec_b, dec_a = _land_words(
+        state.words[idx], lvl, u_full, _cap2(cfg)
+    )
+    return SalsaState(
+        words=state.words.at[idx].set(new_words),
+        lvlmap=state.lvlmap.at[idx].set(pack_levels(new_lvl)),
+        run=state.run + (dec_a - dec_b),
+        epochs=state.epochs,
+    )
+
+
+def add(
+    state: SalsaState,
+    now_ms,
+    res: jax.Array,  # int32 [N] resource ids (any id space; OOB-safe)
+    values: jax.Array,  # int32 [N, len(plane_idx)]
+    plane_idx: Tuple[int, ...],
+    valid: jax.Array,  # bool [N]
+    cfg: SketchConfig,
+    max_int: int = 65535,
+    pre_refreshed: bool = False,
+) -> SalsaState:
+    """Batched event ingest: per-depth MXU one-hot histograms at LOGICAL
+    width (same contraction as ops/gsketch.add — the packed storage only
+    changes how the histogram lands, not how it is built)."""
+    if not pre_refreshed:
+        state = refresh(state, now_ms, cfg)
+    cols = cms_cell(res, cfg.depth, cfg.width)  # [N, depth]
+    plan = MX.plan_for(cfg.width, 512)
+    upds = []
+    for d in range(cfg.depth):
+        Hi, Lo = MX.onehots(cols[:, d], plan, valid=valid)
+        upds.append(
+            MX.scatter_add(
+                jnp.zeros((cfg.width, len(plane_idx)), jnp.int32),
+                plan,
+                Hi,
+                Lo,
+                values,
+                max_int=max_int,
+            )
+        )
+    upd = jnp.stack(upds, axis=0)  # [depth, width, len(plane_idx)]
+    return add_dense(state, now_ms, upd, plane_idx, cfg, pre_refreshed=True)
+
+
+# -- reads -------------------------------------------------------------------
+
+
+def estimate_plane_mxu(
+    ecfg,  # EngineConfig — tables.py dispatch
+    state: SalsaState,
+    now_ms,
+    res: jax.Array,  # int32 [N]
+    plane: int,
+    cfg: SketchConfig,
+) -> jax.Array:
+    """f32 [N]: min-over-depth windowed estimate of ONE plane, read
+    straight from the running sums — O(1) in the window shape (the seed
+    impl summed all sample_count buckets per read)."""
+    from sentinel_tpu.ops import tables as T
+
+    cols = cms_cell(res, cfg.depth, cfg.width)
+    cap = jnp.int32((1 << 24) - 1)
+    ests = []
+    for d in range(cfg.depth):
+        g = T.lane_gather_1col(
+            ecfg, jnp.minimum(state.run[d, plane], cap), cols[:, d], cfg.width
+        )
+        ests.append(g)
+    return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
+
+
+def estimate(
+    state: SalsaState, now_ms, res: jax.Array, cfg: SketchConfig
+) -> jax.Array:
+    """int32 [N, PLANES]: min-over-depth windowed estimates per resource
+    (host observability path — plain gathers from the running sums)."""
+    cols = cms_cell(res, cfg.depth, cfg.width)  # [N, depth]
+    per_depth = jnp.stack(
+        [state.run[d, :, cols[:, d]] for d in range(cfg.depth)], axis=0
+    )  # [depth, N, PLANES]
+    return jnp.min(per_depth, axis=0)
+
+
+# -- introspection -----------------------------------------------------------
+
+
+def level_histogram(state: SalsaState, cfg: SketchConfig) -> jax.Array:
+    """int32 [3]: how many counter words sit at each width level across
+    the whole sketch — the saturation/merge telemetry the hot-set manager
+    exports (``sentinel_sketch_merged_words``).  Effective width for the
+    error bound degrades with merged share: eps ~ e / (W * (n0 + n1/2 +
+    n2/4) / (n0 + n1 + n2))."""
+    lvl = unpack_levels(state.lvlmap, _wp(cfg))
+    return jnp.stack([jnp.sum(lvl == k) for k in range(3)]).astype(jnp.int32)
+
+
+def hbm_bytes(cfg: SketchConfig) -> int:
+    """Persistent HBM bytes of a SalsaState at this config (words + bitmap
+    + running sums + epochs) — the BENCH sketch_tier row's storage
+    number."""
+    wp = cfg.width // 4
+    nb, d = cfg.sample_count, cfg.depth
+    return 4 * (
+        nb * d * PLANES * wp  # words
+        + nb * d * PLANES * (wp // _BMP)  # width bitmap
+        + d * PLANES * cfg.width  # running sums
+        + nb  # epochs
+    )

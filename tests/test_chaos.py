@@ -46,6 +46,7 @@ def _import_instrumented_modules():
     import sentinel_tpu.obs.timeline  # noqa: F401
     import sentinel_tpu.parallel.remote_shard  # noqa: F401
     import sentinel_tpu.runtime.client  # noqa: F401
+    import sentinel_tpu.sketch.hotset  # noqa: F401
     import sentinel_tpu.transport.heartbeat  # noqa: F401
     import sentinel_tpu.transport.http_server  # noqa: F401
 

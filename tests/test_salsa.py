@@ -1,0 +1,317 @@
+"""sentinel_tpu.sketch.salsa — self-adjusting sketch tier correctness.
+
+Pins the tentpole invariants: packed-counter merge semantics (SALSA,
+arXiv 2102.12531), O(1) running-window sums (arXiv 1604.02450), the
+width-bitmap round trip, the fail-closed overestimate bias, and the
+no-retrace contract of the cached table plans."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sentinel_tpu.core.config import small_engine_config
+from sentinel_tpu.ops import engine as E
+from sentinel_tpu.ops import gsketch as GS
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.runtime.registry import Registry
+from sentinel_tpu.sketch import impl_for, salsa as SA
+
+
+def _cfg(depth=2, width=512, nb=2, wms=500):
+    return GS.SketchConfig(sample_count=nb, window_ms=wms, depth=depth, width=width)
+
+
+def _add_ids(s, now, ids, counts, cfg, plane=W.EV_PASS, max_int=65535):
+    vals = jnp.asarray(np.asarray(counts, np.int32)[:, None])
+    return SA.add(
+        s,
+        jnp.int32(now),
+        jnp.asarray(ids, jnp.int32),
+        vals,
+        (plane,),
+        jnp.ones((len(ids),), bool),
+        cfg,
+        max_int=max_int,
+    )
+
+
+def _est(s, now, ids, cfg, plane=W.EV_PASS):
+    return np.asarray(
+        SA.estimate(s, jnp.int32(now), jnp.asarray(ids, jnp.int32), cfg)
+    )[:, plane]
+
+
+# -- width bitmap ------------------------------------------------------------
+
+
+def test_width_bitmap_decode_roundtrip():
+    rng = np.random.default_rng(3)
+    for shape in [(64,), (2, 6, 128), (3, 2, 6, 64)]:
+        lvl = jnp.asarray(rng.integers(0, 3, size=shape), jnp.int32)
+        packed = SA.pack_levels(lvl)
+        assert packed.shape == shape[:-1] + (shape[-1] // 16,)
+        back = SA.unpack_levels(packed, shape[-1])
+        assert bool(jnp.all(back == lvl))
+
+
+def test_packed_word_decode_covers_all_levels():
+    # one word per level: lvl0 lanes [1,2,3,4]; lvl1 halves [300, 70];
+    # lvl2 total 70000 — decode must expand each at its own granularity
+    words = jnp.asarray(
+        [1 | (2 << 8) | (3 << 16) | (4 << 24), 300 | (70 << 16), 70000],
+        jnp.int32,
+    )
+    lvl = jnp.asarray([0, 1, 2], jnp.int32)
+    dec = np.asarray(SA._decode(words, lvl))
+    assert dec.tolist() == [1, 2, 3, 4, 300, 300, 70, 70, 70000, 70000, 70000, 70000]
+
+
+# -- counter saturation / merge ----------------------------------------------
+
+
+def test_counter_saturation_escalates_and_stays_exact_for_single_id():
+    cfg = _cfg(width=256)
+    s = SA.init_sketch(cfg)
+    # int8 -> int16 on the 256-boundary, int16 -> int32 past 65535 (the
+    # GS max_int envelope), values exact throughout for an isolated id
+    s = _add_ids(s, 0, [7], [200], cfg)
+    assert _est(s, 0, [7], cfg)[0] == 200
+    lv = np.asarray(SA.level_histogram(s, cfg))
+    assert lv[1] == 0 and lv[2] == 0
+    s = _add_ids(s, 0, [7], [200], cfg)  # 400 > 255: merge to int16
+    assert _est(s, 0, [7], cfg)[0] == 400
+    assert np.asarray(SA.level_histogram(s, cfg))[1] == cfg.depth
+    s = _add_ids(s, 0, [7], [65535], cfg)  # 65935 > 65535: merge to int32
+    assert _est(s, 0, [7], cfg)[0] == 65935
+    assert np.asarray(SA.level_histogram(s, cfg))[2] == cfg.depth
+
+
+def test_merge_widens_neighbors_conservatively():
+    # saturate one logical column; its word-neighbors now read the MERGED
+    # counter — an overestimate (fail-closed direction), never an
+    # underestimate for anyone
+    cfg = _cfg(depth=1, width=256)
+    s = SA.init_sketch(cfg)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 10_000, size=64)
+    s = _add_ids(s, 0, ids, np.full(64, 3), cfg)
+    exact = {int(i): 0 for i in ids}
+    for i in ids:
+        exact[int(i)] += 3
+    s = _add_ids(s, 0, [777], [1000], cfg)  # escalates its word
+    exact[777] = exact.get(777, 0) + 1000
+    qs = sorted(exact)
+    est = _est(s, 0, qs, cfg)
+    for q, e in zip(qs, est):
+        assert e >= exact[q], (q, e, exact[q])
+
+
+# -- O(1) windowed reads -----------------------------------------------------
+
+
+def test_running_sums_match_seed_cms_without_saturation():
+    """Below every saturation threshold the salsa estimate must equal the
+    seed CMS bit-for-bit (same hashes, same window) — the O(1) running
+    sums replace the per-read bucket sum, not the semantics."""
+    cfg = _cfg(depth=2, width=512, nb=4, wms=250)
+    sa = SA.init_sketch(cfg)
+    gs = GS.init_sketch(cfg)
+    rng = np.random.default_rng(11)
+    for t in [0, 260, 510, 760, 1010, 1260]:  # slides across the window
+        ids = rng.integers(100, 5_000, size=128)
+        cnt = rng.integers(1, 4, size=128)
+        vals = jnp.asarray(cnt[:, None].astype(np.int32))
+        args = (
+            jnp.int32(t),
+            jnp.asarray(ids, jnp.int32),
+            vals,
+            (W.EV_PASS,),
+            jnp.ones((128,), bool),
+            cfg,
+        )
+        sa = SA.add(sa, *args)
+        gs = GS.add(gs, *args)
+        q = jnp.asarray(np.unique(ids), jnp.int32)
+        ea = np.asarray(SA.estimate(sa, jnp.int32(t), q, cfg))
+        eg = np.asarray(GS.estimate(gs, jnp.int32(t), q, cfg))
+        np.testing.assert_array_equal(ea, eg)
+
+
+def test_epoch_rollover_across_idle_gap():
+    """Idle gaps > interval_ms: lazily-expired buckets may overestimate
+    (documented fail-closed transient), sweep_expired collapses it, and
+    after one full rotation the estimate is exact again."""
+    cfg = _cfg(depth=2, width=256, nb=2, wms=500)
+    s = SA.init_sketch(cfg)
+    s = _add_ids(s, 0, [42], [5], cfg)
+    s = _add_ids(s, 600, [42], [7], cfg)
+    assert _est(s, 600, [42], cfg)[0] == 12
+    # idle 10 s (>> interval 1 s): nothing rotated the old buckets out
+    t = 10_600
+    est_lazy = _est(s, t, [42], cfg)[0]
+    assert est_lazy >= 0  # stale overestimate allowed, never negative
+    assert est_lazy <= 12  # bounded by one pre-gap window volume
+    swept = SA.sweep_expired(s, jnp.int32(t), cfg)
+    assert _est(swept, t, [42], cfg)[0] == 0
+    # organic path: adds after the gap rotate the grid clean within one
+    # interval — estimate is exactly the fresh traffic
+    s = _add_ids(s, t, [42], [3], cfg)
+    s = _add_ids(s, t + 500, [42], [4], cfg)
+    assert _est(s, t + 500, [42], cfg)[0] == 7
+    # epochs really rolled: another gap, then a single fresh bucket
+    s = _add_ids(s, t + 5_000, [42], [9], cfg)
+    s = _add_ids(s, t + 5_500, [42], [0], cfg)
+    assert _est(s, t + 5_500, [42], cfg)[0] == 9
+
+
+def test_estimate_never_underestimates_across_rotation():
+    """Fail-closed bias: at every point of a windowed stream, the salsa
+    estimate >= the true in-window count (CMS collision + merge + lazy
+    expiry all err upward) — tail blocks fire early, never late."""
+    cfg = _cfg(depth=2, width=256, nb=3, wms=400)
+    s = SA.init_sketch(cfg)
+    rng = np.random.default_rng(23)
+    events = []  # (t, id, count)
+    t = 0
+    for step in range(12):
+        ids = rng.integers(0, 2_000, size=64)
+        cnt = rng.integers(1, 5, size=64)
+        s = _add_ids(s, t, ids, cnt, cfg)
+        events += [(t, int(i), int(c)) for i, c in zip(ids, cnt)]
+        lo = t - (cfg.interval_ms - cfg.window_ms)  # conservative window
+        true = {}
+        for et, ei, ec in events:
+            if et >= lo:
+                true[ei] = true.get(ei, 0) + ec
+        qs = sorted(true)
+        est = _est(s, t, qs, cfg)
+        for q, e in zip(qs, est):
+            assert e >= true[q], (step, q, e, true[q])
+        t += int(rng.integers(100, 700))
+
+
+# -- error bound on a seeded Zipf stream -------------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_estimate_error_bound_on_zipf(depth):
+    """Classic CMS guarantee on the salsa tier: per query,
+    P(err > e/width * V) <= e^-depth.  Seeded stream -> deterministic;
+    assert the observed violation rate at each depth plus the absolute
+    overestimate invariant."""
+    width = 1024
+    cfg = _cfg(depth=depth, width=width, nb=2, wms=500)
+    s = SA.init_sketch(cfg)
+    rng = np.random.default_rng(7)
+    ids = (rng.zipf(1.2, size=4096).astype(np.int64) - 1) % 50_000 + 1_000_000
+    exact = {}
+    for lo in range(0, len(ids), 512):
+        chunk = ids[lo : lo + 512]
+        s = _add_ids(s, 100, chunk, np.ones(len(chunk)), cfg)
+        for i in chunk:
+            exact[int(i)] = exact.get(int(i), 0) + 1
+    V = float(len(ids))
+    qs = sorted(exact)
+    est = _est(s, 100, qs, cfg)
+    errs = np.asarray([e - exact[q] for q, e in zip(qs, est)], np.float64)
+    assert (errs >= 0).all()  # overestimate only
+    bound = math.e / width * V
+    viol = float((errs > bound).mean())
+    assert viol <= math.exp(-depth) * 1.2 + 1e-9, (depth, viol, bound)
+    # and the typical error is far inside the bound on real (Zipf) traffic
+    assert float(errs.mean()) <= bound
+
+
+# -- HBM accounting ----------------------------------------------------------
+
+
+def test_salsa_hbm_stretch_vs_seed_cms():
+    """At minute windows the packed tier stores ~4x less per bucket than
+    the int32 seed; the BENCH sketch_tier row reports hbm_bytes."""
+    cfg = _cfg(depth=2, width=1 << 14, nb=60, wms=1000)
+    salsa_b = SA.hbm_bytes(cfg)
+    seed_b = 4 * (cfg.sample_count * cfg.depth * cfg.width * GS.PLANES)
+    assert salsa_b < seed_b / 3  # bitmap + running sums cost < 1/4 extra
+    st = SA.init_sketch(cfg)
+    live = sum(int(np.asarray(x).nbytes) for x in st)
+    assert live == salsa_b
+
+
+# -- cached plans / no-retrace (tick identity) -------------------------------
+
+
+def test_plan_cache_returns_shared_instance():
+    from sentinel_tpu.ops import mxu_table as MX
+
+    a = MX.plan_for(1 << 14, 512)
+    b = MX.plan_for(1 << 14, 512)
+    assert a is b
+    assert a == MX.make_plan(1 << 14, 512)
+
+
+@pytest.mark.parametrize("salsa", [False, True])
+def test_sketch_tick_identity_no_retrace(salsa):
+    """The sketch-enabled tick compiles ONCE: repeated calls with fresh
+    now_ms values (and the per-call plan lookups inside gsketch/salsa
+    add) must hit the same executable — the hoisted plan cache keeps the
+    traced constants identical."""
+    cfg = small_engine_config(
+        max_resources=16, max_nodes=32, sketch_stats=True, sketch_width=256,
+        sketch_salsa=salsa,
+    )
+    fn = E.make_tick(cfg, donate=False)
+    state = E.init_state(cfg)
+    rules = E.compile_ruleset(cfg, Registry(cfg))
+    acq = E.empty_acquire(cfg)._replace(
+        res=jnp.full((cfg.batch_size,), cfg.node_rows + 5, jnp.int32),
+        count=jnp.ones((cfg.batch_size,), jnp.int32),
+    )
+    comp = E.empty_complete(cfg)
+    z = jnp.float32(0.0)
+    state, _ = fn(state, rules, acq, comp, jnp.int32(1_000), z, z)
+    assert fn._cache_size() == 1
+    for t in (1_500, 2_100, 60_000):
+        state, _ = fn(state, rules, acq, comp, jnp.int32(t), z, z)
+    assert fn._cache_size() == 1  # no retrace across ticks
+
+
+# -- pre-refreshed handle ----------------------------------------------------
+
+
+@pytest.mark.parametrize("impl_name", ["gsketch", "salsa"])
+def test_pre_refreshed_second_write_is_equivalent(impl_name):
+    """The tick's second sketch write of a tick (acquire side) skips
+    refresh; landing the same events with and without the skip must be
+    bit-identical whenever a first write already stamped the bucket."""
+    impl = GS if impl_name == "gsketch" else SA
+    cfg = _cfg(depth=2, width=256)
+    ids = jnp.asarray([9, 9, 1234], jnp.int32)
+    vals = jnp.asarray([[2], [3], [4]], jnp.int32)
+    ok = jnp.ones((3,), bool)
+
+    def both(pre):
+        s = impl.init_sketch(cfg)
+        # completion-side write stamps the bucket...
+        s = impl.add(s, jnp.int32(700), ids, vals, (W.EV_SUCCESS,), ok, cfg)
+        # ...acquire-side write may then skip the refresh copy
+        return impl.add(
+            s, jnp.int32(700), ids, vals, (W.EV_PASS,), ok, cfg,
+            pre_refreshed=pre,
+        )
+
+    a, b = both(False), both(True)
+    for la, lb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_engine_dispatch_selects_impl():
+    cfg_s = small_engine_config(sketch_stats=True)
+    cfg_g = small_engine_config(sketch_stats=True, sketch_salsa=False)
+    assert impl_for(cfg_s) is SA
+    assert impl_for(cfg_g) is GS
+    st = E.init_state(cfg_s)
+    assert isinstance(st.gs, SA.SalsaState)
+    assert isinstance(E.init_state(cfg_g).gs, GS.SketchState)
