@@ -540,6 +540,13 @@ def client_bench(B: int, n_blocks: int = 32, depth: int = 4) -> dict:
         + 5 * 4 * B
     ) / 1e6
     down_mb = B / 1e6  # int8 verdicts (wait skipped: no PASS_WAIT here)
+    if c.cfg.packed_wire:
+        # packed transport: the MEASURED bytes are the model — narrow
+        # dirty-column uploads, one fused wire readback (ops/wire.py)
+        up_mb = wire_bytes["device_tx"] / max(n_blocks, 1) / 1e6
+        down_mb = (
+            wire_bytes["device_rx"] + timeline_bytes
+        ) / max(n_blocks, 1) / 1e6
 
     verd = np.concatenate(results[-3:])
     lat_ms = np.sort(np.array(lat[inflight:] or lat)) * 1000.0
@@ -916,7 +923,32 @@ DEFAULT_TOLERANCES = {
     # mean salsa overestimate as % of stream volume on a seeded Zipf
     # stream — must stay inside the CMS bound e/width (≈0.27% at 1024)
     "sketch_estimate_err_pct": {"max_abs": 100.0 * math.e / 1024},
+    # packed-wire transport (PR 12): steady-state bytes/tick over EVERY
+    # wire path.  rx ceiling = the ONE fused readback (header + verdict
+    # bitmap + wait sidecar + stats row + timeline top-K at B=1024,
+    # ~5.1 KiB) + slack; a second readback creeping into the resolve
+    # phase blows through it.  tx ceiling: identical columns are skipped
+    # entirely (dirty tracking), so steady-state uploads are ~0 — any
+    # full-column re-upload (~4 KiB/column at B=1024 int32) trips it.
+    "wire_bytes_per_tick_rx": {"max_abs": 6656.0},
+    "wire_bytes_per_tick_tx": {"max_abs": 2048.0},
 }
+
+
+def _wire_totals() -> dict:
+    """Sum of sentinel_wire_bytes_total across every path label, per
+    direction — the choke-point accounting the client/wire layer feeds."""
+    from sentinel_tpu import obs
+
+    tot = {"tx": 0.0, "rx": 0.0}
+    for path_l in ("device", "cluster", "timeline"):
+        for d in ("tx", "rx"):
+            m = obs.REGISTRY.get(
+                "sentinel_wire_bytes_total", {"path": path_l, "direction": d}
+            )
+            if m is not None:
+                tot[d] += float(m.value)
+    return tot
 
 
 def _best_of(fn, repeats: int = 3) -> float:
@@ -1030,7 +1062,25 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             return 8 * len(res) / (time.perf_counter() - t0)
 
         client_dps = _best_of(once)
-        host_build_ms = c.host_build_ms_avg
+
+        # steady-state wire bytes/tick (sentinel_wire_bytes_total deltas,
+        # all paths): rx is THE single fused readback; tx is the dirty-
+        # column residual — repeat traffic uploads nothing.  host_build_ms
+        # is averaged over the SAME window: the client's lifetime average
+        # folds in the first tick's one-time staging/transfer setup
+        # (~100ms), which is not the serving-path cost being sentried.
+        w0 = _wire_totals()
+        b_sum0, b_n0 = c._build_ms_sum, c._build_ticks
+        n_wt = 8
+        for _ in range(n_wt):
+            c.submit_block(res)
+            c.tick_once()
+        w1 = _wire_totals()
+        wire_rx = (w1["rx"] - w0["rx"]) / n_wt
+        wire_tx = (w1["tx"] - w0["tx"]) / n_wt
+        host_build_ms = (c._build_ms_sum - b_sum0) / max(
+            c._build_ticks - b_n0, 1
+        )
     finally:
         c.stop()
 
@@ -1047,6 +1097,8 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             "host_build_ms": round(host_build_ms, 3),
             "sketch_overhead_pct": round(sk_overhead_pct, 2),
             "sketch_estimate_err_pct": sk_err_pct,
+            "wire_bytes_per_tick_rx": round(wire_rx),
+            "wire_bytes_per_tick_tx": round(wire_tx),
         },
         "batch": B,
         "platform": jax.devices()[0].platform,
@@ -1087,6 +1139,105 @@ def _sketch_estimate_err_pct(width: int = 1024, volume: int = 4096) -> float:
     )[:, W.EV_PASS]
     errs = np.asarray([e - exact[q] for q, e in zip(qs, est)], np.float64)
     return round(float(errs.mean()) / volume * 100.0, 4)
+
+
+def wire_compare_bench(B: int = 4096, n_blocks: int = 48) -> dict:
+    """BENCH_r12 before/after: the identical smoke-scale client workload
+    on the CLASSIC transport (packed_wire=False — full int32 column
+    uploads every tick, separate verdict/stats/timeline readbacks) vs the
+    PACKED transport (the default — narrow dirty-column delta uploads,
+    ONE fused readback), with the span tracer's per-stage breakdown for
+    each.  Two workloads per transport:
+
+    - ``steady``: the same block (acquire + completion) every tick — the
+      smoke sentry's shape, where the dirty-column skip eliminates the
+      upload entirely and the wire carries only the fused readback;
+    - ``churn``: blocks repeat twice then change (A,A,B,B,C,C,...) — half
+      the ticks re-upload their changed columns, the repeats skip.
+    """
+    from sentinel_tpu import obs
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    rng = np.random.default_rng(3)
+    rows = {}
+    for label, packed in (("classic", False), ("packed", True)):
+        c = SentinelClient(
+            cfg=small_engine_config(
+                batch_size=B, complete_batch_size=B, packed_wire=packed
+            ),
+            mode="sync",
+        )
+        c.start()
+        try:
+            names = [f"wc-{i}" for i in range(32)]
+            ids = np.asarray(
+                [c.registry.resource_id(n) for n in names], np.int32
+            )
+            c.flow_rules.load([FlowRule(resource=n, count=1e9) for n in names])
+            traffic = [
+                ids[rng.integers(0, len(ids), B)].astype(np.int32)
+                for _ in range(3)
+            ]
+            rts = [
+                np.abs(rng.normal(3.0, 1.0, B)).astype(np.float32)
+                for _ in range(3)
+            ]
+            # warm both shapes off the clock
+            c.submit_block(traffic[0])
+            c.submit_completion_block(traffic[0], rts[0])
+            c.tick_once()
+            obs.TRACER.reset()
+            obs.enable()
+            row = {"packed_wire": packed, "batch": B, "blocks": n_blocks}
+            for phase, pick in (
+                ("steady", lambda t: 0),
+                ("churn", lambda t: (t // 2) % 3),
+            ):
+                w0 = _wire_totals()
+                t0 = time.perf_counter()
+                for t in range(n_blocks):
+                    k = pick(t)
+                    f = c.submit_block(traffic[k])
+                    c.submit_completion_block(traffic[k], rts[k])
+                    c.tick_once()
+                    assert f is None or f.done()
+                wall = time.perf_counter() - t0
+                w1 = _wire_totals()
+                row[phase] = {
+                    "dps": round(n_blocks * B / wall),
+                    "wire_bytes_per_tick_tx": round(
+                        (w1["tx"] - w0["tx"]) / n_blocks
+                    ),
+                    "wire_bytes_per_tick_rx": round(
+                        (w1["rx"] - w0["rx"]) / n_blocks
+                    ),
+                }
+            obs.disable()
+            row["host_build_ms_avg"] = round(c.host_build_ms_avg, 3)
+            row["stage_breakdown_ms"] = obs.summarize(
+                obs.TRACER.snapshot(), prefix="tick."
+            )
+            rows[label] = row
+        finally:
+            c.stop()
+
+    def _wire(r, phase):
+        return (
+            r[phase]["wire_bytes_per_tick_tx"]
+            + r[phase]["wire_bytes_per_tick_rx"]
+        )
+
+    cl, pk = rows["classic"], rows["packed"]
+    for phase in ("steady", "churn"):
+        rows[f"wire_bytes_ratio_classic_over_packed_{phase}"] = round(
+            _wire(cl, phase) / max(_wire(pk, phase), 1), 2
+        )
+        rows[f"dps_ratio_packed_over_classic_{phase}"] = round(
+            pk[phase]["dps"] / max(cl[phase]["dps"], 1), 3
+        )
+    return rows
 
 
 def compare_to_baseline(measured: dict, baseline: dict) -> list:
@@ -1321,7 +1472,11 @@ if __name__ == "__main__":
         # compared against PERF_BASELINE.json (exit 1 on regression);
         # --update-baseline re-pins after an intentional perf change
         sys.exit(_smoke_main("--update-baseline" in sys.argv))
-    if "--sketch-tier" in sys.argv:
+    if "--wire-compare" in sys.argv:
+        # the packed-wire before/after row alone (CPU-reproducible —
+        # how BENCH_r12 captured the transport collapse)
+        print(json.dumps({"wire_compare": wire_compare_bench()}))
+    elif "--sketch-tier" in sys.argv:
         # the 1 M-ruled-resource sketch-tier row alone (plain path —
         # CPU-reproducible; how BENCH_r10 captured it)
         print(json.dumps({"sketch_tier": sketch_tier_bench()}))

@@ -34,6 +34,7 @@ _ENTRY_MODULES = {
     "tick/plain": "sentinel_tpu/ops/engine.py",
     "tick/mxu": "sentinel_tpu/ops/engine.py",
     "tick/fused-seg": "sentinel_tpu/ops/engine.py",
+    "tick/packed-wire": "sentinel_tpu/ops/engine.py",
     "tick/sketch-salsa": "sentinel_tpu/sketch/salsa.py",
     "tick/cluster-token": "sentinel_tpu/cluster/token_service.py",
     "segscan/excl-cumsum": "sentinel_tpu/ops/segscan.py",
@@ -213,7 +214,22 @@ def _build_entries() -> List[TracedEntry]:
         if args is None:
             args = tick_args_by_cfg[cfg] = _mk_tick_inputs(cfg)
         fn = functools.partial(E.tick, cfg=cfg, features=features)
-        return _trace(name, fn, args, time_arg=time_arg, cost=cost)
+        ent = _trace(name, fn, args, time_arg=time_arg, cost=cost)
+        if cfg.packed_wire:
+            # observe (not re-derive) the packed tick's readback surface:
+            # the TickOutput fields the pack step left live.  The
+            # transfer-guard pass pins this set to the fused wire buffer
+            # plus the sidecar-overflow escape hatch.
+            import jax
+
+            out_struct = jax.eval_shape(fn, *args)[1]
+            ent.packed_wire = True
+            ent.readback_fields = tuple(
+                f
+                for f in out_struct._fields
+                if getattr(out_struct, f) is not None
+            )
+        return ent
 
     cfg_plain = small_engine_config()
     cfg_mxu = small_engine_config(use_mxu_tables=True)
@@ -231,6 +247,18 @@ def _build_entries() -> List[TracedEntry]:
     entries.append(
         tick_entry("tick/fused-seg", cfg_seg, E.ALL_FEATURES, cost=False)
     )
+    # the packed-wire transport: every readback block (verdict bitmap,
+    # wait sidecar, telemetry row, timeline top-K, hot-set) folded into
+    # ONE fused uint32 buffer on-device (ops/wire.py) — all blocks
+    # enabled so the trace pins the full wire layout
+    cfg_packed = small_engine_config(
+        packed_wire=True,
+        sketch_stats=True,
+        sketch_width=256,
+        hotset_k=8,
+        timeline_k=8,
+    )
+    entries.append(tick_entry("tick/packed-wire", cfg_packed, E.ALL_FEATURES))
     # the cluster token-decision engine: same tick, the feature set the
     # DefaultTokenService's dedicated decision client needs
     entries.append(tick_entry("tick/cluster-token", cfg_plain, DECISION_FEATURES))

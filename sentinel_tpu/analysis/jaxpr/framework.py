@@ -55,6 +55,15 @@ class TracedEntry:
     #: computation; None when exempt OR when this jaxlib exposes no cost
     #: model (the budget pass reports eligible-but-unmeasured entries)
     cost: Optional[Dict[str, float]] = None
+    #: True when the entry's config runs the packed-wire transport
+    #: (cfg.packed_wire) — the transfer-guard pass then pins the tick's
+    #: readback surface to the single fused wire transfer
+    packed_wire: bool = False
+    #: TickOutput field names that are LIVE outputs of the traced tick
+    #: (fields the pack step None'd out are absent) — observed from the
+    #: program via eval_shape, not re-derived from config.  Populated
+    #: only for packed-wire tick entries; None elsewhere.
+    readback_fields: Optional[Tuple[str, ...]] = None
 
     @property
     def pseudo_path(self) -> str:

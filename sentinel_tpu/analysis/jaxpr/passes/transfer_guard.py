@@ -14,6 +14,17 @@ Flagged primitives: the callback family (`pure_callback`, `io_callback`,
 `debug_callback`, anything containing "callback"), `infeed`/`outfeed`,
 and `device_put` (a placement op inside a traced program — the operand
 should have been an input or a trace-time constant).
+
+Packed-wire readback surface: under ``cfg.packed_wire`` the resolve
+phase performs ONE fused device→host transfer (the wire buffer), so the
+traced tick must not leave any OTHER TickOutput array live — a stats or
+verdict leaf that survives packing re-opens a per-array sync in
+`_resolve_tick` and silently un-fuses the transport.  Entrypoints
+records the live output fields (observed via eval_shape, not re-derived
+from config); this pass flags any field outside the allowance: the wire
+buffer itself, ``wait_ms`` (the sidecar-overflow escape hatch, read only
+on the rare tick whose PASS_WAIT rows overflow the fixed sidecar), and
+``seg_dropped`` (a plain-int trace constant, never read back packed).
 """
 
 from __future__ import annotations
@@ -30,6 +41,10 @@ from sentinel_tpu.analysis.jaxpr.framework import (
 
 _EXACT = frozenset({"infeed", "outfeed", "device_put", "copy_to_host_async"})
 
+#: the ONLY TickOutput fields a packed-wire tick may leave live (see
+#: module docstring for why each is allowed)
+_PACKED_READBACK_OK = frozenset({"wire", "wait_ms", "seg_dropped"})
+
 
 def _repo_root() -> str:
     from sentinel_tpu.analysis import REPO_ROOT
@@ -44,6 +59,24 @@ class TransferGuardPass(JaxprPass):
 
     def run(self, entry: TracedEntry) -> Iterable[Finding]:
         root = _repo_root()
+        if entry.packed_wire and entry.readback_fields is not None:
+            fields = set(entry.readback_fields)
+            if "wire" not in fields:
+                yield self.finding(
+                    entry,
+                    "packed-wire tick emits no fused 'wire' buffer — the "
+                    "resolve phase would fall back to per-array readbacks",
+                )
+            for f in sorted(fields - _PACKED_READBACK_OK):
+                yield self.finding(
+                    entry,
+                    f"TickOutput field '{f}' is still a live output of the "
+                    "packed-wire tick — packed mode must fold every "
+                    "readback into the single fused wire transfer "
+                    "(ops/wire.pack_tick_output); an extra output array "
+                    "re-opens a per-array device->host sync in "
+                    "_resolve_tick",
+                )
         for eqn in walk_eqns(entry.closed_jaxpr):
             pname = eqn.primitive.name
             if "callback" in pname or pname in _EXACT:

@@ -417,9 +417,13 @@ def _scn_cluster_partition(seed: int) -> ScenarioResult:
 
 
 def _scn_resolver_exception(seed: int) -> ScenarioResult:
-    """Verdict readback raises inside the resolve path: the affected
-    ticks must fail CLOSED (system block) with no stranded futures and
-    no hung pipeline — the _fail_tick contract."""
+    """Verdict readback raises inside the resolve path — and, on other
+    ticks, the fused packed-wire readback comes back CORRUPTED: both
+    failure shapes must fail the affected ticks CLOSED (system block)
+    with no stranded futures and no hung pipeline — the _fail_tick
+    contract.  The corrupt ticks additionally must be DETECTED by the
+    wire checksum (sentinel_packed_decode_failures_total), never fanned
+    out as garbage verdicts."""
     from sentinel_tpu.core import errors as ERR
 
     t0 = mono_s()
@@ -434,6 +438,12 @@ def _scn_resolver_exception(seed: int) -> ScenarioResult:
     metrics = MetricsDelta()
     session = _Session()
     n, nth, fires = 12, 3, 3
+    # the packed decoder's hit counter advances only on ticks the raise
+    # fault lets reach it (the raise fires FIRST in _resolve_tick_inner):
+    # raise hits ticks 3/6/9, so decode sees ticks 1,2,4,5,7,8,10,11,12
+    # and every_nth=4 corrupts decode-hits 4 and 8 — ticks 5 and 11.
+    # Seed-pure: both schedules are counter-driven, not probabilistic.
+    corrupt_fires = 2
     plan = FaultPlan(
         name="resolver_exception",
         seed=seed,
@@ -441,7 +451,11 @@ def _scn_resolver_exception(seed: int) -> ScenarioResult:
             FaultSpec(
                 "runtime.resolve.readback", "raise",
                 every_nth=nth, max_fires=fires, exc="RuntimeError",
-            )
+            ),
+            FaultSpec(
+                "transport.packed.decode", "corrupt",
+                every_nth=4, max_fires=corrupt_fires,
+            ),
         ],
     )
     futures = []
@@ -462,10 +476,17 @@ def _scn_resolver_exception(seed: int) -> ScenarioResult:
         blocked=blocked,
         futures=futures,
         injected=session.injected,
-        expect_injected={"runtime.resolve.readback:raise": fires},
+        expect_injected={
+            "runtime.resolve.readback:raise": fires,
+            "transport.packed.decode:corrupt": corrupt_fires,
+        },
         extra={
             "expect_metric_deltas": {
-                "sentinel_resolve_failures_total": fires,
+                # every raise AND every detected corruption fails its tick
+                # closed through the same _resolve_tick handler...
+                "sentinel_resolve_failures_total": fires + corrupt_fires,
+                # ...but only the corruptions are wire-checksum rejections
+                "sentinel_packed_decode_failures_total": corrupt_fires,
             },
         },
     )
@@ -479,12 +500,13 @@ def _scn_resolver_exception(seed: int) -> ScenarioResult:
         ],
         ctx,
     )
-    if blocked != fires:
+    if blocked != fires + corrupt_fires:
         verdicts.append(
             Verdict(
                 "fail-closed-count",
                 False,
-                f"blocked={blocked}, expected exactly the {fires} injected ticks",
+                f"blocked={blocked}, expected exactly the "
+                f"{fires + corrupt_fires} injected ticks",
             )
         )
     return _result("resolver_exception", seed, session, verdicts, t0)
@@ -1301,7 +1323,8 @@ SCENARIOS: Dict[str, Scenario] = {
         Scenario(
             "resolver_exception",
             _scn_resolver_exception,
-            "verdict readback raises; ticks fail closed, nothing strands",
+            "readback raises + fused-wire corruption; ticks fail closed, "
+            "nothing strands",
         ),
         Scenario(
             "seg_overflow_storm",

@@ -254,6 +254,18 @@ class EngineConfig:
     # traced program is unchanged vs. timeline off).  K*32 bytes of extra
     # readback per tick (4 KiB at the default 128).
     timeline_k: int = 128
+    # packed wire format (ops/wire.py): the tick returns ONE flat uint32
+    # buffer — 3-bit-packed verdict bitmap + sparse PASS_WAIT sidecar +
+    # bitcast telemetry/timeline/hot blocks behind a checksummed header —
+    # instead of four separate device arrays, and the batch's low-range
+    # columns (prio/inbound/pre_verdict, clamped counts) travel at int8/
+    # int16 and widen on-device.  Tri-state: None resolves to False here
+    # (direct tick() callers and the traced legacy entries keep the
+    # classic TickOutput) and to True in SentinelClient (the client path
+    # is where the wire is the bottleneck).  TickOutput.wait_ms survives
+    # as the sidecar-overflow escape hatch; everything else rides the
+    # fused buffer.
+    packed_wire: Optional[bool] = None
 
     def __post_init__(self):
         # the native completion ring transports exactly four hot-param

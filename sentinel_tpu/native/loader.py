@@ -136,6 +136,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sx_front_respond.argtypes = [p, i64] + [p] * 3
     lib.sx_front_respond_ex.restype = i32
     lib.sx_front_respond_ex.argtypes = [p, i64] + [p] * 5
+    # batch-build presort (stable multi-key argsort + inverse permutation)
+    lib.sx_batch_sort5.restype = i64
+    lib.sx_batch_sort5.argtypes = [i64] + [p] * 7
+    lib.sx_batch_sort3.restype = i64
+    lib.sx_batch_sort3.argtypes = [i64] + [p] * 5
     return lib
 
 

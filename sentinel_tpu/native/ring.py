@@ -170,3 +170,52 @@ class NativeInterner:
         if lib is not None and getattr(self, "_tbl", None):
             lib.sx_intern_free(self._tbl)
             self._tbl = None
+
+
+def _as_i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def batch_sort5(k0, k1, k2, k3, k4, want_inv: bool = True):
+    """Stable argsort by (k0, k1, k2, k3, k4), k0 most significant.
+
+    Equivalent to ``np.lexsort((k4, k3, k2, k1, k0))`` — both the native
+    and the fallback path are stable sorts, so tie order is identical.
+    Returns ``(order, inv)`` int32 arrays (``inv`` None when not wanted);
+    ``inv[order] == arange(n)``.
+    """
+    k0, k1, k2, k3, k4 = map(_as_i32, (k0, k1, k2, k3, k4))
+    n = k0.shape[0]
+    lib = load_native()
+    if lib is not None:
+        order = np.empty(n, np.int32)
+        inv = np.empty(n, np.int32) if want_inv else None
+        cp = lambda a: a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+        lib.sx_batch_sort5(n, cp(k0), cp(k1), cp(k2), cp(k3), cp(k4),
+                           cp(order), cp(inv))
+        return order, inv
+    order = np.lexsort((k4, k3, k2, k1, k0)).astype(np.int32)
+    inv = None
+    if want_inv:
+        inv = np.empty(n, np.int32)
+        inv[order] = np.arange(n, dtype=np.int32)
+    return order, inv
+
+
+def batch_sort3(k0, k1, k2, want_inv: bool = False):
+    """Stable argsort by (k0, k1, k2); see :func:`batch_sort5`."""
+    k0, k1, k2 = map(_as_i32, (k0, k1, k2))
+    n = k0.shape[0]
+    lib = load_native()
+    if lib is not None:
+        order = np.empty(n, np.int32)
+        inv = np.empty(n, np.int32) if want_inv else None
+        cp = lambda a: a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+        lib.sx_batch_sort3(n, cp(k0), cp(k1), cp(k2), cp(order), cp(inv))
+        return order, inv
+    order = np.lexsort((k2, k1, k0)).astype(np.int32)
+    inv = None
+    if want_inv:
+        inv = np.empty(n, np.int32)
+        inv[order] = np.arange(n, dtype=np.int32)
+    return order, inv

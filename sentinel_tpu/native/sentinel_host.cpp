@@ -269,6 +269,7 @@ int32_t sx_intern_count(sx_intern* t, int32_t first_id) {
 #include <unistd.h>
 #include <fcntl.h>
 #include <time.h>
+#include <algorithm>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -891,6 +892,51 @@ int32_t sx_front_respond_ex(sx_front* f, int64_t n, const int32_t* corr,
             ++dropped;
     }
     return dropped;
+}
+
+// ---------------------------------------------------------------------------
+// batch build: the tick builder's segment-key presort
+// ---------------------------------------------------------------------------
+//
+// The client presorts every engine batch by the segment keys before
+// upload (runtime/client._run_tick).  np.lexsort is the numpy fallback;
+// these produce the IDENTICAL stable permutation (std::stable_sort with
+// lexicographic key compare == np.lexsort with the keys reversed) plus
+// the inverse permutation in the same pass, without numpy's per-key
+// temporary allocations.  Keys are int32 columns of equal length n;
+// `order` receives the argsort, `inv` (nullable) the inverse.
+
+static void sx_inverse(int64_t n, const int32_t* order, int32_t* inv) {
+    for (int64_t i = 0; i < n; ++i) inv[order[i]] = (int32_t)i;
+}
+
+// acquire side: np.lexsort((k4, k3, k2, k1, k0)) — k0 most significant
+int64_t sx_batch_sort5(int64_t n, const int32_t* k0, const int32_t* k1,
+                       const int32_t* k2, const int32_t* k3,
+                       const int32_t* k4, int32_t* order, int32_t* inv) {
+    for (int64_t i = 0; i < n; ++i) order[i] = (int32_t)i;
+    std::stable_sort(order, order + n, [&](int32_t a, int32_t b) {
+        if (k0[a] != k0[b]) return k0[a] < k0[b];
+        if (k1[a] != k1[b]) return k1[a] < k1[b];
+        if (k2[a] != k2[b]) return k2[a] < k2[b];
+        if (k3[a] != k3[b]) return k3[a] < k3[b];
+        return k4[a] < k4[b];
+    });
+    if (inv) sx_inverse(n, order, inv);
+    return n;
+}
+
+// completion side: np.lexsort((k2, k1, k0))
+int64_t sx_batch_sort3(int64_t n, const int32_t* k0, const int32_t* k1,
+                       const int32_t* k2, int32_t* order, int32_t* inv) {
+    for (int64_t i = 0; i < n; ++i) order[i] = (int32_t)i;
+    std::stable_sort(order, order + n, [&](int32_t a, int32_t b) {
+        if (k0[a] != k0[b]) return k0[a] < k0[b];
+        if (k1[a] != k1[b]) return k1[a] < k1[b];
+        return k2[a] < k2[b];
+    });
+    if (inv) sx_inverse(n, order, inv);
+    return n;
 }
 
 }  // extern "C"

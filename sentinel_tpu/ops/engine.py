@@ -31,6 +31,8 @@ import functools
 import threading
 from typing import NamedTuple, Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -67,6 +69,7 @@ from sentinel_tpu.ops import param as P
 from sentinel_tpu.ops import rowmin as RM
 from sentinel_tpu.ops import tables as T
 from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops import wire as WIRE
 from sentinel_tpu.ops.rank import (
     fast_cumsum,
     grouped_exclusive_cumsum,
@@ -199,6 +202,13 @@ class TickOutput(NamedTuple):
     # (node_rows + sketch_capacity < 2^24).  None when off (traced
     # program unchanged).
     hot: object = None
+    # packed wire buffer (cfg.packed_wire, ops/wire.py): ONE flat uint32
+    # array carrying the verdict bitmap, PASS_WAIT sidecar, seg_dropped,
+    # and the bitcast stats/res_stats/hot blocks behind a checksummed
+    # header — the client's single fused readback.  When set, verdict/
+    # stats/res_stats/hot are None (they ride the buffer) and wait_ms
+    # stays as the sidecar-overflow escape hatch.
+    wire: object = None
 
 
 # -- device-resident telemetry (TickOutput.stats) ---------------------------
@@ -468,6 +478,35 @@ def _device_hot_candidates(cfg: EngineConfig, state: EngineState, acq, valid, no
     return jnp.stack([acq.res[i].astype(jnp.float32), v], axis=1)
 
 
+def _tick_output(
+    cfg: EngineConfig, verdict, wait_ms, seg_dropped, stats, res_stats, hot
+) -> TickOutput:
+    """Assemble the TickOutput — classic multi-array form, or (under
+    cfg.packed_wire) everything packed into the single fused wire buffer
+    (ops/wire.py).  Packed mode keeps wait_ms as a device output too: it
+    is only ever READ on the rare tick whose PASS_WAIT rows overflow the
+    wire's fixed sidecar, so it costs nothing on the transport."""
+    if cfg.packed_wire:
+        return TickOutput(
+            verdict=None,
+            wait_ms=wait_ms,
+            stats=None,
+            res_stats=None,
+            hot=None,
+            wire=WIRE.pack_tick_output(
+                cfg, verdict, wait_ms, seg_dropped, stats, res_stats, hot
+            ),
+        )
+    return TickOutput(
+        verdict=verdict,
+        wait_ms=wait_ms,
+        seg_dropped=seg_dropped,
+        stats=stats,
+        res_stats=res_stats,
+        hot=hot,
+    )
+
+
 def empty_acquire(cfg: EngineConfig, b: Optional[int] = None) -> AcquireBatch:
     # every leaf gets its OWN buffer — two pytree leaves sharing one device
     # buffer bakes a deduplicated parameter list into the executable that
@@ -476,18 +515,22 @@ def empty_acquire(cfg: EngineConfig, b: Optional[int] = None) -> AcquireBatch:
     # 'Execution supplied 57 buffers but compiled program expected 58')
     b = b or cfg.batch_size
     trash = cfg.trash_row
-    z = lambda: jnp.zeros((b,), dtype=jnp.int32)
+    # packed_wire ships the range-bounded columns narrow (ops/wire.py);
+    # the empty batch must match the client's upload dtypes exactly or
+    # warmup would compile a signature serving never calls
+    wd = WIRE.acquire_wire_dtypes(cfg)
+    z = lambda f: jnp.zeros((b,), dtype=wd.get(f, np.int32))
     return AcquireBatch(
         res=jnp.full((b,), trash, dtype=jnp.int32),
-        count=z(),
-        prio=z(),
+        count=z("count"),
+        prio=z("prio"),
         origin_id=jnp.full((b,), -1, dtype=jnp.int32),
         origin_node=jnp.full((b,), trash, dtype=jnp.int32),
         ctx_node=jnp.full((b,), trash, dtype=jnp.int32),
         ctx_name=jnp.full((b,), -1, dtype=jnp.int32),
-        inbound=z(),
+        inbound=z("inbound"),
         param_hash=jnp.zeros((b, cfg.param_dims), dtype=jnp.int32),
-        pre_verdict=z(),
+        pre_verdict=z("pre_verdict"),
     )
 
 
@@ -495,15 +538,16 @@ def empty_complete(cfg: EngineConfig, b: Optional[int] = None) -> CompleteBatch:
     # distinct buffer per leaf — see empty_acquire
     b = b or cfg.complete_batch_size
     trash = cfg.trash_row
-    z = lambda: jnp.zeros((b,), dtype=jnp.int32)
+    wd = WIRE.complete_wire_dtypes(cfg)
+    z = lambda f: jnp.zeros((b,), dtype=wd.get(f, np.int32))
     return CompleteBatch(
         res=jnp.full((b,), trash, dtype=jnp.int32),
         origin_node=jnp.full((b,), trash, dtype=jnp.int32),
         ctx_node=jnp.full((b,), trash, dtype=jnp.int32),
-        inbound=z(),
+        inbound=z("inbound"),
         rt=jnp.zeros((b,), dtype=jnp.float32),
-        success=z(),
-        error=z(),
+        success=z("success"),
+        error=z("error"),
         param_hash=jnp.zeros((b, cfg.param_dims), dtype=jnp.int32),
     )
 
@@ -2288,6 +2332,12 @@ def tick(
     """One engine tick: completions, then batched decisions, then effects."""
     b = acq.res.shape[0]
     now_ms = now_ms.astype(jnp.int32)
+    if cfg.packed_wire:
+        # narrow uploads (ops/wire.py) widen here, before anything else
+        # touches the batch — every stage below sees the classic int32
+        # columns, so the packed and classic ticks share one code path
+        acq = WIRE.widen_acquire(acq)
+        comp = WIRE.widen_complete(comp)
     zero_block = jnp.zeros((b,), bool)
 
     # segment-compacted effects (ops/engine_seg.py): build the key-run
@@ -2488,9 +2538,8 @@ def tick(
         hot = None
         if hotset_k(cfg) > 0:
             hot = _device_hot_candidates(cfg, state, acq, valid, now_ms)
-        return state, TickOutput(
-            verdict=verdict, wait_ms=wait_ms, seg_dropped=seg_dropped,
-            stats=stats, res_stats=res_stats, hot=hot,
+        return state, _tick_output(
+            cfg, verdict, wait_ms, seg_dropped, stats, res_stats, hot
         )
 
     with_nodes = "nodes" in features
@@ -2616,10 +2665,7 @@ def tick(
     hot = None
     if hotset_k(cfg) > 0:
         hot = _device_hot_candidates(cfg, state, acq, valid, now_ms)
-    return state, TickOutput(
-        verdict=verdict, wait_ms=wait_ms, stats=stats, res_stats=res_stats,
-        hot=hot,
-    )
+    return state, _tick_output(cfg, verdict, wait_ms, 0, stats, res_stats, hot)
 
 
 def replace_system_columns(ruleset: RuleSet, system: RT.SystemTensors) -> RuleSet:

@@ -1,0 +1,326 @@
+"""Packed host↔device wire format for the client tick path.
+
+ROADMAP item 1: the engine decides at ~19 M dps pipelined but the client
+path ships ~5 MB of full-width columns per tick and reads verdicts,
+telemetry, timeline and hot-set rows back in FOUR separate transfers.
+This module is the wire half of the fix (runtime/client.py owns the
+dirty-column upload half):
+
+Readback — ONE flat uint32 buffer per tick (``TickOutput.wire``),
+packed on-device so only packed bytes ever cross the transport::
+
+    word 0            WIRE_MAGIC (layout/version tag)
+    word 1            n_wait   — count of PASS_WAIT rows with wait > 0
+    word 2            seg_dropped — fail-closed seg-overflow item count
+    word 3            checksum — uint32 sum of words {0,1,2} ∪ payload
+    [bitmap]          ceil(B / 10) words; 10 verdicts per word, 3 bits
+                      each (verdict codes are 0..6 — core/errors.py)
+    [sidecar]         EXC_K row indices then EXC_K wait values (uint32):
+                      the top-EXC_K rows of wait_ms.  Covers every
+                      PASS_WAIT row whenever n_wait <= EXC_K; a rarer
+                      overflow tick falls back to reading the full
+                      TickOutput.wait_ms column (the one escape hatch).
+    [stats]           N_STATS words — float32 telemetry row, bitcast
+    [timeline]        timeline_k * TL_COLS words — float32, bitcast
+    [hot]             hotset_k * 2 words — float32, bitcast
+
+Optional blocks appear iff the config emits them, so the layout is a
+pure function of (EngineConfig, batch shape) — the host unpacks by a
+static offset table, no per-tick negotiation.  The additive checksum
+detects any single-flipped-byte corruption (the chaos ``corrupt``
+action's exact fault model) plus truncation/drop via the length check;
+``unpack`` raises :class:`WireDecodeError` and the client fails the tick
+CLOSED (runtime/client._resolve_tick).
+
+Upload — batch columns whose value range is statically bounded travel
+narrow and widen on-device at tick entry (``widen_acquire`` /
+``widen_complete``): prio/inbound are 0/1 flags, pre_verdict is a
+verdict code, and count/success/error are clamped to
+``cfg.max_batch_count`` at the client's batch-build choke point whenever
+the fused path is active.  Dtypes are STATIC per config (a
+value-dependent encoding would change the jitted tick's signature and
+recompile mid-serving); the dirty-column skip lives in the client.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core.config import EngineConfig
+
+#: layout/version tag — bump when the word layout changes
+WIRE_MAGIC = 0x53_E1_71_12
+#: verdict codes are 0..6 (core/errors.py) — 3 bits, 10 per uint32 word
+VERDICT_BITS = 3
+VERDICTS_PER_WORD = 10
+_VMASK = (1 << VERDICT_BITS) - 1
+#: header words: magic, n_wait, seg_dropped, checksum
+HDR_WORDS = 4
+#: PASS_WAIT sidecar capacity — pacing verdicts are rare by design
+#: (flow rules with RATE_LIMITER behavior); 64 rows = 512 B covers the
+#: normal tick, and an overflow tick reads the full wait column instead
+EXC_K = 64
+
+
+class WireDecodeError(Exception):
+    """The fused readback failed validation (bad magic, wrong length, or
+    checksum mismatch).  The client turns this into a fail-CLOSED tick."""
+
+
+class WireLayout(NamedTuple):
+    """Static offset table for one (config, batch shape) pair."""
+
+    b: int  # batch rows the bitmap covers
+    exc_k: int  # sidecar rows (min(EXC_K, b))
+    n_stats: int  # telemetry words (0 = block absent)
+    tl_rows: int  # timeline rows (0 = block absent)
+    tl_cols: int
+    hot_rows: int  # hot-candidate rows (0 = block absent)
+    off_bitmap: int
+    n_bitmap: int
+    off_exc: int
+    off_stats: int
+    off_tl: int
+    off_hot: int
+    total: int  # whole-buffer length in words
+
+
+def layout_for(cfg: EngineConfig, b: int) -> WireLayout:
+    """The wire layout this config emits at batch shape ``b`` — must
+    mirror the engine's emission conditions exactly (ops/engine.tick)."""
+    from sentinel_tpu.ops import engine as E
+
+    n_stats = E.N_STATS if cfg.device_telemetry else 0
+    tl_rows = E.timeline_k(cfg) if cfg.device_telemetry else 0
+    # hot candidates clamp to the batch shape (engine._device_hot_candidates)
+    hot_rows = min(E.hotset_k(cfg), b)
+    exc_k = min(EXC_K, b)
+    n_bitmap = -(-b // VERDICTS_PER_WORD)
+    off_bitmap = HDR_WORDS
+    off_exc = off_bitmap + n_bitmap
+    off_stats = off_exc + 2 * exc_k
+    off_tl = off_stats + n_stats
+    off_hot = off_tl + tl_rows * E.TL_COLS
+    total = off_hot + hot_rows * 2
+    return WireLayout(
+        b=b,
+        exc_k=exc_k,
+        n_stats=n_stats,
+        tl_rows=tl_rows,
+        tl_cols=E.TL_COLS,
+        hot_rows=hot_rows,
+        off_bitmap=off_bitmap,
+        n_bitmap=n_bitmap,
+        off_exc=off_exc,
+        off_stats=off_stats,
+        off_tl=off_tl,
+        off_hot=off_hot,
+        total=total,
+    )
+
+
+# -- device side (inside the jitted tick) -----------------------------------
+
+
+def pack_tick_output(
+    cfg: EngineConfig,
+    verdict,  # int8 [B]
+    wait_ms,  # int32 [B]
+    seg_dropped,  # int32 scalar or plain 0
+    stats,  # float32 [N_STATS] or None
+    res_stats,  # float32 [K, TL_COLS] or None
+    hot,  # float32 [K, 2] or None
+):
+    """Pack one tick's outputs into the flat uint32 wire buffer.
+
+    Pure jnp (element-wise shifts + one top_k + concatenates) — cheap on
+    any backend against a tick that already streamed the full batch, and
+    it keeps the single-readback property on CPU tests and TPU alike."""
+    b = verdict.shape[0]
+    lo = layout_for(cfg, b)
+    v = verdict.astype(jnp.uint32)
+    v = jnp.pad(v, (0, lo.n_bitmap * VERDICTS_PER_WORD - b))
+    shifts = (jnp.arange(VERDICTS_PER_WORD, dtype=jnp.uint32) * VERDICT_BITS)
+    # lanes occupy disjoint bit ranges, so the OR-fold is a plain sum
+    bitmap = jnp.sum(
+        v.reshape(lo.n_bitmap, VERDICTS_PER_WORD) << shifts[None, :],
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    n_wait = jnp.sum(wait_ms > 0).astype(jnp.uint32)
+    # top-K by wait value: whenever n_wait <= exc_k this captures EVERY
+    # wait row (the rest read 0 and the host filters them out)
+    wv, wi = jax.lax.top_k(wait_ms, lo.exc_k)
+    parts = [bitmap, wi.astype(jnp.uint32), wv.astype(jnp.uint32)]
+    if lo.n_stats:
+        parts.append(jax.lax.bitcast_convert_type(stats, jnp.uint32))
+    if lo.tl_rows:
+        parts.append(
+            jax.lax.bitcast_convert_type(res_stats, jnp.uint32).reshape(-1)
+        )
+    if lo.hot_rows:
+        parts.append(jax.lax.bitcast_convert_type(hot, jnp.uint32).reshape(-1))
+    payload = jnp.concatenate(parts)
+    magic = jnp.uint32(WIRE_MAGIC)
+    dropped = jnp.asarray(seg_dropped).astype(jnp.uint32).reshape(())
+    cksum = (
+        magic
+        + n_wait
+        + dropped
+        + jnp.sum(payload, dtype=jnp.uint32)
+    )
+    return jnp.concatenate([jnp.stack([magic, n_wait, dropped, cksum]), payload])
+
+
+# -- host side (resolver thread) --------------------------------------------
+
+
+class WireFrame(NamedTuple):
+    """One decoded tick readback (host numpy)."""
+
+    verdict: np.ndarray  # int8 [B]
+    wait: Optional[np.ndarray]  # int32 [B]; None = sidecar overflowed
+    n_wait: int
+    seg_dropped: int
+    stats: Optional[np.ndarray]  # float32 [N_STATS]
+    res_stats: Optional[np.ndarray]  # float32 [K, TL_COLS]
+    hot: Optional[np.ndarray]  # float32 [K, 2]
+
+
+def unpack(data: bytes, lo: WireLayout) -> WireFrame:
+    """Validate and unpack one fused readback.
+
+    Raises :class:`WireDecodeError` on any integrity failure — length
+    (drop/short_read), magic, or checksum (any single-byte corruption);
+    the caller fails the tick CLOSED rather than fanning out garbage
+    verdicts."""
+    if len(data) != lo.total * 4:
+        raise WireDecodeError(
+            f"wire length {len(data)} B != layout {lo.total * 4} B"
+        )
+    buf = np.frombuffer(data, dtype=np.uint32)
+    if int(buf[0]) != WIRE_MAGIC:
+        raise WireDecodeError(f"bad wire magic {int(buf[0]):#x}")
+    expect = (
+        int(buf[0]) + int(buf[1]) + int(buf[2])
+        + int(np.sum(buf[HDR_WORDS:], dtype=np.uint64))
+    ) & 0xFFFFFFFF
+    if int(buf[3]) != expect:
+        raise WireDecodeError(
+            f"wire checksum mismatch ({int(buf[3]):#x} != {expect:#x})"
+        )
+    n_wait = int(buf[1])
+    seg_dropped = int(buf[2])
+    words = buf[lo.off_bitmap : lo.off_bitmap + lo.n_bitmap]
+    shifts = np.arange(VERDICTS_PER_WORD, dtype=np.uint32) * VERDICT_BITS
+    verdict = (
+        ((words[:, None] >> shifts[None, :]) & _VMASK)
+        .reshape(-1)[: lo.b]
+        .astype(np.int8)
+    )
+    wait: Optional[np.ndarray]
+    if n_wait == 0:
+        wait = np.zeros(lo.b, np.int32)
+    elif n_wait <= lo.exc_k:
+        idx = buf[lo.off_exc : lo.off_exc + lo.exc_k].astype(np.int64)
+        vals = buf[lo.off_exc + lo.exc_k : lo.off_stats].astype(np.int32)
+        live = vals > 0
+        if int(idx[live].max(initial=0)) >= lo.b:
+            raise WireDecodeError("wait sidecar row index out of range")
+        wait = np.zeros(lo.b, np.int32)
+        wait[idx[live]] = vals[live]
+    else:
+        wait = None  # overflow: caller reads the full wait_ms column
+    stats = res_stats = hot = None
+    if lo.n_stats:
+        stats = buf[lo.off_stats : lo.off_tl].view(np.float32)
+    if lo.tl_rows:
+        res_stats = (
+            buf[lo.off_tl : lo.off_hot].view(np.float32)
+            .reshape(lo.tl_rows, lo.tl_cols)
+        )
+    if lo.hot_rows:
+        hot = buf[lo.off_hot : lo.total].view(np.float32).reshape(lo.hot_rows, 2)
+    return WireFrame(
+        verdict=verdict,
+        wait=wait,
+        n_wait=n_wait,
+        seg_dropped=seg_dropped,
+        stats=stats,
+        res_stats=res_stats,
+        hot=hot,
+    )
+
+
+# -- narrow upload dtypes ----------------------------------------------------
+
+
+def _count_dtype(cfg: EngineConfig):
+    """Narrowest dtype that carries count-valued columns exactly.  The
+    client clamps counts to cfg.max_batch_count at batch build ONLY when
+    the fused path is active (engine._use_fused — static per process),
+    so narrowing is sound exactly then; the unfused paths stay exact to
+    65535 and keep int32."""
+    from sentinel_tpu.ops.engine import _use_fused
+
+    if not _use_fused(cfg):
+        return np.int32
+    if cfg.max_batch_count <= 0xFF:
+        return np.uint8
+    if cfg.max_batch_count <= 0x7FFF:
+        return np.int16
+    return np.int32
+
+
+def acquire_wire_dtypes(cfg: EngineConfig) -> dict:
+    """field -> numpy dtype for AcquireBatch columns narrower than int32
+    under packed_wire.  prio/inbound are 0/1 flags and pre_verdict is a
+    verdict code (0..6) — always int8-safe; count follows the clamp."""
+    if not cfg.packed_wire:
+        return {}
+    out = {
+        "prio": np.int8,
+        "inbound": np.int8,
+        "pre_verdict": np.int8,
+    }
+    cd = _count_dtype(cfg)
+    if cd is not np.int32:
+        out["count"] = cd
+    return out
+
+
+def complete_wire_dtypes(cfg: EngineConfig) -> dict:
+    """field -> numpy dtype for CompleteBatch columns narrower than int32
+    under packed_wire (same envelope as the acquire side)."""
+    if not cfg.packed_wire:
+        return {}
+    out = {"inbound": np.int8}
+    cd = _count_dtype(cfg)
+    if cd is not np.int32:
+        out["success"] = cd
+        out["error"] = cd
+    return out
+
+
+def _widen(batch, fields):
+    reps = {}
+    for f in fields:
+        x = getattr(batch, f)
+        if x.dtype != jnp.int32:
+            reps[f] = x.astype(jnp.int32)
+    return batch._replace(**reps) if reps else batch
+
+
+def widen_acquire(acq):
+    """Restore int32 for narrow-uploaded acquire columns at tick entry —
+    everything downstream of tick() sees the classic dtypes."""
+    return _widen(acq, ("count", "prio", "inbound", "pre_verdict"))
+
+
+def widen_complete(comp):
+    return _widen(comp, ("inbound", "success", "error"))

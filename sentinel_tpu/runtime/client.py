@@ -56,10 +56,12 @@ from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.core.rule_tensors import compile_system_rules, hash_param
 from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops import wire as WIRE
 from sentinel_tpu.obs import flight as FL
 from sentinel_tpu.obs import timeline as TLM
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as OBS
+from sentinel_tpu.native import ring as RING
 from sentinel_tpu.runtime import context as CTX
 from sentinel_tpu.runtime.registry import Registry
 from sentinel_tpu.metrics import extension as MEXT
@@ -208,6 +210,14 @@ _C_WIRE = {
     )
     for d in ("tx", "rx")
 }
+_C_PACKED_DECODE = OBS.counter(
+    "sentinel_packed_decode_failures_total",
+    "fused wire readbacks rejected by the packed decoder (tick fails CLOSED)",
+)
+_C_COLS_SKIPPED = OBS.counter(
+    "sentinel_wire_cols_skipped_total",
+    "batch-column uploads skipped because the column matched the previous tick",
+)
 
 
 def _shed_counter(stage: str, reason: str):
@@ -243,6 +253,11 @@ _FP_WD_STALL = FP.register(
     "runtime.watchdog.stall",
     "verdict readback entry (a delay stalls the tick for the watchdog)",
     FP.HIT_ACTIONS,
+)
+_FP_PACKED_DECODE = FP.register(
+    "transport.packed.decode",
+    "fused packed-wire readback bytes (mangled bytes fail the tick CLOSED)",
+    FP.PIPE_ACTIONS,
 )
 
 
@@ -325,6 +340,9 @@ class _PendingTick:
     check_dropped: bool
     n_obj: int  # object-request count (blocks start here)
     n_blk: int  # block item count (fronts start at n_obj + n_blk)
+    #: packed-wire offset table for this tick's batch shape (ops/wire.py);
+    #: captured at DISPATCH so a concurrent cfg swap can't skew the decode
+    wire_lo: Any = None
     tick_id: int = 0  # obs trace correlation id (0 = tracing disabled)
     dispatched_ns: int = 0  # obs: dispatch-complete stamp for the device span
     now_ms: int = 0  # engine timestamp the tick ran at (timeline fold key)
@@ -505,6 +523,14 @@ class SentinelClient:
         # (MXU tables + fused effects + segment compaction) is ON — the
         # product hot path IS the benchmarked engine configuration
         self.cfg = cfg or platform_engine_config()
+        # tri-state packed_wire resolves to ON here and OFF everywhere
+        # else (core/config.py): the client path is exactly where the
+        # fused readback + narrow uploads pay; direct engine callers keep
+        # the classic TickOutput arrays.  An explicit False opts out.
+        if self.cfg.packed_wire is None:
+            import dataclasses as _dc
+
+            self.cfg = _dc.replace(self.cfg, packed_wire=True)
         self.time = time_source or TimeSource()
         self.mode = mode if not isinstance(self.time, VirtualTimeSource) else "sync"
         self.tick_interval_ms = tick_interval_ms
@@ -636,6 +662,21 @@ class SentinelClient:
         # bottleneck, and most columns (prio, ctx, pre_verdict, counts of
         # 1) are constant in bulk workloads
         self._const_cols: Dict[tuple, Any] = {}
+        # dirty-column delta uploads: field -> (host column as last
+        # uploaded, its device array).  A varying-but-unchanged column
+        # (steady bulk traffic) reuses the device copy instead of
+        # re-crossing the transport; _dev_col keeps the ref fresh every
+        # tick so the two-slot staging below can never alias it.
+        self._col_last: Dict[str, tuple] = {}
+        # two-slot staging for batch assembly: per-column host buffers
+        # reused on alternating parity, so the buffer an async upload of
+        # tick t may still be reading is not rewritten until t+2 (one
+        # tick after its dirty-ref comparison) — zero per-tick column
+        # allocation on the steady path
+        self._stage: Dict[tuple, list] = {}
+        self._stage_parity = 0
+        # packed-wire offset tables keyed by (cfg, batch shape)
+        self._wire_layouts: Dict[tuple, Any] = {}
         # completions are fire-and-forget (no futures), so they ride the
         # native MPMC event ring: Entry.exit() from any request thread is
         # one C call, and the tick drains straight into numpy arrays
@@ -2478,7 +2519,17 @@ class SentinelClient:
         Keyed by FIELD, not just (fill, shape): two leaves must never
         share one device buffer — XLA dedupes identical argument buffers
         at compile time, and a call whose sharing pattern differs from the
-        compile-time call fails with a buffer-count mismatch."""
+        compile-time call fails with a buffer-count mismatch.
+
+        Varying columns get the dirty-skip: when the column is
+        bit-identical to the previous tick's upload, the cached device
+        array is reused.  The stored host ref is a PRIVATE COPY of the
+        uploaded column, never the staging buffer itself — staging slots
+        are reused on a parity cycle (and twice per round on paths that
+        tick more than once), so a borrowed ref could be silently
+        overwritten, or even BE the buffer under comparison, by the time
+        the next tick compares against it.  The copy costs one host
+        memcpy per CHANGED column; skipped ticks pay only the compare."""
         if (x == fill).all():
             key = (field, float(fill), x.dtype.str, x.shape)
             c = self._const_cols.get(key)
@@ -2486,9 +2537,44 @@ class SentinelClient:
                 c = jnp.asarray(x)
                 self._const_cols[key] = c
                 _C_WIRE["tx"].inc(x.nbytes)  # first (only) upload of the const
+            # the dirty ref would go stale while const ticks bypass it —
+            # drop it so the next varying tick uploads fresh
+            self._col_last.pop(field, None)
             return c
+        # the dirty-column delta path is part of the packed transport:
+        # packed_wire=False stays a true FULL-UPLOAD reference client
+        # (the golden tests compare the packed client against it)
+        if self.cfg.packed_wire:
+            prev = self._col_last.get(field)
+            if (
+                prev is not None
+                and prev[0].shape == x.shape
+                and prev[0].dtype == x.dtype
+                and np.array_equal(prev[0], x)
+            ):
+                _C_COLS_SKIPPED.inc()
+                return prev[1]
+        dev = jnp.asarray(x)  # copies: mutating x later never touches dev
         _C_WIRE["tx"].inc(x.nbytes)
-        return jnp.asarray(x)
+        self._col_last[field] = (x.copy(), dev)
+        return dev
+
+    def _sbuf(self, name: str, shape, dt) -> np.ndarray:
+        """Current-parity slot of the two-slot host staging buffer for one
+        assembly column (see __init__) — caller fills it completely."""
+        key = (name, shape, np.dtype(dt).str)
+        s = self._stage.get(key)
+        if s is None:
+            s = self._stage[key] = [np.empty(shape, dt), np.empty(shape, dt)]
+        return s[self._stage_parity]
+
+    def _wire_layout(self, cfg, b: int) -> WIRE.WireLayout:
+        """Cached packed-wire offset table for (cfg, batch shape)."""
+        key = (cfg, b)
+        lo = self._wire_layouts.get(key)
+        if lo is None:
+            lo = self._wire_layouts[key] = WIRE.layout_for(cfg, b)
+        return lo
 
     # -- segment-capacity adaptation ---------------------------------------
 
@@ -2697,6 +2783,9 @@ class SentinelClient:
         M = cfg.param_dims
         trash = cfg.trash_row
         n_blk = sum(t for _b, _o, t in blocks)
+        # flip the staging parity: every _sbuf below hands out the slot
+        # the PREVIOUS tick did not touch (double-buffered async safety)
+        self._stage_parity ^= 1
         t_build0 = _time.perf_counter()
         # process-unique trace id correlating this tick's spans across the
         # submitting thread and the resolver pool (per-client counters
@@ -2749,8 +2838,11 @@ class SentinelClient:
             n = len(acq)
             def arr(f, fill, dt, front_col=None, blk_default=None):
                 """Column assembly: object requests [0:n], array-block
-                slices [n:n+n_blk] (vectorized), front-door items after."""
-                out = np.full(B, fill, dtype=dt)
+                slices [n:n+n_blk] (vectorized), front-door items after.
+                Assembles into a two-slot staging buffer — the steady
+                serving path allocates no per-tick columns."""
+                out = self._sbuf("a." + f, B, dt)
+                out.fill(fill)
                 for i, r in enumerate(acq):
                     out[i] = getattr(r, f)
                 o = n
@@ -2769,7 +2861,8 @@ class SentinelClient:
             f_prio = front[2] if n_front else None
 
             def _ph_cols():
-                ph = np.zeros((B, M), dtype=np.int32)
+                ph = self._sbuf("a.ph", (B, M), np.int32)
+                ph.fill(0)
                 for i, r in enumerate(acq):
                     t = tuple(r.param_hash)[:M]
                     ph[i, : len(t)] = t
@@ -2796,7 +2889,7 @@ class SentinelClient:
             # are exact to 65535 and stay unclamped.
             cnt_np = arr("count", 0, np.int32, f_cnt, blk_default=1)
             if clamp:
-                cnt_np = np.minimum(cnt_np, cfg.max_batch_count)
+                np.minimum(cnt_np, cfg.max_batch_count, out=cnt_np)
             prio_np = arr("prio", 0, np.int32, f_prio)
             oid_np = arr("origin_id", -1, np.int32)
             onode_np = arr("origin_node", trash, np.int32)
@@ -2810,17 +2903,24 @@ class SentinelClient:
                 # key order matches engine_seg.prepare_acquire's segment
                 # keys, res-major (seg ranks also need res nondecreasing);
                 # trash-row padding sorts wherever its id lands — padding
-                # items are engine no-ops at any position
-                order = np.lexsort((cname_np, oid_np, onode_np, cnode_np, res_np))
-                (res_np, cnt_np, prio_np, oid_np, onode_np, cnode_np,
-                 cname_np, inb_np, pre_np) = (
-                    x[order]
-                    for x in (res_np, cnt_np, prio_np, oid_np, onode_np,
-                              cnode_np, cname_np, inb_np, pre_np)
+                # items are engine no-ops at any position.  Native stable
+                # argsort (native/ring.batch_sort5) with a bit-identical
+                # np.lexsort fallback; inverse permutation comes from the
+                # same call.
+                order, inv_a = RING.batch_sort5(
+                    res_np, cnode_np, onode_np, oid_np, cname_np
                 )
-                ph_np = ph_np[order]
-                inv_a = np.empty(B, np.int32)
-                inv_a[order] = np.arange(B, dtype=np.int32)
+                cols = [res_np, cnt_np, prio_np, oid_np, onode_np,
+                        cnode_np, cname_np, inb_np, pre_np]
+                for i, x in enumerate(cols):
+                    dst = self._sbuf(f"s.{i}", B, x.dtype)
+                    np.take(x, order, out=dst)
+                    cols[i] = dst
+                (res_np, cnt_np, prio_np, oid_np, onode_np, cnode_np,
+                 cname_np, inb_np, pre_np) = cols
+                dst = self._sbuf("s.ph", (B, M), np.int32)
+                np.take(ph_np, order, axis=0, out=dst)
+                ph_np = dst
                 if _tp:
                     _tp0 = _tp0 or _tp
                     _ns_presort += OT.now_ns() - _tp
@@ -2834,17 +2934,30 @@ class SentinelClient:
                         ),
                         B,
                     )
+            wd_a = WIRE.acquire_wire_dtypes(cfg)
+
+            def _nar(name, key, x, fill):
+                # narrow upload (ops/wire.py): flag / verdict-code /
+                # clamped-count values fit the wire dtype by construction,
+                # so the downcast is exact; the engine widens at tick entry
+                dt = wd_a.get(key)
+                if dt is not None and x.dtype != dt:
+                    nx = self._sbuf("w." + name, x.shape, dt)
+                    np.copyto(nx, x, casting="unsafe")
+                    x = nx
+                return self._dev_col(name, x, fill)
+
             a = E.AcquireBatch(
                 res=self._dev_col("a.res", res_np, trash),
-                count=self._dev_col("a.count", cnt_np, 1),
-                prio=self._dev_col("a.prio", prio_np, 0),
+                count=_nar("a.count", "count", cnt_np, 1),
+                prio=_nar("a.prio", "prio", prio_np, 0),
                 origin_id=self._dev_col("a.oid", oid_np, -1),
                 origin_node=self._dev_col("a.onode", onode_np, trash),
                 ctx_node=self._dev_col("a.cnode", cnode_np, trash),
                 ctx_name=self._dev_col("a.cname", cname_np, -1),
-                inbound=self._dev_col("a.inb", inb_np, 0),
+                inbound=_nar("a.inb", "inbound", inb_np, 0),
                 param_hash=self._dev_col("a.ph", ph_np, 0),
-                pre_verdict=self._dev_col("a.pre", pre_np, 0),
+                pre_verdict=_nar("a.pre", "pre_verdict", pre_np, 0),
             )
         c = E.empty_complete(cfg, b=min(256, cfg.complete_batch_size))
         if comp is not None:
@@ -2860,7 +2973,7 @@ class SentinelClient:
                 _tp = OT.t0()
                 # completions carry no futures — sort in place, no unsort
                 # (all completion effects are order-independent sums/minima)
-                order = np.lexsort((org_a, ctx_a, res_a))
+                order, _ = RING.batch_sort3(res_a, ctx_a, org_a)
                 res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a = (
                     x[order]
                     for x in (res_a, cnt_a, org_a, ctx_a, flags_a, rt_a, err_a)
@@ -2876,19 +2989,30 @@ class SentinelClient:
                         B2,
                     )
 
+            wd_c = WIRE.complete_wire_dtypes(cfg)
+
             def pad(name, a, fill, dt):
-                out = np.full(B2, fill, dtype=dt)
+                # staged assembly; narrow wire dtypes downcast exactly
+                # (0/1 flags, counts pre-clamped to max_batch_count)
+                out = self._sbuf(name, B2, dt)
+                out.fill(fill)
                 out[:n] = a
                 return self._dev_col(name, out, fill)
 
-            ph_np = np.zeros((B2, M), dtype=np.int32)
+            ph_np = self._sbuf("c.ph", (B2, M), np.int32)
+            ph_np.fill(0)
             for k in range(min(M, len(aux_a))):
                 ph_np[:n, k] = aux_a[k]
             c = E.CompleteBatch(
                 res=pad("c.res", res_a, trash, np.int32),
                 origin_node=pad("c.onode", org_a, trash, np.int32),
                 ctx_node=pad("c.cnode", ctx_a, trash, np.int32),
-                inbound=pad("c.inb", (flags_a & FLAG_INBOUND), 0, np.int32),
+                inbound=pad(
+                    "c.inb",
+                    (flags_a & FLAG_INBOUND),
+                    0,
+                    wd_c.get("inbound", np.int32),
+                ),
                 rt=pad("c.rt", rt_a, 0.0, np.float32),
                 # same max_batch_count envelope as the acquire side
                 success=pad(
@@ -2897,7 +3021,7 @@ class SentinelClient:
                     if clamp
                     else cnt_a,
                     0,
-                    np.int32,
+                    wd_c.get("success", np.int32),
                 ),
                 error=pad(
                     "c.err",
@@ -2905,7 +3029,7 @@ class SentinelClient:
                     if clamp
                     else err_a,
                     0,
-                    np.int32,
+                    wd_c.get("error", np.int32),
                 ),
                 param_hash=self._dev_col("c.ph", ph_np, 0),
             )
@@ -2963,17 +3087,19 @@ class SentinelClient:
             check_dropped=bool(presort and not cfg.seg_fallback),
             n_obj=len(acq),
             n_blk=n_blk,
+            wire_lo=self._wire_layout(cfg, B) if cfg.packed_wire else None,
             tick_id=tick_id,
             dispatched_ns=_disp_done,
             now_ms=int(t),
         )
         self._track_tick(p)  # watchdog coverage (no-op while disarmed)
         if self._pipeline_depth:
-            # start the device→host verdict transfer NOW so it overlaps
-            # the next tick's host build + device compute (tunnel RTT /
-            # PCIe latency hiding); resolution happens in _resolve_tick
+            # start the device→host transfer NOW so it overlaps the next
+            # tick's host build + device compute (tunnel RTT / PCIe
+            # latency hiding); resolution happens in _resolve_tick.
+            # Packed mode prefetches the ONE fused buffer instead.
             try:
-                out.verdict.copy_to_host_async()
+                (out.wire if out.wire is not None else out.verdict).copy_to_host_async()
             except Exception:  # stlint: disable=fail-open — prefetch hint only; _resolve_tick still reads the verdict synchronously
                 pass
         return p
@@ -3097,9 +3223,32 @@ class SentinelClient:
         FP.hit(_FP_WD_STALL)  # chaos: a delay here stalls the readback —
         # the stand-in for a hung device tick the watchdog must fail over
         out = p.out
-        # stlint: disable-next-line=host-sync — THE designed readback point (see class docstring)
-        verdict = np.asarray(out.verdict)
-        _C_WIRE["rx"].inc(verdict.nbytes)
+        frame = None
+        if out.wire is not None:
+            # THE single fused readback: verdict bitmap + wait sidecar +
+            # telemetry row + timeline top-K + hot-set candidates in one
+            # device→host transfer (ops/wire.py layout)
+            lo = p.wire_lo
+            # stlint: disable-next-line=host-sync — THE designed readback point (fused wire buffer)
+            raw = np.asarray(out.wire)
+            tl_bytes = lo.tl_rows * lo.tl_cols * 4
+            _C_WIRE["rx"].inc(raw.nbytes - tl_bytes)
+            if tl_bytes:
+                # timeline rows keep their own wire accounting path
+                TLM._C_WIRE["rx"].inc(tl_bytes)
+            # chaos: mangled bytes must be DETECTED and fail the tick
+            # CLOSED — never fan out garbage verdicts
+            data = FP.pipe(_FP_PACKED_DECODE, raw.tobytes())
+            try:
+                frame = WIRE.unpack(data, lo)
+            except WIRE.WireDecodeError:
+                _C_PACKED_DECODE.inc()
+                raise
+            verdict = frame.verdict
+        else:
+            # stlint: disable-next-line=host-sync — THE designed readback point (see class docstring)
+            verdict = np.asarray(out.verdict)
+            _C_WIRE["rx"].inc(verdict.nbytes)
         if p.dispatched_ns and OT.TRACER.enabled:
             # dispatch → verdicts host-visible: device compute + transfer,
             # plus queue wait when pipelined (spans may overlap in time —
@@ -3119,31 +3268,48 @@ class SentinelClient:
         # the same readback phase; replaces the host-side verdict re-scans
         # below (PASS_WAIT probe, adaptive pass/block accounting)
         stats = None
-        if out.stats is not None:
-            stats = np.asarray(out.stats)  # stlint: disable=host-sync — readback point
-            _C_WIRE["rx"].inc(stats.nbytes)
-            self._fold_device_stats(stats)
-        # per-resource timeline matrix (ops/engine.TL_*): K rows in the
-        # same readback phase, folded write-behind into per-second records
-        # (obs/timeline.py) — its wire cost is accounted under
-        # path="timeline" so the transport work sees it separately
-        if out.res_stats is not None and self.timeline is not None:
-            rs = np.asarray(out.res_stats)  # stlint: disable=host-sync — readback point
-            TLM._C_WIRE["rx"].inc(rs.nbytes)
-            self.timeline.note_tick(
-                rs, p.now_ms, self.time.wall_ms(p.now_ms) - p.now_ms
-            )
-        # hot-set candidate rows ([K, 2] id/estimate): folded into the
-        # promotion loop's candidate map (sketch/hotset.py)
-        if out.hot is not None and self.hotset is not None:
-            hot = np.asarray(out.hot)  # stlint: disable=host-sync — readback point
-            _C_WIRE["rx"].inc(hot.nbytes)
-            self.hotset.fold(hot)
+        if frame is not None:
+            # packed mode: every block below was decoded from the ONE
+            # fused transfer — no further device reads on this path
+            # (except the rare wait-sidecar overflow escape hatch)
+            stats = frame.stats
+            if stats is not None:
+                self._fold_device_stats(stats)
+            if frame.res_stats is not None and self.timeline is not None:
+                self.timeline.note_tick(
+                    frame.res_stats, p.now_ms,
+                    self.time.wall_ms(p.now_ms) - p.now_ms,
+                )
+            if frame.hot is not None and self.hotset is not None:
+                self.hotset.fold(frame.hot)
+        else:
+            if out.stats is not None:
+                stats = np.asarray(out.stats)  # stlint: disable=host-sync — readback point
+                _C_WIRE["rx"].inc(stats.nbytes)
+                self._fold_device_stats(stats)
+            # per-resource timeline matrix (ops/engine.TL_*): K rows in the
+            # same readback phase, folded write-behind into per-second
+            # records (obs/timeline.py) — its wire cost is accounted under
+            # path="timeline" so the transport work sees it separately
+            if out.res_stats is not None and self.timeline is not None:
+                rs = np.asarray(out.res_stats)  # stlint: disable=host-sync — readback point
+                TLM._C_WIRE["rx"].inc(rs.nbytes)
+                self.timeline.note_tick(
+                    rs, p.now_ms, self.time.wall_ms(p.now_ms) - p.now_ms
+                )
+            # hot-set candidate rows ([K, 2] id/estimate): folded into the
+            # promotion loop's candidate map (sketch/hotset.py)
+            if out.hot is not None and self.hotset is not None:
+                hot = np.asarray(out.hot)  # stlint: disable=host-sync — readback point
+                _C_WIRE["rx"].inc(hot.nbytes)
+                self.hotset.fold(hot)
         if p.check_dropped:
             # fail-closed capacity overflow must be LOUD (an engine
             # rejecting traffic because seg_u is undersized is an incident,
             # not a silent counter)
-            if stats is not None:
+            if frame is not None:
+                dropped = frame.seg_dropped  # always in the packed header
+            elif stats is not None:
                 dropped = int(stats[E.STAT_SEG_DROPPED])
             else:
                 dropped = int(np.asarray(out.seg_dropped))  # stlint: disable=host-sync — readback point
@@ -3155,15 +3321,20 @@ class SentinelClient:
         # transfer entirely on the common no-pacing tick.  The device
         # telemetry row answers "any PASS_WAIT?" without scanning the
         # verdict array on the host.
-        if stats is not None:
-            any_wait = stats[E.STAT_PASS_WAIT] > 0
+        if frame is not None:
+            wait = frame.wait
+            if wait is None:
+                # > EXC_K pacing rows this tick: the sidecar overflowed —
+                # the ONE escape-hatch read outside the fused transfer
+                wait = np.asarray(out.wait_ms)  # stlint: disable=host-sync — sidecar-overflow escape hatch (rare by design)
+                _C_WIRE["rx"].inc(wait.nbytes)
+        elif stats is not None and not stats[E.STAT_PASS_WAIT] > 0:
+            wait = np.zeros(verdict.shape[0], np.int32)
+        elif stats is None and not (verdict == ERR.PASS_WAIT).any():
+            wait = np.zeros(verdict.shape[0], np.int32)
         else:
-            any_wait = bool((verdict == ERR.PASS_WAIT).any())
-        if any_wait:
             wait = np.asarray(out.wait_ms)  # stlint: disable=host-sync — readback point
             _C_WIRE["rx"].inc(wait.nbytes)
-        else:
-            wait = np.zeros(verdict.shape[0], np.int32)
         if _t_rb:
             OT.stage("tick.readback", _t_rb, _H_READBACK, trace=p.tick_id)
         FP.hit(_FP_FANOUT)  # chaos: raise BEFORE any consumer resolves

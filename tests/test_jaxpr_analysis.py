@@ -140,6 +140,56 @@ def test_transfer_guard_clean_tensor_program():
     assert list(TransferGuardPass().run(e)) == []
 
 
+def test_transfer_guard_flags_readbacks_outside_fused_wire():
+    """A packed-wire tick whose TickOutput still carries a live stats (or
+    verdict) array has silently un-fused the transport: _resolve_tick
+    would sync that array separately from the single wire transfer."""
+    e = _entry(
+        lambda x: x + 1,
+        jnp.zeros((4,), jnp.float32),
+        packed_wire=True,
+        readback_fields=("wait_ms", "seg_dropped", "stats", "wire"),
+    )
+    got = list(TransferGuardPass().run(e))
+    assert len(got) == 1 and "'stats'" in got[0].message
+
+    # ...and a packed entry that lost the wire buffer itself is flagged
+    e = _entry(
+        lambda x: x + 1,
+        jnp.zeros((4,), jnp.float32),
+        packed_wire=True,
+        readback_fields=("verdict", "wait_ms"),
+    )
+    msgs = [f.message for f in TransferGuardPass().run(e)]
+    assert any("no fused 'wire' buffer" in m for m in msgs)
+    assert any("'verdict'" in m for m in msgs)
+
+
+def test_transfer_guard_packed_allowance_is_clean():
+    e = _entry(
+        lambda x: x + 1,
+        jnp.zeros((4,), jnp.float32),
+        packed_wire=True,
+        readback_fields=("wait_ms", "seg_dropped", "wire"),
+    )
+    assert list(TransferGuardPass().run(e)) == []
+
+
+def test_packed_wire_entry_readback_surface_is_fused():
+    """The REAL tick/packed-wire entry: eval_shape-observed live outputs
+    must be exactly the fused buffer + the sidecar escape hatch — this is
+    the acceptance invariant 'four readbacks fused to one' as a gate."""
+    from sentinel_tpu.analysis.jaxpr.entrypoints import trace_entries
+
+    ents = {e.name: e for e in trace_entries()}
+    e = ents["tick/packed-wire"]
+    assert e.packed_wire and e.readback_fields is not None
+    assert "wire" in e.readback_fields
+    assert set(e.readback_fields) <= {"wire", "wait_ms", "seg_dropped"}
+    # the classic entries keep the multi-array form and are not gated
+    assert ents["tick/plain"].packed_wire is False
+
+
 # ---------------------------------------------------------------------------
 # dtype-overflow
 # ---------------------------------------------------------------------------

@@ -134,3 +134,29 @@ def test_interner_threaded_consistency():
         th.join()
     assert len(results) == 50
     assert sorted(results.values()) == list(range(50))
+
+
+def test_batch_sort_native_matches_numpy_fallback(monkeypatch):
+    """The C stable argsort (sx_batch_sort5/3) must be byte-identical to
+    the np.lexsort fallback — order AND inverse permutation, ties
+    included (both sides are stable sorts over the same key order)."""
+    import sentinel_tpu.native.ring as RM
+
+    assert native_available()  # the native path must actually be on trial
+    rng = np.random.default_rng(7)
+    for n in (0, 1, 3, 257, 20000):
+        # tiny key ranges force heavy ties — the stability trap
+        k5 = [rng.integers(-2, 3, n).astype(np.int32) for _ in range(5)]
+        k3 = [rng.integers(0, 4, n).astype(np.int32) for _ in range(3)]
+        o5n, i5n = RM.batch_sort5(*k5)
+        o3n, i3n = RM.batch_sort3(*k3, want_inv=True)
+        with monkeypatch.context() as m:
+            m.setattr(RM, "load_native", lambda: None)
+            o5f, i5f = RM.batch_sort5(*k5)
+            o3f, i3f = RM.batch_sort3(*k3, want_inv=True)
+        assert np.array_equal(o5n, o5f) and np.array_equal(i5n, i5f)
+        assert np.array_equal(o3n, o3f) and np.array_equal(i3n, i3f)
+        # both agree with the reference np.lexsort key order
+        assert np.array_equal(o5f, np.lexsort((k5[4], k5[3], k5[2], k5[1], k5[0])))
+        if n:
+            assert np.array_equal(i5n[o5n], np.arange(n))
