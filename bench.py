@@ -732,6 +732,228 @@ def cluster_sharded_bench(n_requests: int = 2000, workers: int = 8) -> dict:
     return out
 
 
+# -- multihost fleet curve (--multihost → MULTIHOST_r13.json) ----------------
+
+
+def _fleet_point(
+    fleet, fids, duration_s: float, workers: int, count: int = 1
+) -> dict:
+    """Hammer an already-warmed fleet for ``duration_s`` and report the
+    steady-state lease-phase shape: tokens/s, sampled call p50/p99, and
+    RPCs-per-decision (routed singles + batch frames over decisions)."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from sentinel_tpu.obs.registry import REGISTRY as OBS
+
+    def _frames_tx() -> float:
+        m = OBS.get("sentinel_cluster_batch_frames_total", {"direction": "tx"})
+        return float(m.value) if m is not None else 0.0
+
+    shards = list(fleet.client._shards.values())
+    req0 = sum(st.c_requests.value for st in shards)
+    adm0 = sum(st.c_local_admits.value for st in shards)
+    fr0 = _frames_tx()
+    lat: list = []
+    lat_lock = threading.Lock()
+    n_done = [0] * workers
+    end_t = [0.0]
+
+    def worker(wi: int) -> None:
+        rng = np.random.default_rng(wi)
+        order = [int(x) for x in rng.permutation(fids)]
+        i = n = 0
+        loc = []
+        end = end_t[0]
+        while time.perf_counter() < end:
+            t0 = time.perf_counter()
+            fleet.client.request_token(order[i % len(order)], count)
+            if n % 64 == 0:  # sample: timing every call would dominate it
+                loc.append(time.perf_counter() - t0)
+            i += 1
+            n += 1
+        with lat_lock:
+            lat.extend(loc)
+        n_done[wi] = n
+
+    end_t[0] = time.perf_counter() + duration_s
+    cpu0, t0 = time.process_time(), time.perf_counter()
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        list(pool.map(worker, range(workers)))
+    wall = time.perf_counter() - t0
+    cpu = time.process_time() - cpu0
+    fleet.client.flush_lease_refresh(5.0)
+    decisions = sum(n_done)
+    routed = sum(st.c_requests.value for st in shards) - req0
+    local = sum(st.c_local_admits.value for st in shards) - adm0
+    frames = _frames_tx() - fr0
+    la = np.sort(np.asarray(lat)) * 1000.0
+    return {
+        "routed_tokens_per_s": round(decisions * count / wall),
+        "decisions": decisions,
+        "call_p50_ms": round(float(la[len(la) // 2]), 4),
+        "call_p99_ms": round(float(la[int(len(la) * 0.99)]), 4),
+        "rpcs_per_decision": round((routed + frames) / max(decisions, 1), 5),
+        "local_admit_share": round(local / max(decisions, 1), 4),
+        "routed_rpcs": int(routed),
+        "batch_frames": int(frames),
+        "cpu_core_share": round(cpu / wall, 2),
+    }
+
+
+def multihost_fleet_bench(
+    duration_s: float = 3.0, workers: int = 8, flows: int = 32
+) -> dict:
+    """The MULTIHOST curve, r13 revision: the cluster token fleet under
+    protocol v2's lease-first admission at 1/2/4 shards.  The seed curve
+    (MULTIHOST_BENCH.json) anti-scaled — 28.9k → 15.2k routed tokens/s
+    with call_p50 280 ms — because every decision was one synchronous
+    RPC.  Lease-first makes the steady state RPC-free: decisions admit
+    locally against standing leases topped up ahead of exhaustion by
+    batched LEASE frames, so tokens/s is bounded by the admitting hosts,
+    not the socket.
+
+    Environment honesty (same note as the seed bench): every shard AND
+    the driving workers share this container's single core, so the curve
+    cannot show CAPACITY scaling — adding in-process shards only splits
+    the same core.  What it shows is that shards no longer COST
+    throughput (the seed lost 47% going 1 → 4): the per-decision RPC
+    that made fan-out anti-scale is gone, and the residual per-shard
+    overhead is a handful of lease frames per thousand decisions.
+    Deployed shards on separate hosts multiply capacity by the host
+    count exactly because the client-side cost per decision no longer
+    grows with the fleet."""
+    from sentinel_tpu.cluster.shard import ShardFleet
+    from sentinel_tpu.core import rules as R
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    made = []
+
+    def factory():
+        c = SentinelClient(cfg=small_engine_config(), mode="sync")
+        c.start()
+        made.append(c)
+        return c
+
+    fids = list(range(1001, 1001 + flows))
+    out: dict = {
+        "metric": "multihost_routed_tokens_per_s",
+        "revision": "r13",
+        "flows": flows,
+        "workers": workers,
+        "duration_s": duration_s,
+        "seed_points": {"1": 28886, "2": 22740, "4": 15237},
+        "points": [],
+        "environment": (
+            "in-process fleet on ONE core: shards and workers split the "
+            "same cycles, so the curve documents that shard fan-out no "
+            "longer costs throughput (seed: −47% at 4 shards) — not "
+            "multi-host capacity, which needs one host per shard"
+        ),
+    }
+    try:
+        for n_shards in (1, 2, 4):
+            fleet = ShardFleet(
+                factory,
+                n_shards=n_shards,
+                lease_slack=0.25,
+                retry_interval_s=300.0,
+                lease_ttl_ms=600_000,
+                timeout_ms=5000,
+                reconnect_interval_s=0.0,
+            )
+            try:
+                fleet.load_flow_rules(
+                    "default",
+                    [
+                        R.FlowRule(
+                            resource=f"res-{fid}",
+                            count=1e9,  # measure the protocol, not admission
+                            cluster_mode=True,
+                            cluster_flow_id=fid,
+                            cluster_threshold_type=1,
+                        )
+                        for fid in fids
+                    ],
+                )
+                for fid in fids:  # warm: connections + bootstrap leases
+                    fleet.client.request_token(fid)
+                fleet.client.flush_lease_refresh(5.0)
+                row = _fleet_point(fleet, fids, duration_s, workers)
+                row["shards"] = n_shards
+                out["points"].append(row)
+            finally:
+                fleet.stop()
+        by = {p["shards"]: p for p in out["points"]}
+        out["scaling_4_vs_1"] = round(
+            by[4]["routed_tokens_per_s"] / max(by[1]["routed_tokens_per_s"], 1), 2
+        )
+        out["seed_scaling_4_vs_1"] = round(15237 / 28886, 2)
+    finally:
+        for c in made:
+            c.stop()
+    return out
+
+
+def _cluster_smoke_metrics() -> dict:
+    """The perf sentry's fleet-path sample: a 2-shard fleet hammered
+    briefly at per-decision grain.  ``cluster_rpcs_per_decision`` trips
+    if the lease-first fast path stops absorbing steady-state traffic
+    (every decision turning back into an RPC measures ~1.0 against a
+    0.05 ceiling); ``cluster_call_p50_ms`` trips if the common-case
+    admission stops being a local debit."""
+    from sentinel_tpu.cluster.shard import ShardFleet
+    from sentinel_tpu.core import rules as R
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    made = []
+
+    def factory():
+        c = SentinelClient(cfg=small_engine_config(), mode="sync")
+        c.start()
+        made.append(c)
+        return c
+
+    fids = list(range(1001, 1017))
+    fleet = ShardFleet(
+        factory,
+        n_shards=2,
+        lease_slack=0.25,
+        retry_interval_s=300.0,
+        lease_ttl_ms=600_000,
+        timeout_ms=5000,
+        reconnect_interval_s=0.0,
+    )
+    try:
+        fleet.load_flow_rules(
+            "default",
+            [
+                R.FlowRule(
+                    resource=f"res-{fid}",
+                    count=1e9,
+                    cluster_mode=True,
+                    cluster_flow_id=fid,
+                    cluster_threshold_type=1,
+                )
+                for fid in fids
+            ],
+        )
+        for fid in fids:
+            fleet.client.request_token(fid)
+        fleet.client.flush_lease_refresh(5.0)
+        row = _fleet_point(fleet, fids, duration_s=1.5, workers=4)
+        return {
+            "cluster_rpcs_per_decision": row["rpcs_per_decision"],
+            "cluster_call_p50_ms": row["call_p50_ms"],
+        }
+    finally:
+        fleet.stop()
+        for c in made:
+            c.stop()
+
+
 # -- sketch statistics tier @ 1M ruled resources (--sketch-tier) -------------
 
 
@@ -932,6 +1154,13 @@ DEFAULT_TOLERANCES = {
     # full-column re-upload (~4 KiB/column at B=1024 int32) trips it.
     "wire_bytes_per_tick_rx": {"max_abs": 6656.0},
     "wire_bytes_per_tick_tx": {"max_abs": 2048.0},
+    # cluster fleet path (PR 13 lease-first admission): steady-state
+    # decisions must be absorbed locally by standing leases — the ratio
+    # measures ~0.001 when healthy and ~1.0 if every decision turns back
+    # into a synchronous RPC; p50 is a local debit (µs), so the 30 ms
+    # ceiling catches the fast path collapsing to the transport
+    "cluster_rpcs_per_decision": {"max_abs": 0.05},
+    "cluster_call_p50_ms": {"max_abs": 30.0},
 }
 
 
@@ -1099,6 +1328,7 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             "sketch_estimate_err_pct": sk_err_pct,
             "wire_bytes_per_tick_rx": round(wire_rx),
             "wire_bytes_per_tick_tx": round(wire_tx),
+            **_cluster_smoke_metrics(),
         },
         "batch": B,
         "platform": jax.devices()[0].platform,
@@ -1472,7 +1702,18 @@ if __name__ == "__main__":
         # compared against PERF_BASELINE.json (exit 1 on regression);
         # --update-baseline re-pins after an intentional perf change
         sys.exit(_smoke_main("--update-baseline" in sys.argv))
-    if "--wire-compare" in sys.argv:
+    if "--multihost" in sys.argv:
+        # the fleet scaling curve under protocol v2 lease-first admission
+        # (host path only — CPU-reproducible); writes MULTIHOST_r13.json
+        doc = multihost_fleet_bench()
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "MULTIHOST_r13.json"
+        )
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(json.dumps({"multihost": doc, "written": path}))
+    elif "--wire-compare" in sys.argv:
         # the packed-wire before/after row alone (CPU-reproducible —
         # how BENCH_r12 captured the transport collapse)
         print(json.dumps({"wire_compare": wire_compare_bench()}))

@@ -43,6 +43,7 @@ _ENTRY_MODULES = {
     "rank/grouped-cumsum": "sentinel_tpu/ops/rank.py",
     "rank/grouped-cumsum-small": "sentinel_tpu/ops/rank.py",
     "window/add-batch": "sentinel_tpu/ops/window.py",
+    "cluster/token-col": "sentinel_tpu/ops/token_col.py",
 }
 
 #: entries whose jaxpr contains pallas_call — exempt from cost budgets
@@ -304,6 +305,35 @@ def _build_entries() -> List[TracedEntry]:
             "rank/grouped-cumsum-small",
             lambda k, v, e: RK.grouped_exclusive_cumsum_small(k, [v], e, 64),
             (keys, vals_f, elig),
+            cost=True,
+        )
+    )
+
+    # the cluster decision-batch column (protocol v2): one call answers a
+    # coalesced BATCH frame — slot-run prefix rebase + window charge —
+    # entirely on device (cluster/token_service.TokenColumnBatcher)
+    from sentinel_tpu.ops import token_col as TC
+
+    tc_state = TC.init_state(16)
+    tcn = 64
+    tc_slots = jnp.zeros((tcn,), jnp.int32)
+    tc_units = jnp.ones((tcn,), jnp.int32)
+    tc_heads = jnp.zeros((tcn,), jnp.int32)
+    tc_flag = jnp.zeros((tcn,), bool)
+    entries.append(
+        _trace(
+            "cluster/token-col",
+            functools.partial(TC.decide_batch, cfg=TC.DEFAULT_CFG),
+            (
+                tc_state,
+                jnp.int32(1_000),
+                tc_slots,
+                tc_units,
+                tc_heads,
+                tc_flag,
+                tc_flag,
+            ),
+            time_arg=1,
             cost=True,
         )
     )

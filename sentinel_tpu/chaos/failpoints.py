@@ -223,6 +223,15 @@ def armed(plan):
 # -- hot-path hooks ----------------------------------------------------------
 
 
+def is_armed() -> bool:
+    """Whether a chaos plan is currently armed.  Instrumented code may
+    consult this to keep injected counts a pure function of the seed —
+    e.g. the shard lease refresher runs its async hop inline while a
+    plan is armed, so a refresh-site raise lands on the driving thread
+    deterministically instead of racing a background worker."""
+    return _ARMED
+
+
 def hit(site: str) -> None:
     """Cross a raise/delay site.  Disarmed: one flag check."""
     if not _ARMED:

@@ -848,13 +848,20 @@ def _scn_shard_reconnect(seed: int) -> ScenarioResult:
 
 def _scn_shard_failover(seed: int) -> ScenarioResult:
     """Shard-kill / partition / rejoin against a real 2-shard fleet
-    (cluster/shard.py): an injected route failure partitions ONE shard —
-    only its flows fail over to the bounded-slack lease fallback while
-    the other shard keeps answering remotely — the heal probe exits the
-    degraded state within one hysteresis window, and a REAL server kill
-    + rejoin after the armed window exercises the same protocol over an
-    actual dead socket.  Token conservation: every fallback pass debits
-    a lease the owner granted out of the global budget beforehand."""
+    (cluster/shard.py), under protocol-v2 LEASE-FIRST admission: after
+    the first remote decision bootstraps the standing lease, healthy
+    repeats admit locally with zero RPCs, so the injected route failure
+    is delivered through a param-token request (param budgets never
+    lease, every one routes — the hit index stays a pure function of
+    the seed).  One shard partitions; its flows drain the bounded-slack
+    lease (local admits, then metered fallback) and fail CLOSED at
+    exhaustion while the other shard is untouched; an injected
+    ``cluster.lease.refresh_async`` raise drops exactly one
+    ahead-of-exhaustion top-up (the lease keeps draining, the next
+    trigger refills); a REAL kill + rejoin exercises the same protocol
+    over an actual dead socket.  Token conservation: every local admit
+    and fallback pass debits a lease the owner granted out of the
+    global budget beforehand."""
     from sentinel_tpu.cluster import constants as CC
     from sentinel_tpu.cluster.shard import ShardFleet
     from sentinel_tpu.core import rules as R
@@ -895,16 +902,23 @@ def _scn_shard_failover(seed: int) -> ScenarioResult:
     )
     metrics = MetricsDelta()
     session = _Session()
-    # healthy phase drives exactly 4 route-site hits (A A B B), so the
-    # raise lands on hit 4 — the first partition-phase request to A
+    # lease-first leaves exactly 2 route hits in the healthy phase (one
+    # bootstrap decision per shard — repeats admit locally), so the
+    # param-token partition probe is route hit 2.  The refresh_async
+    # raise fires on that site's FIRST hit: the drain below crosses the
+    # refresh threshold (remaining <= 50%) once at used=25.
     plan = FaultPlan(
         name="shard_failover",
         seed=seed,
         faults=[
             FaultSpec(
                 "cluster.shard.route", "raise",
-                burst_start=4, burst_len=1, max_fires=1, exc="ConnectionResetError",
-            )
+                burst_start=2, burst_len=1, max_fires=1, exc="ConnectionResetError",
+            ),
+            FaultSpec(
+                "cluster.lease.refresh_async", "raise",
+                max_fires=1, exc="RuntimeError",
+            ),
         ],
     )
     counts = {"requests": 0, "ok": 0, "blocked": 0, "failed": 0, "other": 0}
@@ -926,39 +940,58 @@ def _scn_shard_failover(seed: int) -> ScenarioResult:
     sh_b = fleet.client._shards["shard-1"]
     try:
         with session.window(plan):
-            drive(fid_a, 2)          # healthy: route hits 0,1 (+ lease grant)
-            drive(fid_b, 2)          # healthy: route hits 2,3 (+ lease grant)
-            drive(fid_a, 1)          # hit 4 raises -> enter degraded(shard-0)
+            drive(fid_a, 2)          # route hit 0 + lease grant 50; repeat = local admit
+            drive(fid_b, 2)          # route hit 1 + lease grant 50; repeat = local admit
+            # param budgets never lease -> always route: hit 2 raises
+            r = fleet.client.request_param_token(fid_a, 1, ["chaos"])
+            counts["requests"] += 1
+            counts["blocked" if r.status == CC.STATUS_BLOCKED else "other"] += 1
             failover_one_window = sh_a.degraded_active  # within ONE hysteresis window
-            drive(fid_a, 3)          # degraded: lease-fallback passes, no route hits
-            drive(fid_b, 2)          # other shard unaffected: route hits 5,6
+            drive(fid_a, 3)          # degraded: metered lease-fallback passes, no route hits
+            drive(fid_b, 2)          # other shard untouched: local admits, no route hits
             with sh_a.lock:          # heal: expire the cooldown explicitly
                 sh_a.degraded_until = 0.0
-            drive(fid_a, 1)          # probe -> healthy answer -> exit degraded
+            drive(fid_a, 1)          # probe (route hit 3) -> healthy -> exit degraded
             healed = not sh_a.degraded_active
+            # drain fid_b toward the refresh threshold: used 3 -> 25
+            # triggers top-up #1 (the injected raise eats it: lease
+            # keeps draining), used 26 triggers top-up #2, which
+            # refills inline (armed => deterministic) to granted=50
+            drive(fid_b, 23)
         # -- real-kill phase (outside the armed window: injected counts
         # stay a pure function of the seed).  shard-1's server dies for
-        # real; its flow fails over to the lease while shard-0's flow is
+        # real; lease-first keeps its flow passing LOCALLY for exactly
+        # the refilled slack (50), then fail-CLOSED; shard-0's flow is
         # untouched; rejoin on the ORIGINAL port + explicit cooldown
         # expiry brings it back.
         fleet.kill("shard-1")
         _time.sleep(0.2)  # let the client's reader observe the close
-        drive(fid_b, 1)              # dead socket -> enter degraded(shard-1), lease pass
+        drive(fid_b, 50)             # exactly-slack local admits against the dead owner
+        drive(fid_b, 1)              # spent -> remote -> dead socket -> degraded, fail closed
         killed_over = sh_b.degraded_active
+        drive(fid_b, 1)              # degraded + spent lease: still fail closed
+        drive(fid_a, 1)              # shard-0 untouched: local admit
         fleet.rejoin("shard-1")
         with sh_b.lock:
             sh_b.degraded_until = 0.0
         drive(fid_b, 1)              # probe the rejoined server -> exit
         rejoined = not sh_b.degraded_active
+        # quiesce the background refresher (disarmed kill-phase admits
+        # may have queued async top-ups against the dead socket)
+        fleet.client.flush_lease_refresh(5.0)
     finally:
         fleet.stop()
         for c in decisions:
             c.stop()
 
-    lease_cap = 50  # ceil(100 * lease_slack); fallback passes beyond it would be unmetered
+    lease_cap = 50  # ceil(100 * lease_slack); passes beyond it would be unmetered
     fallback_passes = int(
         metrics.delta('sentinel_shard_fallback_total{shard="shard-0",verdict="pass"}')
         + metrics.delta('sentinel_shard_fallback_total{shard="shard-1",verdict="pass"}')
+    )
+    local_admits = int(
+        metrics.delta('sentinel_lease_local_admits_total{shard="shard-0"}')
+        + metrics.delta('sentinel_lease_local_admits_total{shard="shard-1"}')
     )
     ctx = ScenarioContext(
         metrics=metrics,
@@ -967,20 +1000,29 @@ def _scn_shard_failover(seed: int) -> ScenarioResult:
         passed=counts["ok"],
         blocked=counts["blocked"],
         degraded=counts["failed"] + counts["other"],
-        degraded_passes=max(fallback_passes - 2 * lease_cap, 0),
+        # local admits + fallback passes both spend lease units: beyond
+        # 2 × (cap + one top-up refill) they would be unmetered grants
+        degraded_passes=max(fallback_passes + local_admits - 2 * lease_cap - 26, 0),
         injected=session.injected,
-        expect_injected={"cluster.shard.route:raise": 1},
+        expect_injected={
+            "cluster.shard.route:raise": 1,
+            "cluster.lease.refresh_async:raise": 1,
+        },
         extra={
             "token_counts": counts,
             "expect_token_failures": 0,
             "expect_shard_transitions": {"shard-0": (1, 1), "shard-1": (1, 1)},
             "expect_metric_deltas": {
-                'sentinel_shard_fallback_total{shard="shard-0",verdict="pass"}': 4,
-                'sentinel_shard_fallback_total{shard="shard-0",verdict="block"}': 0,
-                'sentinel_shard_fallback_total{shard="shard-1",verdict="pass"}': 1,
-                'sentinel_shard_fallback_total{shard="shard-1",verdict="block"}': 0,
+                'sentinel_shard_fallback_total{shard="shard-0",verdict="pass"}': 3,
+                'sentinel_shard_fallback_total{shard="shard-0",verdict="block"}': 1,
+                'sentinel_shard_fallback_total{shard="shard-1",verdict="pass"}': 0,
+                'sentinel_shard_fallback_total{shard="shard-1",verdict="block"}': 2,
                 'sentinel_shard_lease_tokens_total{shard="shard-0"}': lease_cap,
-                'sentinel_shard_lease_tokens_total{shard="shard-1"}': lease_cap,
+                # bootstrap grant (50) + the surviving top-up (26)
+                'sentinel_shard_lease_tokens_total{shard="shard-1"}': lease_cap + 26,
+                'sentinel_lease_local_admits_total{shard="shard-0"}': 2,
+                # 1 healthy + 2 untouched + 23 drain + 50 exactly-slack
+                'sentinel_lease_local_admits_total{shard="shard-1"}': 76,
             },
         },
     )

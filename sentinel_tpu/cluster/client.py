@@ -16,7 +16,9 @@ import struct
 import threading
 from concurrent.futures import CancelledError, Future
 from concurrent.futures import TimeoutError as _FutTimeout
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
@@ -25,6 +27,7 @@ from sentinel_tpu.cluster.token_service import TokenResult, TokenService
 from sentinel_tpu.obs import flight as FL
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
+from sentinel_tpu.utils.time_source import mono_s
 
 _H_RPC = _OBS.histogram(
     "sentinel_cluster_rpc_ms",
@@ -50,6 +53,13 @@ _C_RPC_FAIL = {
     )
     for k in ("connect", "send", "timeout", "conn_lost", "decode")
 }
+
+#: frames currently awaiting a response across all cluster client
+#: connections (multiplexing depth) — mirrors the xid→Future map exactly
+_G_INFLIGHT = _OBS.gauge(
+    "sentinel_cluster_inflight_frames",
+    "request frames awaiting responses across all cluster client connections",
+)
 
 #: chaos failpoints (chaos/failpoints.py) on the round-trip path — the
 #: exact points a real transport fault strikes, one flag check disarmed
@@ -107,10 +117,24 @@ class ClusterTokenClient(TokenService):
         self._xid_counter = itertools.count(0)
         self._reader: Optional[threading.Thread] = None
         self._closed = False
+        # negotiated protocol version for the CURRENT connection: starts
+        # at 1, bumped to 2 when the server answers our HELLO, reset on
+        # every teardown (a failover target may be an older build)
+        self._peer_version = 1
 
     def _next_xid(self) -> int:
         # xid is an int32 on the wire; wrap within the positive range
         return next(self._xid_counter) % 0x7FFFFFFF + 1
+
+    def _pend_add(self, xid: int, f: Future) -> None:
+        self._pending[xid] = f
+        _G_INFLIGHT.inc()
+
+    def _pend_pop(self, xid: int) -> Optional[Future]:
+        f = self._pending.pop(xid, None)
+        if f is not None:
+            _G_INFLIGHT.dec()
+        return f
 
     # -- connection management ----------------------------------------------
 
@@ -170,6 +194,45 @@ class ClusterTokenClient(TokenService):
             self._backoff.failure()
             self._teardown(kind="send_fail")
             return False
+        # protocol negotiation rides behind the PING, off the request
+        # path: a v2 server answers with its version; a v1 server's
+        # decoder rejects the unknown type and drops the frame, so the
+        # future never resolves and a reaper timer pins this connection
+        # to v1 framing.  Either way no request ever waits on it.
+        try:
+            hx = self._next_xid()
+            hf: Future = Future()
+
+            def _hello_done(fut: Future) -> None:
+                try:
+                    rsp = fut.result(timeout=0)
+                except Exception:  # stlint: disable=fail-open — HELLO is a best-effort probe: any failure leaves the peer on v1 legacy framing, the conservative direction
+                    return
+                if (
+                    rsp is not None
+                    and rsp.status == C.STATUS_OK
+                    and rsp.remaining >= 2
+                ):
+                    self._peer_version = 2
+
+            hf.add_done_callback(_hello_done)
+            self._pend_add(hx, hf)
+
+            def _hello_reap() -> None:
+                f2 = self._pend_pop(hx)
+                if f2 is not None and not f2.done():
+                    f2.set_result(None)  # v1 peer: HELLO went unanswered
+
+            self._send_nowait(
+                P.ClusterRequest(hx, C.MSG_TYPE_HELLO, count=C.PROTOCOL_VERSION)
+            )
+            t = threading.Timer(self.timeout_ms / 1000.0, _hello_reap)
+            t.daemon = True
+            t.start()
+        except OSError:
+            self._pend_pop(hx)
+            # PING already proved the socket once; a HELLO write failure
+            # just leaves the connection on v1 until the next reconnect
         # NO backoff reset here: a connect (or even a buffered write)
         # proves nothing about server health — an accept-then-die flapper
         # would hold the backoff at attempt 0 forever and the fleet would
@@ -181,6 +244,9 @@ class ClusterTokenClient(TokenService):
         with self._lock:
             s, self._sock = self._sock, None
             pending, self._pending = self._pending, {}
+            self._peer_version = 1  # renegotiate on the next connection
+        if pending:
+            _G_INFLIGHT.dec(len(pending))
         if s is not None:
             # black-box journal: WHY a live connection went away (close /
             # send_fail / conn_lost) with how many requests it stranded
@@ -212,7 +278,12 @@ class ClusterTokenClient(TokenService):
                     break
                 for body in frames.feed(data):
                     try:
-                        rsp = P.decode_response(body)
+                        # BATCH responses carry column slabs the legacy
+                        # decoder would misparse — route on the type byte
+                        if P.peek_type(body) == C.MSG_TYPE_BATCH:
+                            rsp = P.decode_batch_response(body)
+                        else:
+                            rsp = P.decode_response(body)
                     except (ValueError, struct.error):
                         _C_RPC_FAIL["decode"].inc()
                         continue  # malformed frame; xid never resolves -> caller times out to STATUS_FAIL
@@ -220,7 +291,7 @@ class ClusterTokenClient(TokenService):
                         # first decoded response = the healthy exchange
                         # that resets the reconnect backoff ramp
                         self._backoff.success()
-                    f = self._pending.pop(rsp.xid, None)
+                    f = self._pend_pop(rsp.xid)
                     if f is not None and not f.done():
                         f.set_result(rsp)
         except OSError:
@@ -263,7 +334,7 @@ class ClusterTokenClient(TokenService):
         except (ValueError, struct.error):
             return _BAD_REQUEST  # unencodable request; connection is fine
         f: Future = Future()
-        self._pending[req.xid] = f
+        self._pend_add(req.xid, f)
         try:
             s = self._sock
             if s is None:
@@ -274,7 +345,7 @@ class ClusterTokenClient(TokenService):
             with self._send_lock:
                 s.sendall(raw)
         except OSError:
-            self._pending.pop(req.xid, None)
+            self._pend_pop(req.xid)
             self._teardown(kind="send_fail")
             _C_RPC_FAIL["send"].inc()
             if _t:
@@ -290,7 +361,7 @@ class ClusterTokenClient(TokenService):
         try:
             rsp = f.result(timeout=self.timeout_ms / 1000.0)
         except (_FutTimeout, CancelledError):
-            self._pending.pop(req.xid, None)
+            self._pend_pop(req.xid)
             _C_RPC_FAIL["timeout"].inc()
             if _t:
                 OT.stage(
@@ -329,6 +400,169 @@ class ClusterTokenClient(TokenService):
         if rsp is None:
             return TokenResult(C.STATUS_FAIL)
         return TokenResult(rsp.status, remaining=rsp.remaining, wait_ms=rsp.wait_ms)
+
+    @property
+    def peer_version(self) -> int:
+        return self._peer_version
+
+    def request_batch(
+        self, entries: Sequence[Tuple[int, ...]]
+    ) -> List[TokenResult]:
+        """Many token requests in ONE wire exchange.
+
+        ``entries`` is a sequence of ``(kind, flow_id, count)`` or
+        ``(kind, flow_id, count, flags)`` tuples (kind is a
+        C.BATCH_KIND_* constant).  Against a v2 peer the whole list rides
+        one BATCH frame; against a v1 peer the entries are pipelined as
+        individual frames on the same connection — all sends first, then
+        one collection pass — so wall clock is one round-trip either
+        way.  Transport failure fails every entry CLOSED (STATUS_FAIL):
+        partial answers from a corrupted frame are never applied."""
+        n = len(entries)
+        if n == 0:
+            return []
+        if not self._ensure_connected():
+            _C_RPC_FAIL["connect"].inc()
+            return [TokenResult(C.STATUS_FAIL)] * n
+        if self._peer_version >= 2 and n <= C.MAX_BATCH_ENTRIES:
+            return self._request_batch_v2(entries)
+        return self._request_batch_v1(entries)
+
+    def _request_batch_v2(self, entries) -> List[TokenResult]:
+        n = len(entries)
+        req = P.ClusterBatchRequest(
+            xid=self._next_xid(),
+            kinds=np.array([e[0] for e in entries], np.uint8),
+            ids=np.array([e[1] for e in entries], np.int64),
+            counts=np.array([e[2] for e in entries], np.int32),
+            flags=np.array(
+                [e[3] if len(e) > 3 else 0 for e in entries], np.uint8
+            ),
+        )
+        _t = OT.t0()
+        _attrs = None
+        if _t:
+            tid, parent = OT.current_ctx()
+            if not tid:
+                tid = OT.new_trace_id()
+            req.trace_id = tid
+            req.span_id = OT.new_span_id()
+            _attrs = {"type": C.MSG_TYPE_BATCH, "n": n, "span_id": req.span_id}
+            if parent:
+                _attrs["parent"] = parent
+        try:
+            raw = P.encode_batch_request(req)
+        except (ValueError, struct.error):
+            return [TokenResult(C.STATUS_BAD_REQUEST)] * n
+        f: Future = Future()
+        self._pend_add(req.xid, f)
+        try:
+            s = self._sock
+            if s is None:
+                raise OSError("not connected")
+            raw = FP.pipe(_FP_SEND, raw)
+            with self._send_lock:
+                s.sendall(raw)
+        except OSError:
+            self._pend_pop(req.xid)
+            self._teardown(kind="send_fail")
+            _C_RPC_FAIL["send"].inc()
+            if _t:
+                OT.stage(
+                    "cluster.rpc", _t, trace=req.trace_id,
+                    attrs=dict(_attrs, ok=False),
+                )
+            return [TokenResult(C.STATUS_FAIL)] * n
+        try:
+            rsp = f.result(timeout=self.timeout_ms / 1000.0)
+        except (_FutTimeout, CancelledError):
+            self._pend_pop(req.xid)
+            _C_RPC_FAIL["timeout"].inc()
+            rsp = None
+        if rsp is None and not self.connected:
+            _C_RPC_FAIL["conn_lost"].inc()
+        if _t:
+            OT.stage(
+                "cluster.rpc", _t, _H_RPC if rsp is not None else None,
+                trace=req.trace_id, attrs=dict(_attrs, ok=rsp is not None),
+            )
+        # whole-frame fail-closed: a non-OK frame status or an entry-count
+        # mismatch means NO entry verdict can be trusted
+        if (
+            rsp is None
+            or not isinstance(rsp, P.ClusterBatchResponse)
+            or rsp.status != C.STATUS_OK
+            or len(rsp) != n
+        ):
+            return [TokenResult(C.STATUS_FAIL)] * n
+        return [
+            TokenResult(
+                int(rsp.statuses[i]),
+                remaining=int(rsp.remainings[i]),
+                wait_ms=int(rsp.waits[i]),
+                token_id=int(rsp.token_ids[i]),
+            )
+            for i in range(n)
+        ]
+
+    _BATCH_KIND_TO_MSG = {
+        C.BATCH_KIND_FLOW: C.MSG_TYPE_FLOW,
+        C.BATCH_KIND_FLOW_BATCH: C.MSG_TYPE_FLOW_BATCH,
+        C.BATCH_KIND_LEASE: C.MSG_TYPE_LEASE,
+    }
+
+    def _request_batch_v1(self, entries) -> List[TokenResult]:
+        n = len(entries)
+        out: List[Optional[TokenResult]] = [None] * n
+        waiters: List[Tuple[int, int, Future]] = []
+        for i, e in enumerate(entries):
+            mt = self._BATCH_KIND_TO_MSG.get(int(e[0]))
+            if mt is None:
+                out[i] = TokenResult(C.STATUS_BAD_REQUEST)
+                continue
+            prio = bool((e[3] if len(e) > 3 else 0) & C.BATCH_FLAG_PRIORITIZED)
+            req = P.ClusterRequest(
+                self._next_xid(), mt, flow_id=int(e[1]), count=int(e[2]),
+                priority=prio,
+            )
+            f: Future = Future()
+            self._pend_add(req.xid, f)
+            try:
+                raw = FP.pipe(_FP_SEND, P.encode_request(req))
+                s = self._sock
+                if s is None:
+                    raise OSError("not connected")
+                with self._send_lock:
+                    s.sendall(raw)
+            except (ValueError, struct.error):
+                self._pend_pop(req.xid)
+                out[i] = TokenResult(C.STATUS_BAD_REQUEST)
+                continue
+            except OSError:
+                self._pend_pop(req.xid)
+                self._teardown(kind="send_fail")
+                _C_RPC_FAIL["send"].inc()
+                out[i] = TokenResult(C.STATUS_FAIL)
+                continue
+            waiters.append((i, req.xid, f))
+        # one shared deadline for the whole pipeline: the responses were
+        # all in flight before the first wait started
+        end = mono_s() + self.timeout_ms / 1000.0
+        for i, xid, f in waiters:
+            try:
+                rsp = f.result(timeout=max(0.0, end - mono_s()))
+            except (_FutTimeout, CancelledError):
+                self._pend_pop(xid)
+                _C_RPC_FAIL["timeout"].inc()
+                rsp = None
+            if rsp is None:
+                out[i] = TokenResult(C.STATUS_FAIL)
+            else:
+                out[i] = TokenResult(
+                    rsp.status, remaining=rsp.remaining,
+                    wait_ms=rsp.wait_ms, token_id=rsp.token_id,
+                )
+        return [r if r is not None else TokenResult(C.STATUS_FAIL) for r in out]
 
     def request_param_token(self, flow_id: int, count: int, params: List[Any]) -> TokenResult:
         rsp = self._roundtrip(

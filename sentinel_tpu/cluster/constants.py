@@ -29,6 +29,19 @@ MSG_TYPE_RES_CHECK = 12
 # fallback), so global overshoot is bounded by the outstanding leases —
 # the slack-window reconciliation idea (arXiv 1703.01166)
 MSG_TYPE_LEASE = 13
+# protocol v2 extension: BATCH — one frame carries many flows' token
+# requests as fixed-width column entries (see protocol.py "v2 BATCH
+# frame layout").  The server coalesces BATCH frames across connections
+# into one device decision batch (ops/token_col.py), so the shard
+# answers at engine speed instead of socket speed.  Version-negotiated
+# via HELLO: a v1 peer never sees a BATCH frame.
+MSG_TYPE_BATCH = 14
+# protocol v2 extension: HELLO — version negotiation.  A v2 client sends
+# HELLO (its version in `count`) after connect; a v2 server answers
+# STATUS_OK with its own version in `remaining`.  A v1 server drops the
+# unknown frame on the floor, the HELLO times out, and the client keeps
+# speaking v1 — legacy frames stay byte-identical either way.
+MSG_TYPE_HELLO = 15
 
 # -- token result status (TokenResultStatus.java) ----------------------------
 STATUS_BAD_REQUEST = -4
@@ -67,3 +80,18 @@ MAX_LEASE_UNITS = 1024
 # cluster threshold types (ClusterRuleConstant)
 FLOW_THRESHOLD_AVG_LOCAL = 0
 FLOW_THRESHOLD_GLOBAL = 1
+
+# -- protocol v2 (BATCH frames) ----------------------------------------------
+PROTOCOL_VERSION = 2
+# per-entry kinds inside a BATCH frame (NOT wire message types — the
+# frame's type byte is MSG_TYPE_BATCH; these select the per-entry
+# decision semantics)
+BATCH_KIND_FLOW = 1  # all-or-nothing acquire of `count` units
+BATCH_KIND_FLOW_BATCH = 2  # partial-grant acquire (granted k in remaining)
+BATCH_KIND_LEASE = 3  # bounded-slack lease top-up (granted k + TTL)
+# per-entry flag bits
+BATCH_FLAG_PRIORITIZED = 0x01
+# hard ceiling on entries per BATCH frame: 14 B/entry keeps the frame
+# comfortably under MAX_FRAME (65535) and bounds one coalesced device
+# decision batch
+MAX_BATCH_ENTRIES = 2048

@@ -25,6 +25,27 @@ Payloads (big-endian, mirroring the reference entity writers):
   flow/param response       → [remaining:int32][waitMs:int32]
   concurrent acquire resp   → [tokenId:int64]
   others                    → empty
+
+v2 BATCH frame layout (MSG_TYPE_BATCH, version-negotiated via HELLO —
+a v1 peer never receives one; all v1 frames above stay byte-identical):
+
+  request  → [xid:int32][type=14:uint8][n:uint16]
+             n × [kind:uint8][id:int64][count:int32][flags:uint8]   (14 B)
+             [optional 17-byte trace tail]
+  response → [xid:int32][type=14:uint8][status:int8][n:uint16]
+             n × [status:int8][remaining:int32][waitMs:int32][tokenId:int64]  (17 B)
+             [optional 17-byte trace tail]
+
+Entry columns are fixed-width big-endian, so pack/unpack is a single
+zero-copy reinterpret (native sx_frame_* or a numpy structured-dtype
+fallback — byte-identical by construction).  Decoding validates the
+EXACT frame length (header + n×entry + optional tail): a corrupt or
+short-read frame raises and the WHOLE frame fails closed — partial
+answers are never applied.
+
+  HELLO    → request  [version:uint8];  response carries the server's
+             version in `remaining`.  A v1 server drops the unknown
+             frame (client's HELLO times out → keeps speaking v1).
 """
 
 from __future__ import annotations
@@ -33,7 +54,10 @@ import struct
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
 
+import numpy as np
+
 from sentinel_tpu.cluster import constants as C
+from sentinel_tpu.native import ring as _NR
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
 
 MAX_FRAME = 65535  # 2-byte length prefix ceiling; RES_CHECK batches chunk
@@ -52,6 +76,16 @@ _C_WIRE_TX = _OBS.counter(
 _C_WIRE_RX = _OBS.counter(
     "sentinel_wire_bytes_total", _WIRE_HELP,
     labels={"path": "cluster", "direction": "rx"},
+)
+
+#: v2 BATCH frames through the codec — the RPC-coalescing win is visible
+#: as this counter rising while per-decision RPC counts fall
+_BATCH_HELP = "protocol v2 BATCH frames encoded/decoded, by direction"
+_C_BATCH_TX = _OBS.counter(
+    "sentinel_cluster_batch_frames_total", _BATCH_HELP, labels={"direction": "tx"}
+)
+_C_BATCH_RX = _OBS.counter(
+    "sentinel_cluster_batch_frames_total", _BATCH_HELP, labels={"direction": "rx"}
 )
 
 # param type tags
@@ -196,6 +230,9 @@ def encode_request(req: ClusterRequest) -> bytes:
     elif t == C.MSG_TYPE_RES_CHECK:
         # params = flat 5-tuples (name, count, prio, origin, typed-param)
         payload = _pack_params(req.params) + tail
+    elif t == C.MSG_TYPE_HELLO:
+        # version negotiation: the speaker's protocol version in `count`
+        payload = struct.pack(">B", req.count & 0xFF) + tail
     else:
         raise ValueError(f"bad request type {t}")
     body = head + payload
@@ -229,6 +266,9 @@ def decode_request(body: bytes) -> ClusterRequest:
         req.trace_id, req.span_id = _read_trace_tail(p, 8)
     elif t == C.MSG_TYPE_RES_CHECK:
         req.params, req.trace_id, req.span_id = _unpack_params(p)
+    elif t == C.MSG_TYPE_HELLO:
+        req.count = p[0] if p else 1
+        req.trace_id, req.span_id = _read_trace_tail(p, 1)
     else:
         raise ValueError(f"bad request type {t}")
     return req
@@ -241,6 +281,7 @@ def encode_response(rsp: ClusterResponse) -> bytes:
         C.MSG_TYPE_PARAM_FLOW,
         C.MSG_TYPE_FLOW_BATCH,
         C.MSG_TYPE_LEASE,
+        C.MSG_TYPE_HELLO,  # v2 extension: server version in `remaining`
     ):
         payload = struct.pack(">ii", rsp.remaining, rsp.wait_ms)
     elif rsp.type == C.MSG_TYPE_CONCURRENT_ACQUIRE:
@@ -271,6 +312,7 @@ def decode_response(body: bytes) -> ClusterResponse:
             C.MSG_TYPE_PARAM_FLOW,
             C.MSG_TYPE_FLOW_BATCH,
             C.MSG_TYPE_LEASE,
+            C.MSG_TYPE_HELLO,  # v2 extension: peer version in `remaining`
         )
         and len(p) >= 8
     ):
@@ -293,6 +335,133 @@ def decode_response(body: bytes) -> ClusterResponse:
         tail_off = off
     rsp.trace_id, rsp.span_id = _read_trace_tail(p, tail_off)
     return rsp
+
+
+# ---------------------------------------------------------------------------
+# protocol v2: BATCH frames (column entries, zero-copy pack/unpack)
+# ---------------------------------------------------------------------------
+
+_BATCH_REQ_HEAD = struct.Struct(">iBH")  # xid, type, n
+_BATCH_RSP_HEAD = struct.Struct(">iBbH")  # xid, type, frame status, n
+
+
+@dataclass
+class ClusterBatchRequest:
+    """One v2 frame carrying many flows' token requests as columns."""
+
+    xid: int
+    kinds: np.ndarray  # uint8[n] — C.BATCH_KIND_*
+    ids: np.ndarray  # int64[n] — flow ids
+    counts: np.ndarray  # int32[n] — units requested
+    flags: np.ndarray  # uint8[n] — C.BATCH_FLAG_*
+    trace_id: int = 0
+    span_id: int = 0
+
+    def __len__(self) -> int:
+        return len(self.kinds)
+
+
+@dataclass
+class ClusterBatchResponse:
+    """Per-entry verdict columns; ``status`` is the WHOLE-frame status
+    (non-OK ⇒ no entry was applied — fail closed, never partially)."""
+
+    xid: int
+    status: int
+    statuses: np.ndarray  # int8[n] — C.STATUS_* per entry
+    remainings: np.ndarray  # int32[n] — granted units / remaining
+    waits: np.ndarray  # int32[n] — wait/TTL ms per entry
+    token_ids: np.ndarray  # int64[n] — concurrent token ids (0 otherwise)
+    trace_id: int = 0
+    span_id: int = 0
+
+    def __len__(self) -> int:
+        return len(self.statuses)
+
+
+def encode_batch_request(req: ClusterBatchRequest) -> bytes:
+    n = len(req.kinds)
+    if not 0 < n <= C.MAX_BATCH_ENTRIES:
+        raise ValueError(f"bad batch size {n}")
+    body = (
+        _BATCH_REQ_HEAD.pack(req.xid, C.MSG_TYPE_BATCH, n)
+        + _NR.pack_batch_entries(req.kinds, req.ids, req.counts, req.flags)
+        + _trace_tail(req.trace_id, req.span_id)
+    )
+    if len(body) > MAX_FRAME:
+        raise ValueError("frame too large")
+    _C_WIRE_TX.inc(len(body) + 2)
+    _C_BATCH_TX.inc()
+    return struct.pack(">H", len(body)) + body
+
+
+def _batch_payload(p: bytes, n: int, entry_size: int) -> Tuple[bytes, int, int]:
+    """Strict-length entry slab + trace context.  The remainder after the
+    count header must be EXACTLY ``n`` entries, optionally followed by a
+    well-formed trace block — anything else (bit-flipped count byte,
+    short read, trailing garbage) raises, and the caller rejects the
+    whole frame: a corrupted BATCH frame never yields partial answers."""
+    want = n * entry_size
+    if len(p) == want:
+        return p, 0, 0
+    if len(p) == want + _TRACE_BLOCK.size and p[want] == _T_TRACE:
+        tid, sid = _read_trace_tail(p, want)
+        return p[:want], tid, sid
+    raise ValueError(f"bad batch frame length {len(p)} for {n} entries")
+
+
+def decode_batch_request(body: bytes) -> ClusterBatchRequest:
+    _C_WIRE_RX.inc(len(body) + 2)
+    _C_BATCH_RX.inc()
+    xid, t, n = _BATCH_REQ_HEAD.unpack_from(body, 0)
+    if t != C.MSG_TYPE_BATCH:
+        raise ValueError(f"not a batch frame (type {t})")
+    if not 0 < n <= C.MAX_BATCH_ENTRIES:
+        raise ValueError(f"bad batch size {n}")
+    slab, tid, sid = _batch_payload(body[_BATCH_REQ_HEAD.size :], n, _NR.BATCH_ENTRY_SIZE)
+    kinds, ids, counts, flags = _NR.unpack_batch_entries(slab)
+    return ClusterBatchRequest(
+        xid=xid, kinds=kinds, ids=ids, counts=counts, flags=flags,
+        trace_id=tid, span_id=sid,
+    )
+
+
+def encode_batch_response(rsp: ClusterBatchResponse) -> bytes:
+    n = len(rsp.statuses)
+    body = _BATCH_RSP_HEAD.pack(rsp.xid, C.MSG_TYPE_BATCH, rsp.status, n)
+    if n:
+        body += _NR.pack_batch_results(
+            rsp.statuses, rsp.remainings, rsp.waits, rsp.token_ids
+        )
+    body += _trace_tail(rsp.trace_id, rsp.span_id)
+    if len(body) > MAX_FRAME:
+        raise ValueError("frame too large")
+    _C_WIRE_TX.inc(len(body) + 2)
+    _C_BATCH_TX.inc()
+    return struct.pack(">H", len(body)) + body
+
+
+def decode_batch_response(body: bytes) -> ClusterBatchResponse:
+    _C_WIRE_RX.inc(len(body) + 2)
+    _C_BATCH_RX.inc()
+    xid, t, status, n = _BATCH_RSP_HEAD.unpack_from(body, 0)
+    if t != C.MSG_TYPE_BATCH:
+        raise ValueError(f"not a batch frame (type {t})")
+    if not 0 <= n <= C.MAX_BATCH_ENTRIES:
+        raise ValueError(f"bad batch size {n}")
+    slab, tid, sid = _batch_payload(body[_BATCH_RSP_HEAD.size :], n, _NR.BATCH_RESULT_SIZE)
+    statuses, remainings, waits, tokens = _NR.unpack_batch_results(slab)
+    return ClusterBatchResponse(
+        xid=xid, status=status, statuses=statuses, remainings=remainings,
+        waits=waits, token_ids=tokens, trace_id=tid, span_id=sid,
+    )
+
+
+def peek_type(body: bytes) -> int:
+    """Frame type byte without a full decode (offset 4 in both request
+    and response bodies) — lets transport loops route BATCH frames to
+    the column codec and everything else to the legacy one."""
+    return body[4] if len(body) >= 5 else -1
 
 
 class FrameReader:

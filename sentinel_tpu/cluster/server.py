@@ -20,6 +20,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
+import numpy as np
+
 from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.obs import trace as OT
@@ -34,6 +36,13 @@ from sentinel_tpu.utils.record_log import record_log
 #: processed" probe — the no-replay invariant reads it.
 _FP_PROCESS = FP.register(
     "cluster.server.process", "token server request processing", FP.HIT_ACTIONS
+)
+
+#: chaos failpoint on the protocol-v2 BATCH frame transport: corrupt /
+#: short_read mangle the frame bytes before decode, which must fail the
+#: WHOLE frame closed — partial answers are never applied
+_FP_BATCH = FP.register(
+    "cluster.batch.frame", "protocol-v2 batch frame transport", FP.PIPE_ACTIONS
 )
 
 
@@ -198,6 +207,9 @@ class ClusterTokenServer:
                     break
                 self._last_active[cid] = mono_s()
                 for body in frames.feed(data):
+                    if P.peek_type(body) == C.MSG_TYPE_BATCH:
+                        loop.create_task(self._batch_and_reply(body, writer))
+                        continue
                     try:
                         req = P.decode_request(body)
                     except (ValueError, struct.error, IndexError):
@@ -211,6 +223,22 @@ class ClusterTokenServer:
                         writer.write(
                             P.encode_response(
                                 P.ClusterResponse(req.xid, req.type, C.STATUS_OK)
+                            )
+                        )
+                        continue
+                    if req.type == C.MSG_TYPE_HELLO:
+                        # version negotiation: answer our protocol version
+                        # inline.  A v1 server never gets here — its
+                        # decoder rejects type HELLO, the frame is dropped
+                        # above, and the client's HELLO times out, pinning
+                        # the connection to v1 framing.
+                        writer.write(
+                            P.encode_response(
+                                P.ClusterResponse(
+                                    req.xid, req.type, C.STATUS_OK,
+                                    remaining=C.PROTOCOL_VERSION,
+                                    trace_id=req.trace_id, span_id=req.span_id,
+                                )
                             )
                         )
                         continue
@@ -288,6 +316,66 @@ class ClusterTokenServer:
             await writer.drain()
         except (ConnectionResetError, OSError):
             pass  # peer vanished mid-reply
+
+    async def _batch_and_reply(self, body: bytes, writer: asyncio.StreamWriter) -> None:
+        """Protocol-v2 BATCH frame: chaos pipe → strict decode → ONE
+        worker-pool decision over the whole frame.
+
+        Any transport mangling fails the WHOLE frame CLOSED: if the xid
+        is still readable the client gets a single frame-level
+        STATUS_FAIL covering every entry; otherwise the frame is dropped
+        and the client times out.  Partial answers are never applied."""
+        loop = asyncio.get_running_loop()
+        try:
+            breq = P.decode_batch_request(FP.pipe(_FP_BATCH, body))
+        except Exception:  # stlint: disable=fail-open — this handler IS the fail-closed path: the whole frame is answered STATUS_FAIL (or dropped), partial answers never applied
+            xid = None
+            if len(body) >= 4:
+                try:
+                    xid = struct.unpack_from(">i", body, 0)[0]
+                except struct.error:
+                    xid = None
+            if xid is not None:
+                rsp = P.ClusterBatchResponse(
+                    xid, C.STATUS_FAIL,
+                    np.zeros(0, np.int8), np.zeros(0, np.int32),
+                    np.zeros(0, np.int32), np.zeros(0, np.int64),
+                )
+                try:
+                    writer.write(P.encode_batch_response(rsp))
+                    await writer.drain()
+                except (ConnectionResetError, OSError):
+                    pass
+            return
+        rsp = await loop.run_in_executor(self._pool, self._process_batch, breq)
+        try:
+            writer.write(P.encode_batch_response(rsp))
+            await writer.drain()
+        except (ConnectionResetError, OSError):
+            pass  # peer vanished mid-reply
+
+    def _process_batch(self, breq: P.ClusterBatchRequest) -> P.ClusterBatchResponse:
+        n = len(breq)
+        # the frame's trace context rides this worker thread, so the
+        # column decision spans adopt the caller's trace id
+        with OT.maybe_ctx(breq.trace_id, breq.span_id):
+            try:
+                FP.hit(_FP_PROCESS)
+                statuses, remainings, waits, token_ids = self.service.decide_frame(
+                    breq.kinds, breq.ids, breq.counts, breq.flags
+                )
+                status = C.STATUS_OK
+            except Exception:  # stlint: disable=fail-open — whole-frame STATUS_FAIL: every entry degrades, none passes
+                record_log().exception("batch frame processing failed")
+                statuses = np.full(n, C.STATUS_FAIL, np.int8)
+                remainings = np.zeros(n, np.int32)
+                waits = np.zeros(n, np.int32)
+                token_ids = np.zeros(n, np.int64)
+                status = C.STATUS_FAIL
+        return P.ClusterBatchResponse(
+            breq.xid, status, statuses, remainings, waits, token_ids,
+            trace_id=breq.trace_id, span_id=breq.span_id,
+        )
 
     def _process(self, req: P.ClusterRequest) -> P.ClusterResponse:
         # install the frame's trace context on this worker thread so every

@@ -76,8 +76,17 @@ _FP_PROBE = FP.register(
 _FP_LEASE = FP.register(
     "cluster.shard.lease", "bounded-slack lease refresh round-trip", FP.HIT_ACTIONS
 )
+_FP_LEASE_ASYNC = FP.register(
+    "cluster.lease.refresh_async",
+    "ahead-of-exhaustion lease top-up dispatch",
+    FP.HIT_ACTIONS,
+)
 
 _REQ_HELP = "token requests routed by the sharded client, by owning shard"
+_LOCAL_ADMIT_HELP = (
+    "decisions admitted locally against a healthy shard's standing lease "
+    "(the zero-RPC fast path), by shard"
+)
 _FALLBACK_HELP = (
     "decisions served by the shard-local lease fallback while the owning "
     "shard is degraded, by verdict (pass = lease debit, block = fail-closed)"
@@ -99,14 +108,19 @@ def describe_fleets() -> List[dict]:
 
 class _Lease:
     """One flow's standing slack lease: ``granted`` tokens spendable
-    until ``expires_ms`` (wall clock, the wire's accounting domain)."""
+    until ``expires_ms`` (wall clock, the wire's accounting domain).
+    ``retry_at_ms`` backs off ahead-of-exhaustion top-ups after the
+    owner DENIED one while this lease still had spendable carry — the
+    carry keeps draining, but re-asking before the horizon would retry
+    a saturated budget on every local admit."""
 
-    __slots__ = ("granted", "used", "expires_ms")
+    __slots__ = ("granted", "used", "expires_ms", "retry_at_ms")
 
     def __init__(self, granted: int, expires_ms: int):
         self.granted = granted
         self.used = 0
         self.expires_ms = expires_ms
+        self.retry_at_ms = 0
 
 
 class _ShardState:
@@ -156,6 +170,9 @@ class _ShardState:
         self.c_lease_tokens = _OBS.counter(
             "sentinel_shard_lease_tokens_total", _LEASE_HELP, labels=labels
         )
+        self.c_local_admits = _OBS.counter(
+            "sentinel_lease_local_admits_total", _LOCAL_ADMIT_HELP, labels=labels
+        )
         # the shared degrade-hysteresis primitive (adaptive/degrade.py),
         # scoped to THIS shard: same journal kinds ("shard.degrade.*"),
         # counters and gauge as the hand-rolled state it replaced.  The
@@ -201,6 +218,17 @@ class ShardedTokenClient(TokenService):
     ``register_flow_rule`` — the ``ShardFleet``/RLS loaders call it; a
     client wired by hand must feed it the same rules its servers hold,
     or fallback (correctly) fails closed for unknown flows.
+
+    Lease-first admission (protocol v2): with ``lease_slack > 0`` the
+    standing lease is not just failover slack — it is the PRIMARY
+    admission path.  A healthy flow admits locally by debiting the
+    lease (zero RPCs) and tops the lease up in the background once the
+    spendable remainder dips under ``lease_refresh_frac`` of the grant
+    (or the TTL nears expiry).  Expiry still fails closed exactly as
+    before: an expired or spent lease routes the request remotely.
+    ``lease_refresh_async=False`` (or an armed chaos plan — see
+    ``_refresh_lease_soon``) runs the top-up inline on the admitting
+    thread, keeping failpoint hit counts a pure function of the seed.
     """
 
     def __init__(
@@ -213,12 +241,17 @@ class ShardedTokenClient(TokenService):
         lease_slack: float = 0.25,
         reconnect_interval_s: float = 2.0,
         clients: Optional[Dict[str, ClusterTokenClient]] = None,
+        lease_refresh_frac: float = 0.5,
+        lease_refresh_async: bool = True,
     ):
         if not members:
             raise ValueError("sharded client needs at least one member")
         self.namespace = namespace
         self.retry_interval_s = retry_interval_s
         self.lease_slack = float(lease_slack)
+        self.lease_refresh_frac = float(lease_refresh_frac)
+        self.lease_refresh_async = bool(lease_refresh_async)
+        self._refresher = _LeaseRefresher(self)
         self.ring = HashRing(sorted(members), vnodes=vnodes)
         self._order = sorted(members)  # index ↔ name, for composite token ids
         self._shards: Dict[str, _ShardState] = {}
@@ -252,6 +285,7 @@ class ShardedTokenClient(TokenService):
         # deregister FIRST: a closed client must drop out of the
         # GET /api/shards topology even while callers still hold a ref
         _FLEET_REGISTRY.discard(self)
+        self._refresher.close()
         for st in self._shards.values():
             st.client.close()
 
@@ -380,18 +414,16 @@ class ShardedTokenClient(TokenService):
         )
 
     def _maybe_refresh_lease(self, flow_id: int) -> None:
-        """Keep the owning shard's standing lease fresh while it is
-        healthy: at most one LEASE round-trip per validity window per
-        flow.  Failures are ignored — a missing lease just means the
-        fallback fails closed, which is the safe direction."""
-        units = self._lease_units(flow_id)
-        if units <= 0:
+        """Bootstrap/expiry refresh on the request path: at most one
+        blocking LEASE round-trip per validity window per flow, exactly
+        the pre-lease-first contract.  In the v2 steady state the
+        ahead-of-exhaustion top-up (``_refresh_lease_soon``) keeps the
+        lease from ever expiring, so this fires only for a flow's FIRST
+        request (or after an owner outage).  Failures are ignored — a
+        missing lease just means the fallback fails closed, which is
+        the safe direction."""
+        if self._lease_units(flow_id) <= 0:
             return
-        # the refresh is deliberately SYNCHRONOUS on the request path
-        # (one caller per flow per TTL window pays one extra RPC): a
-        # background refresher would make the LEASE failpoint fire at a
-        # nondeterministic point, breaking the chaos plane's
-        # injected-counts-are-a-pure-function-of-the-seed contract
         st = self._shards[self.ring.owner_of_flow(flow_id)]
         if st.degraded_active:
             # never refresh against a degraded shard — not even once the
@@ -408,9 +440,103 @@ class ShardedTokenClient(TokenService):
             if flow_id in st.lease_inflight:
                 return
             st.lease_inflight.add(flow_id)
+        self._refresh_lease_now(st, flow_id)
+
+    def _lease_admit(self, flow_id: int, count: int) -> Optional[TokenResult]:
+        """Lease-first fast path: admit locally against the standing
+        bounded-slack lease while the owner is HEALTHY — zero RPCs on
+        the request.  Returns ``None`` whenever the fast path does not
+        apply (leasing disabled, shard degraded, lease missing, spent,
+        or expired) and the caller routes remotely exactly as before —
+        expiry fails closed into the remote path, never a local pass.
+        Every grant here was debited from the global budget when the
+        lease was acquired, so local admits conserve tokens."""
+        if self.lease_slack <= 0 or count <= 0:
+            return None
+        st = self._shards[self.ring.owner_of_flow(flow_id)]
+        if st.degraded_active:
+            return None  # degraded flows use the metered fallback path
+        now = wall_ms_now()
+        refresh = False
+        with st.lock:
+            lease = st.leases.get(flow_id)
+            if (
+                lease is None
+                or lease.granted <= 0
+                or now >= lease.expires_ms
+                or lease.used + count > lease.granted
+            ):
+                return None
+            lease.used += count
+            remaining = lease.granted - lease.used
+            st.c_local_admits.inc()
+            # top up ahead of exhaustion: once the spendable remainder
+            # dips under refresh_frac of the grant — or the TTL enters
+            # its last quarter — schedule a background refresh so the
+            # NEXT admission window never pays a blocking RPC
+            low = remaining <= lease.granted * self.lease_refresh_frac
+            near = (lease.expires_ms - now) <= st.lease_ttl_hint_ms * 0.25
+            if (low or near) and now >= lease.retry_at_ms:
+                refresh = True
+        if refresh:
+            self._refresh_lease_soon(st, flow_id)
+        return TokenResult(C.STATUS_OK, remaining=remaining)
+
+    def _refresh_lease_soon(self, st: _ShardState, flow_id: int) -> None:
+        """Ahead-of-exhaustion top-up dispatch: claim the single-flight
+        marker and hand the RPC to the background refresher so the
+        admitting request never pays transport latency.  While a chaos
+        plan is armed — or ``lease_refresh_async=False`` — the hop runs
+        INLINE instead: a background worker would make the LEASE
+        failpoints fire at a nondeterministic point, breaking the chaos
+        plane's injected-counts-are-a-pure-function-of-the-seed
+        contract."""
+        with st.lock:
+            if flow_id in st.lease_inflight:
+                return
+            st.lease_inflight.add(flow_id)
+        if self.lease_refresh_async and not FP.is_armed():
+            self._refresher.enqueue(st, flow_id)
+            return
+        try:
+            FP.hit(_FP_LEASE_ASYNC)
+        except Exception:  # stlint: disable=fail-open — an injected dispatch fault skips ONE top-up; the lease keeps draining and fails closed at exhaustion
+            with st.lock:
+                st.lease_inflight.discard(flow_id)
+            return
+        self._refresh_lease_now(st, flow_id)
+
+    def flush_lease_refresh(self, timeout_s: float = 5.0) -> bool:
+        """Block until every queued ahead-of-exhaustion top-up has
+        drained (tests and the bench use this to sequence assertions
+        against the background refresher)."""
+        return self._refresher.flush(timeout_s)
+
+    def _lease_ask(self, st: _ShardState, flow_id: int) -> Tuple[int, int]:
+        """``(ask, units_total)`` for a top-up: the lease target minus
+        the still-spendable carry of the current lease."""
+        units_total = self._lease_units(flow_id)
+        if units_total <= 0:
+            return 0, 0
+        now = wall_ms_now()
+        with st.lock:
+            lease = st.leases.get(flow_id)
+            carry = 0
+            if lease is not None and now < lease.expires_ms:
+                carry = max(lease.granted - lease.used, 0)
+        return units_total - carry, units_total
+
+    def _refresh_lease_now(self, st: _ShardState, flow_id: int) -> None:
+        """Blocking lease top-up; the caller must already hold the
+        in-flight marker for this flow (single-flight)."""
+        ask, units_total = self._lease_ask(st, flow_id)
+        if ask <= 0:
+            with st.lock:
+                st.lease_inflight.discard(flow_id)
+            return
         try:
             FP.hit(_FP_LEASE)
-            r = st.client.request_lease(flow_id, units)
+            r = st.client.request_lease(flow_id, ask)
         except Exception:  # stlint: disable=fail-open — no lease acquired: the fallback path fails CLOSED for this flow
             with st.lock:
                 st.lease_inflight.discard(flow_id)
@@ -424,21 +550,45 @@ class ShardedTokenClient(TokenService):
             with st.lock:
                 st.lease_inflight.discard(flow_id)
             return
+        self._store_lease_result(st, flow_id, r, units_total)
+
+    def _store_lease_result(
+        self, st: _ShardState, flow_id: int, r: TokenResult, units_total: int
+    ) -> None:
+        """Fold one grant/denial into the standing lease, in the SAME
+        critical section that clears the in-flight marker:
+        discard-then-store would let another thread slip in between and
+        double-debit the budget."""
         if r.status == C.STATUS_OK and r.remaining > 0:
             st.c_lease_tokens.inc(r.remaining)
+        now = wall_ms_now()
         with st.lock:
-            # store the result in the SAME critical section that clears
-            # the in-flight marker: discard-then-store would let another
-            # thread slip in between and double-debit the budget
             st.lease_inflight.discard(flow_id)
             if int(flow_id) not in self._rule_counts:
                 # the rule was dropped while the RPC was in flight —
                 # storing the grant would resurrect a deleted rule's
                 # standing lease past register_flow_rule's eviction
                 return
+            lease = st.leases.get(flow_id)
+            carry = 0
+            if lease is not None and now < lease.expires_ms:
+                # recompute the carry NOW — local admits kept debiting
+                # while the RPC was in flight, so the grant folds onto
+                # whatever is genuinely left (bounded by units_total:
+                # a shrunken carry only under-fills, never over)
+                carry = max(lease.granted - lease.used, 0)
             if r.status == C.STATUS_OK and r.remaining > 0:
                 st.lease_ttl_hint_ms = max(r.wait_ms, 1)
-                st.leases[flow_id] = _Lease(r.remaining, now + max(r.wait_ms, 1))
+                st.leases[flow_id] = _Lease(
+                    min(carry + r.remaining, units_total),
+                    now + max(r.wait_ms, 1),
+                )
+            elif carry > 0:
+                # top-up DENIED but the standing lease still has carry:
+                # keep draining it and just back off further asks until
+                # the denial horizon — replacing it with a zero-lease
+                # would throw away slack the budget already paid for
+                lease.retry_at_ms = now + max(r.wait_ms, st.lease_ttl_hint_ms)
             else:
                 # cache the DENIAL too: a saturated flow otherwise
                 # retries a blocking LEASE round-trip on every request
@@ -449,6 +599,45 @@ class ShardedTokenClient(TokenService):
                 st.leases[flow_id] = _Lease(
                     0, now + max(r.wait_ms, st.lease_ttl_hint_ms)
                 )
+
+    def _refresh_leases_batch(self, st: _ShardState, flow_ids: List[int]) -> None:
+        """Background top-up for several of one shard's flows at once:
+        a v2 peer answers them as ONE batched LEASE frame (one
+        round-trip for the whole group), a v1 peer gets pipelined
+        individual requests.  The caller (the refresher thread) already
+        holds every flow's in-flight marker."""
+        if st.degraded_active:
+            with st.lock:
+                for fid in flow_ids:
+                    st.lease_inflight.discard(fid)
+            return
+        live: List[Tuple[int, int]] = []  # (flow_id, units_total)
+        entries: List[Tuple[int, int, int]] = []
+        for fid in flow_ids:
+            ask, units_total = self._lease_ask(st, fid)
+            if ask <= 0:
+                with st.lock:
+                    st.lease_inflight.discard(fid)
+                continue
+            live.append((fid, units_total))
+            entries.append((C.BATCH_KIND_LEASE, fid, ask))
+        if not live:
+            return
+        try:
+            FP.hit(_FP_LEASE)
+            results = st.client.request_batch(entries)
+        except Exception:  # stlint: disable=fail-open — no lease acquired: the fallback fails CLOSED for these flows
+            with st.lock:
+                for fid, _ in live:
+                    st.lease_inflight.discard(fid)
+            return
+        for (fid, units_total), r in zip(live, results):
+            if r.status == C.STATUS_FAIL:
+                # transport-shaped — leave uncached (see _refresh_lease_now)
+                with st.lock:
+                    st.lease_inflight.discard(fid)
+                continue
+            self._store_lease_result(st, fid, r, units_total)
 
     def _fallback_flow(self, st: _ShardState, flow_id: int, count: int) -> TokenResult:
         """Shard-local decision while the owner is unreachable: debit the
@@ -479,6 +668,9 @@ class ShardedTokenClient(TokenService):
     def request_token(
         self, flow_id: int, count: int = 1, prioritized: bool = False
     ) -> TokenResult:
+        r = self._lease_admit(flow_id, count)
+        if r is not None:
+            return r
         r = self._call(
             flow_id,
             lambda c: c.request_token(flow_id, count, prioritized),
@@ -489,6 +681,10 @@ class ShardedTokenClient(TokenService):
         return r
 
     def request_token_batch(self, flow_id: int, units: int) -> TokenResult:
+        r = self._lease_admit(flow_id, units)
+        if r is not None:
+            return TokenResult(C.STATUS_OK, remaining=units)
+
         def _fb(st: _ShardState) -> TokenResult:
             r = self._fallback_flow(st, flow_id, units)
             if r.status == C.STATUS_OK:
@@ -501,6 +697,79 @@ class ShardedTokenClient(TokenService):
         if r.status in (C.STATUS_OK, C.STATUS_SHOULD_WAIT, C.STATUS_BLOCKED):
             self._maybe_refresh_lease(flow_id)
         return r
+
+    def request_token_many(
+        self, requests: Sequence[Tuple[int, int]]
+    ) -> List[TokenResult]:
+        """Admit many ``(flow_id, count)`` asks in one pass: lease-local
+        admits cost nothing, and whatever must route remotely is grouped
+        per owning shard into ONE protocol-v2 batch frame each (a v1
+        peer gets a pipelined burst over the same multiplexed socket).
+        The RLS front door drives multi-descriptor requests through
+        this instead of one blocking round-trip per descriptor."""
+        out: List[Optional[TokenResult]] = [None] * len(requests)
+        per: Dict[str, List[int]] = {}
+        for i, (fid, cnt) in enumerate(requests):
+            r = self._lease_admit(fid, cnt)
+            if r is not None:
+                out[i] = r
+                continue
+            per.setdefault(self.ring.owner_of_flow(fid), []).append(i)
+        for name, idxs in per.items():
+            st = self._shards[name]
+            st.c_requests.inc(len(idxs))
+            entries = [
+                (C.BATCH_KIND_FLOW, requests[i][0], requests[i][1]) for i in idxs
+            ]
+            rs = self._call_batch(st, entries)
+            if rs is None:
+                for i in idxs:
+                    out[i] = self._fallback_flow(st, requests[i][0], requests[i][1])
+                continue
+            for i, r in zip(idxs, rs):
+                out[i] = r
+            for i in idxs:
+                if out[i].status in (
+                    C.STATUS_OK,
+                    C.STATUS_SHOULD_WAIT,
+                    C.STATUS_BLOCKED,
+                ):
+                    self._maybe_refresh_lease(requests[i][0])
+        return [r if r is not None else TokenResult(C.STATUS_FAIL) for r in out]
+
+    def _call_batch(
+        self, st: _ShardState, entries: List[Tuple[int, int, int]]
+    ) -> Optional[List[TokenResult]]:
+        """One shard's slice of a many-flow request, under the same
+        failover protocol as ``_call``.  Returns ``None`` when the
+        exchange failed at the transport level (the caller serves every
+        entry from the lease fallback)."""
+        degraded = st.degraded_active
+        if degraded:
+            if mono_s() < st.degraded_until:
+                return None
+            if not st.probe_lock.acquire(blocking=False):
+                return None
+        try:
+            if degraded:
+                FP.hit(_FP_PROBE)
+            FP.hit(_FP_ROUTE)
+            rs = st.client.request_batch(entries)
+        except Exception:  # stlint: disable=fail-open — degrade to the shard-local lease fallback (fail-closed when no lease), never PASS
+            self._enter_degraded(st)
+            return None
+        finally:
+            if degraded:
+                st.probe_lock.release()
+        if rs and all(r.status == C.STATUS_FAIL for r in rs):
+            # request_batch fails closed as a UNIT on transport trouble
+            # (whole-frame FAIL, timeout, dead socket), so all-FAIL is
+            # the batched shape of a single STATUS_FAIL round-trip
+            self._enter_degraded(st)
+            return None
+        if degraded:
+            self._exit_degraded(st)
+        return rs
 
     def request_param_token(
         self, flow_id: int, count: int, params: List
@@ -553,6 +822,110 @@ class ShardedTokenClient(TokenService):
             return st.client.release_concurrent_token(raw)
         except Exception:  # stlint: disable=fail-open — a lost release expires via the server-side TTL sweep; never PASSes anything
             return TokenResult(C.STATUS_FAIL)
+
+
+class _LeaseRefresher:
+    """Background lease top-up worker for one ``ShardedTokenClient``:
+    the admitting thread only enqueues ``(shard, flow)``; this thread
+    drains the queue and groups everything bound for the same shard
+    into one batched LEASE exchange (``_refresh_leases_batch``).  The
+    thread starts lazily on the first enqueue, so clients that never
+    trigger an async top-up (slack 0, chaos runs, ``lease_refresh_async
+    =False``) cost nothing.  Every queued flow's single-flight marker
+    is already held by the enqueuer; whatever drops out of the queue —
+    including at ``close()`` — must release it."""
+
+    def __init__(self, client: "ShardedTokenClient"):
+        # weakref: the refresher thread must not pin a dropped client
+        # (close() also stops it explicitly, but tests that leak
+        # clients still shouldn't leak fleets through the daemon)
+        self._client = weakref.ref(client)
+        self._cv = threading.Condition()
+        self._q: List[Tuple[_ShardState, int]] = []
+        self._busy = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def enqueue(self, st: _ShardState, flow_id: int) -> None:
+        with self._cv:
+            if not self._closed:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._run, name="sentinel-lease-refresh", daemon=True
+                    )
+                    self._thread.start()
+                self._q.append((st, flow_id))
+                self._cv.notify()
+                return
+        with st.lock:
+            st.lease_inflight.discard(flow_id)
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Wait until the queue is empty AND no drain is in progress."""
+        deadline = mono_s() + timeout_s
+        with self._cv:
+            while self._q or self._busy:
+                left = deadline - mono_s()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=left)
+        return True
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            pending, self._q = self._q, []
+            self._cv.notify_all()
+        for st, fid in pending:
+            with st.lock:
+                st.lease_inflight.discard(fid)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                batch, self._q = self._q, []
+                self._busy = True
+            try:
+                per: Dict[str, List[int]] = {}
+                states: Dict[str, _ShardState] = {}
+                for st, fid in batch:
+                    states[st.name] = st
+                    per.setdefault(st.name, []).append(fid)
+                client = self._client()
+                if client is None:
+                    for st, fid in batch:
+                        with st.lock:
+                            st.lease_inflight.discard(fid)
+                elif len(per) == 1:
+                    ((name, fids),) = per.items()
+                    client._refresh_leases_batch(states[name], fids)
+                else:
+                    # one blocking exchange PER OWNING SHARD — issued
+                    # concurrently, not in a serial loop: each shard's
+                    # connection is independently multiplexed, and a
+                    # serial sweep would charge one drain cycle the SUM
+                    # of every shard's round-trip (the fleet's lease
+                    # capacity would then shrink as shards are added)
+                    hops = [
+                        threading.Thread(
+                            target=client._refresh_leases_batch,
+                            args=(states[name], fids),
+                            daemon=True,
+                        )
+                        for name, fids in per.items()
+                    ]
+                    for h in hops:
+                        h.start()
+                    for h in hops:
+                        h.join()
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
 
 
 class _ClientFlowRules:

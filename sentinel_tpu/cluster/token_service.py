@@ -26,8 +26,11 @@ from __future__ import annotations
 import itertools
 import threading
 import time as _time
+from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from sentinel_tpu.chaos import failpoints as FP
 from sentinel_tpu.cluster import constants as C
@@ -54,6 +57,10 @@ _C_DECISIONS = _OBS.counter(
 _C_SHED = _OBS.counter(
     "sentinel_token_shed_total",
     "token requests shed before the engine (namespace guard or backpressure)",
+)
+_C_BATCHED = _OBS.counter(
+    "sentinel_cluster_batched_decisions_total",
+    "token entries decided by the device column kernel (ops/token_col.py)",
 )
 
 #: chaos failpoint on the decision path: a raise here exercises every
@@ -221,6 +228,280 @@ class ConcurrentTokenManager:
             return len(dead)
 
 
+class TokenColumnBatcher:
+    """Coalesces token decisions into one jitted device column call.
+
+    Every decision entry path — the blocking API, the thread-free TCP
+    FLOW path, and whole protocol-v2 BATCH frames from many connections
+    — submits ``(flow_id, units, partial)`` entries here; a worker
+    thread drains the queue and answers a whole chunk with ONE
+    ``ops/token_col.decide_batch`` call.  All paths therefore debit the
+    SAME device-resident budget ledger (the per-slot sliding window IS
+    the ledger), so coalescing can never double-admit against a separate
+    engine-side account.
+
+    Entries are presorted by slot host-side (native batch_sort3, stable)
+    and rebased prefix sums inside the kernel make one coalesced batch
+    admit exactly what sequential requests would have.
+
+    Slot assignment is stable across rule pushes: retained flows keep
+    their row (the standing ledger survives a reprojection, matching the
+    engine tier where windows persist across rule reloads); dropped
+    flows release their row with its ledger zeroed before reuse.
+    """
+
+    #: entries per device call — one compiled shape per slot capacity;
+    #: bigger drains chunk sequentially (same-slot carry is exact: the
+    #: window is updated between chunks)
+    CAPACITY = 256
+
+    def __init__(self, service: "DefaultTokenService"):
+        # lazy heavyweight imports: the cluster codec/client modules must
+        # stay importable without dragging jax in
+        from sentinel_tpu.native import ring as NR
+        from sentinel_tpu.obs import timeline as TLM
+        from sentinel_tpu.ops import token_col as TC
+
+        self._TC = TC
+        self._NR = NR
+        self._TLM = TLM
+        self.svc = service
+        # per-window cumulative [TL_COLS] rows fed to the decision
+        # client's TimelineRecorder: the col path answers off-engine, so
+        # it must land the same per-second `$cluster/flow/<id>` rows the
+        # engine's device top-K matrix used to produce (worker-thread
+        # only — no lock needed beyond the recorder's own)
+        self._tl_wid = -1
+        self._tl_acc: Dict[int, np.ndarray] = {}
+        self._tl_rids: Dict[int, int] = {}
+        self._q_lock = threading.Lock()
+        self._cv = threading.Condition(self._q_lock)
+        self._pending: List[tuple] = []  # (flow_id, units, partial, Future)
+        self._s_lock = threading.Lock()  # slots + device state
+        self._slots: Dict[int, int] = {}
+        self._free: List[int] = []
+        self._next_slot = 0
+        self._cap = 8
+        self._state = TC.init_state(self._cap)
+        self._decide = TC.jitted_decide()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="sentinel-token-col", daemon=True
+        )
+        self._worker.start()
+
+    def pending_entries(self) -> int:
+        return len(self._pending)
+
+    def submit(
+        self, flow_id: int, units: int, partial: bool, forced: bool = False
+    ) -> "Future":
+        """Enqueue one decision entry; resolves to granted units (int).
+        A flow whose rule dropped between guard and decide grants 0 —
+        fail closed, like every ambiguity on this path.  ``forced``
+        charges unconditionally (the occupy-ahead emulation)."""
+        f: Future = Future()
+        with self._cv:
+            if self._closed:
+                f.set_exception(RuntimeError("token column batcher closed"))
+                return f
+            self._pending.append((flow_id, units, partial, forced, f))
+            self._cv.notify()
+        return f
+
+    def ms_to_next_bucket(self, now_ms: int) -> int:
+        return self._TC.ms_to_next_bucket(int(now_ms))
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def warm(self) -> None:
+        """Pay the XLA compile for the current capacity off the request
+        path — a cold first decision would outlive entry timeouts and
+        read as a dead shard (the ShardFleet warm lesson)."""
+        with self._s_lock:
+            self._warm_locked()
+
+    def _warm_locked(self) -> None:
+        TC = self._TC
+        now = np.int32(int(self.svc.client.time.now_ms()))
+        slots = np.zeros(self.CAPACITY, np.int32)
+        units = np.zeros(self.CAPACITY, np.int32)
+        heads = np.arange(self.CAPACITY, dtype=np.int32)
+        partial = np.zeros(self.CAPACITY, bool)
+        forced = np.zeros(self.CAPACITY, bool)
+        g, self._state = self._decide(
+            self._state, now, slots, units, heads, partial, forced
+        )
+        np.asarray(g)  # block until the executable is built
+
+    def project(self, thresholds: Dict[int, float]) -> None:
+        """Rebuild slot map + per-slot limits from a rule/census push.
+        Retained flows keep their slot AND their standing window ledger;
+        recycled and grown rows start zeroed."""
+        import jax.numpy as jnp
+
+        TC = self._TC
+        W = TC.W
+        with self._s_lock:
+            zero_rows: List[int] = []
+            for fid in [f for f in self._slots if f not in thresholds]:
+                s = self._slots.pop(fid)
+                self._free.append(s)
+            for fid in thresholds:
+                if fid not in self._slots:
+                    if self._free:
+                        s = self._free.pop()
+                        zero_rows.append(s)  # no inherited ledger
+                    else:
+                        s = self._next_slot
+                        self._next_slot += 1
+                    self._slots[fid] = s
+            cap = self._cap
+            while cap < self._next_slot:
+                cap *= 2
+            if zero_rows or cap != self._cap:
+                counts = np.zeros(
+                    (cap,) + tuple(self._state.win.counts.shape[1:]), np.int32
+                )
+                rt_sum = np.zeros((cap,) + tuple(self._state.win.rt_sum.shape[1:]), np.float32)
+                rt_min = np.full(
+                    (cap,) + tuple(self._state.win.rt_min.shape[1:]),
+                    W.RT_MIN_INIT,
+                    np.float32,
+                )
+                old = self._cap
+                counts[:old] = np.asarray(self._state.win.counts)
+                rt_sum[:old] = np.asarray(self._state.win.rt_sum)
+                rt_min[:old] = np.asarray(self._state.win.rt_min)
+                if zero_rows:
+                    counts[zero_rows] = 0
+                    rt_sum[zero_rows] = 0.0
+                    rt_min[zero_rows] = W.RT_MIN_INIT
+                win = W.WindowState(
+                    counts=jnp.asarray(counts),
+                    rt_sum=jnp.asarray(rt_sum),
+                    rt_min=jnp.asarray(rt_min),
+                    epochs=self._state.win.epochs,
+                )
+                grew = cap != self._cap
+                self._state = TC.TokenColState(win=win, limits=self._state.limits)
+                self._cap = cap
+            else:
+                grew = False
+            limits = np.zeros(cap, np.float32)
+            for fid, thr in thresholds.items():
+                limits[self._slots[fid]] = thr
+            self._state = TC.set_limits(self._state, jnp.asarray(limits))
+            if grew:
+                # rule pushes pay the new shape's compile, requests don't
+                self._warm_locked()
+
+    # -- worker -------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending and self._closed:
+                    return
+                batch, self._pending = self._pending, []
+            try:
+                now = int(self.svc.client.time.now_ms())
+                with self._s_lock:
+                    for i in range(0, len(batch), self.CAPACITY):
+                        self._decide_chunk(batch[i : i + self.CAPACITY], now)
+            except Exception as e:  # stlint: disable=fail-open — a failed future is STATUS_FAIL at every caller: degrade, never PASS
+                for *_, f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+
+    def _decide_chunk(self, chunk: List[tuple], now: int) -> None:
+        n = len(chunk)
+        raw_slots = np.zeros(n, np.int32)
+        raw_units = np.zeros(n, np.int32)
+        raw_partial = np.zeros(n, bool)
+        raw_forced = np.zeros(n, bool)
+        for i, (fid, u, p, fo, _f) in enumerate(chunk):
+            s = self._slots.get(fid, -1)
+            if s >= 0 and u > 0:
+                raw_slots[i] = s
+                raw_units[i] = u  # unknown/dropped flows keep units 0 → granted 0
+            raw_partial[i] = bool(p)
+            raw_forced[i] = bool(fo)
+        z = np.zeros(n, np.int32)
+        order, _ = self._NR.batch_sort3(raw_slots, z, z, want_inv=False)
+        s_sorted = raw_slots[order]
+        u_sorted = raw_units[order]
+        slots = np.zeros(self.CAPACITY, np.int32)
+        units = np.zeros(self.CAPACITY, np.int32)
+        partial = np.zeros(self.CAPACITY, bool)
+        forced = np.zeros(self.CAPACITY, bool)
+        heads = np.arange(self.CAPACITY, dtype=np.int32)
+        slots[:n], units[:n] = s_sorted, u_sorted
+        partial[:n], forced[:n] = raw_partial[order], raw_forced[order]
+        if n:
+            newseg = np.ones(n, bool)
+            newseg[1:] = s_sorted[1:] != s_sorted[:-1]
+            heads[:n] = np.maximum.accumulate(
+                np.where(newseg, np.arange(n), 0)
+            ).astype(np.int32)
+        g, self._state = self._decide(
+            self._state, np.int32(now), slots, units, heads, partial, forced
+        )
+        granted = np.empty(n, np.int32)
+        granted[order] = np.asarray(g)[:n]
+        _C_BATCHED.inc(n)
+        self._note_timeline(chunk, granted, now)
+        for i, (_fid, _u, _p, _fo, f) in enumerate(chunk):
+            if not f.done():
+                f.set_result(int(granted[i]))
+
+    def _note_timeline(self, chunk: List[tuple], granted: np.ndarray, now: int) -> None:
+        """Land this chunk's verdicts in the decision client's timeline.
+
+        The recorder keeps the LATEST cumulative row per (window,
+        resource), so this accumulates per-window pass/block counts and
+        re-emits the whole current window each call — byte-for-byte the
+        contract of the engine's device top-K matrix, minus the stages
+        (rt/concurrency) a token verdict doesn't have."""
+        TLM = self._TLM
+        tl = self.svc.client.timeline
+        if tl is None:
+            return
+        wid = int(now) // tl.window_ms
+        if wid != self._tl_wid:
+            # the recorder already holds the previous window's final
+            # cumulative rows; only the open window needs an accumulator
+            self._tl_wid = wid
+            self._tl_acc.clear()
+        for i, (fid, u, p, fo, _f) in enumerate(chunk):
+            rid = self._tl_rids.get(fid)
+            if rid is None:
+                rid = self.svc.client.registry.resource_id(flow_resource(fid))
+                if rid is None:
+                    continue  # registry exhausted: stats degrade, verdicts don't
+                self._tl_rids[fid] = rid
+            row = self._tl_acc.get(rid)
+            if row is None:
+                row = np.zeros(8, np.float32)  # ops/engine TL_COLS layout
+                row[TLM.TL_RID] = rid
+                row[TLM.TL_RT_MIN] = TLM._RT_MIN_INIT
+                self._tl_acc[rid] = row
+            g = int(granted[i])
+            ok = fo or g >= u or (p and g > 0)
+            row[TLM.TL_PASS if ok else TLM.TL_BLOCK] += 1.0
+        if self._tl_acc:
+            tl.note_tick(
+                np.stack(list(self._tl_acc.values())),
+                now,
+                int(self.svc.client.time.wall_ms(now)) - int(now),
+            )
+
+
 class DefaultTokenService(TokenService):
     """Engine-backed token service.
 
@@ -242,17 +523,33 @@ class DefaultTokenService(TokenService):
         connected_count_fn: Optional[Callable[[str], int]] = None,
         concurrent_ttl_ms: int = 5000,
         lease_ttl_ms: int = C.DEFAULT_LEASE_TTL_MS,
+        use_token_column: bool = True,
     ):
         self.client = decision_client
         self.lease_ttl_ms = lease_ttl_ms
         self.config = config or ClusterServerConfigManager()
         self.connected_count_fn = connected_count_fn or (lambda ns: 1)
+        # device column batcher first: _reproject (fired by every rule
+        # push below) projects thresholds into it
+        self.col = TokenColumnBatcher(self) if use_token_column else None
         self.flow_rules = ClusterFlowRuleManager(on_change=self._reproject)
         self.param_rules = ClusterParamFlowRuleManager(on_change=self._reproject)
         self.limiter = GlobalRequestLimiter(self.config)
         self.concurrent = ConcurrentTokenManager(ttl_ms=concurrent_ttl_ms)
         self.config.add_listener(self._reproject)
         self._lock = threading.Lock()
+        if self.col is not None:
+            self.col.warm()
+
+    def warm(self) -> None:
+        """Compile the device decision path off the request clock (a cold
+        first decision outlives entry timeouts and reads as a dead shard)."""
+        if self.col is not None:
+            self.col.warm()
+
+    def close(self) -> None:
+        if self.col is not None:
+            self.col.close()
 
     # -- projection onto the engine ----------------------------------------
 
@@ -269,15 +566,18 @@ class DefaultTokenService(TokenService):
         """Rebuild the decision client's engine rules from cluster rules."""
         with self._lock:
             flow = []
+            thresholds: Dict[int, float] = {}
             for fid in self.flow_rules.all_ids():
                 rule = self.flow_rules.get_by_id(fid)
                 if rule is None:
                     continue  # unloaded between snapshot and lookup
                 ns = self.flow_rules.namespace_of(fid) or C.DEFAULT_NAMESPACE
+                thr = self._global_threshold(rule, ns)
+                thresholds[fid] = thr
                 flow.append(
                     R.FlowRule(
                         resource=flow_resource(fid),
-                        count=self._global_threshold(rule, ns),
+                        count=thr,
                         grade=R.GRADE_QPS,
                     )
                 )
@@ -298,6 +598,8 @@ class DefaultTokenService(TokenService):
                 )
             self.client.flow_rules.load(flow)
             self.client.param_flow_rules.load(param)
+            if self.col is not None:
+                self.col.project(thresholds)
 
     def refresh_connected_count(self) -> None:
         """Call when the connection census changes.  Only AVG_LOCAL rules
@@ -342,6 +644,63 @@ class DefaultTokenService(TokenService):
         if not self.limiter.try_pass(ns, self.client.time.now_ms()):
             _C_SHED.inc()
             done.set_result(TokenResult(C.STATUS_TOO_MANY_REQUEST))
+            return done
+        if self.col is not None:
+            if self.col.pending_entries() > 4 * TokenColumnBatcher.CAPACITY:
+                _C_SHED.inc()
+                done.set_result(TokenResult(C.STATUS_TOO_MANY_REQUEST))
+                return done
+            if count <= 0:  # zero-unit ask: nothing to debit
+                _C_DECISIONS.inc()
+                done.set_result(TokenResult(C.STATUS_OK))
+                return done
+            _span = OT.TRACER.begin("token.decision", flow_id=flow_id)
+            cf = self.col.submit(flow_id, count, partial=False)
+
+            def _chain_col(fut):
+                _C_DECISIONS.inc()
+                if _span is not None:
+                    OT.stage_ns(
+                        "token.decision",
+                        _span.t0_ns,
+                        OT.now_ns() - _span.t0_ns,
+                        _H_DECISION,
+                        trace=_span.trace,
+                        attrs=_span.attrs,
+                    )
+                try:
+                    granted = fut.result()
+                except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
+                    done.set_result(TokenResult(C.STATUS_FAIL))
+                    return
+                if granted >= count:
+                    done.set_result(TokenResult(C.STATUS_OK))
+                    return
+                if not prioritized:
+                    done.set_result(TokenResult(C.STATUS_BLOCKED))
+                    return
+                # occupy-ahead emulation: charge the ask unconditionally
+                # (debits the CURRENT bucket — one earlier than the
+                # engine's tryOccupyNext, the conservative direction) and
+                # tell the caller to sleep into the next bucket
+                f2 = self.col.submit(flow_id, count, partial=False, forced=True)
+
+                def _chain_occ(fut2):
+                    try:
+                        fut2.result()
+                    except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
+                        done.set_result(TokenResult(C.STATUS_FAIL))
+                        return
+                    wait = self.col.ms_to_next_bucket(
+                        int(self.client.time.now_ms())
+                    )
+                    done.set_result(
+                        TokenResult(C.STATUS_SHOULD_WAIT, wait_ms=wait)
+                    )
+
+                f2.add_done_callback(_chain_occ)
+
+            cf.add_done_callback(_chain_col)
             return done
         # backpressure: with the thread-free TCP path nothing else bounds
         # in-flight requests, so shed load once the acquire queue exceeds a
@@ -404,6 +763,20 @@ class DefaultTokenService(TokenService):
         if not self.limiter.try_pass(ns, self.client.time.now_ms()):
             _C_SHED.inc()
             return TokenResult(C.STATUS_TOO_MANY_REQUEST)
+        if self.col is not None:
+            with OT.TRACER.span("token.decision_batch", flow_id=flow_id, units=units):
+                try:
+                    granted = int(
+                        self.col.submit(flow_id, units, partial=True).result(
+                            timeout=self.client.entry_timeout_s
+                        )
+                    )
+                except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
+                    return TokenResult(C.STATUS_FAIL)
+            _C_DECISIONS.inc(units)
+            if granted == 0:
+                return TokenResult(C.STATUS_BLOCKED, remaining=0)
+            return TokenResult(C.STATUS_OK, remaining=granted)
         with OT.TRACER.span("token.decision_batch", flow_id=flow_id, units=units):
             results = self.client.check_batch([flow_resource(flow_id)] * units)
         _C_DECISIONS.inc(units)
@@ -456,3 +829,104 @@ class DefaultTokenService(TokenService):
     def release_concurrent_token(self, token_id: int) -> TokenResult:
         ok = self.concurrent.release(token_id)
         return TokenResult(C.STATUS_RELEASE_OK if ok else C.STATUS_ALREADY_RELEASE)
+
+    # -- protocol v2 BATCH frames -------------------------------------------
+
+    def decide_frame(
+        self, kinds, ids, counts, flags
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Answer one protocol-v2 BATCH frame's entry columns.
+
+        Host-side guards (rule lookup, namespace limiter, validation) run
+        per entry; every surviving entry joins ONE column submission, so a
+        frame carrying a hundred flows costs one device decision.  Entry
+        kinds map onto the existing verdict surface:
+
+          BATCH_KIND_FLOW        all-or-nothing → OK / BLOCKED
+          BATCH_KIND_FLOW_BATCH  partial grant  → OK(remaining=granted) / BLOCKED
+          BATCH_KIND_LEASE       MAX_LEASE_UNITS-clamped partial grant;
+                                 wait_ms carries the lease TTL
+
+        The prioritized flag has no occupy-ahead on the column path: an
+        over-limit prioritized entry is BLOCKED (fail closed), never
+        SHOULD_WAIT.  Returns (statuses i8, remainings i32, waits i32,
+        token_ids i64) aligned with the request entries.
+        """
+        n = len(kinds)
+        # seed FAIL, not OK: any entry a bug leaves untouched must read as
+        # a failure the client degrades on, never as a grant
+        statuses = np.full(n, C.STATUS_FAIL, np.int8)
+        remainings = np.zeros(n, np.int32)
+        waits = np.zeros(n, np.int32)
+        token_ids = np.zeros(n, np.int64)
+        if self.col is None:
+            for i in range(n):
+                kind, fid, cnt = int(kinds[i]), int(ids[i]), int(counts[i])
+                prio = bool(int(flags[i]) & C.BATCH_FLAG_PRIORITIZED)
+                if kind == C.BATCH_KIND_FLOW:
+                    r = self.request_token(fid, cnt, prio)
+                elif kind == C.BATCH_KIND_FLOW_BATCH:
+                    r = self.request_token_batch(fid, cnt)
+                elif kind == C.BATCH_KIND_LEASE:
+                    r = self.request_lease(fid, cnt)
+                else:
+                    r = TokenResult(C.STATUS_BAD_REQUEST)
+                statuses[i] = r.status
+                remainings[i] = r.remaining
+                waits[i] = r.wait_ms
+                token_ids[i] = r.token_id
+            return statuses, remainings, waits, token_ids
+        now = self.client.time.now_ms()
+        futs: List[Future] = []
+        meta: List[Tuple[int, int, int]] = []
+        for i in range(n):
+            FP.hit(_FP_DECIDE)
+            kind, fid, cnt = int(kinds[i]), int(ids[i]), int(counts[i])
+            if kind not in (
+                C.BATCH_KIND_FLOW,
+                C.BATCH_KIND_FLOW_BATCH,
+                C.BATCH_KIND_LEASE,
+            ):
+                statuses[i] = C.STATUS_BAD_REQUEST
+                continue
+            rule = self.flow_rules.get_by_id(fid)
+            if rule is None:
+                statuses[i] = C.STATUS_NO_RULE
+                continue
+            if cnt <= 0:
+                # a zero-unit all-or-nothing ask requests nothing and
+                # passes; a zero/negative batch or lease ask is malformed
+                statuses[i] = (
+                    C.STATUS_OK
+                    if kind == C.BATCH_KIND_FLOW and cnt == 0
+                    else C.STATUS_BAD_REQUEST
+                )
+                continue
+            ns = self.flow_rules.namespace_of(fid) or C.DEFAULT_NAMESPACE
+            if not self.limiter.try_pass(ns, now):
+                _C_SHED.inc()
+                statuses[i] = C.STATUS_TOO_MANY_REQUEST
+                continue
+            units = min(cnt, C.MAX_LEASE_UNITS) if kind == C.BATCH_KIND_LEASE else cnt
+            futs.append(
+                self.col.submit(fid, units, partial=kind != C.BATCH_KIND_FLOW)
+            )
+            meta.append((i, kind, units))
+        timeout = self.client.entry_timeout_s
+        for f, (i, kind, units) in zip(futs, meta):
+            try:
+                granted = int(f.result(timeout=timeout))
+            except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
+                statuses[i] = C.STATUS_FAIL
+                continue
+            _C_DECISIONS.inc(1 if kind == C.BATCH_KIND_FLOW else units)
+            if kind == C.BATCH_KIND_FLOW:
+                statuses[i] = C.STATUS_OK if granted >= units else C.STATUS_BLOCKED
+            elif granted == 0:
+                statuses[i] = C.STATUS_BLOCKED
+            else:
+                statuses[i] = C.STATUS_OK
+                remainings[i] = granted
+                if kind == C.BATCH_KIND_LEASE:
+                    waits[i] = self.lease_ttl_ms
+        return statuses, remainings, waits, token_ids

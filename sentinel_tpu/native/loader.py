@@ -141,6 +141,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sx_batch_sort5.argtypes = [i64] + [p] * 7
     lib.sx_batch_sort3.restype = i64
     lib.sx_batch_sort3.argtypes = [i64] + [p] * 5
+    # protocol v2 BATCH framing (big-endian column entries <-> int columns)
+    lib.sx_frame_pack_entries.restype = i64
+    lib.sx_frame_pack_entries.argtypes = [i64] + [p] * 5
+    lib.sx_frame_unpack_entries.restype = i64
+    lib.sx_frame_unpack_entries.argtypes = [i64] + [p] * 5
+    lib.sx_frame_pack_results.restype = i64
+    lib.sx_frame_pack_results.argtypes = [i64] + [p] * 5
+    lib.sx_frame_unpack_results.restype = i64
+    lib.sx_frame_unpack_results.argtypes = [i64] + [p] * 5
     return lib
 
 

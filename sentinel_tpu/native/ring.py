@@ -202,6 +202,116 @@ def batch_sort5(k0, k1, k2, k3, k4, want_inv: bool = True):
     return order, inv
 
 
+# ---------------------------------------------------------------------------
+# protocol v2 BATCH framing (cluster/protocol.py)
+# ---------------------------------------------------------------------------
+#
+# Fixed-width big-endian column entries; the native pack/unpack loop and
+# the numpy structured-dtype fallback produce IDENTICAL bytes (pinned by
+# tests/test_native.py parity tests), so peers built with and without a
+# toolchain interoperate bit-exactly.
+
+BATCH_ENTRY_SIZE = 14  # [kind:u8][id:i64][count:i32][flags:u8]
+BATCH_RESULT_SIZE = 17  # [status:i8][remaining:i32][wait:i32][token:i64]
+
+_ENTRY_DT = np.dtype(
+    [("kind", "u1"), ("id", ">i8"), ("count", ">i4"), ("flags", "u1")]
+)
+_RESULT_DT = np.dtype(
+    [("status", "i1"), ("remaining", ">i4"), ("wait", ">i4"), ("token", ">i8")]
+)
+assert _ENTRY_DT.itemsize == BATCH_ENTRY_SIZE
+assert _RESULT_DT.itemsize == BATCH_RESULT_SIZE
+
+_cp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+
+
+def pack_batch_entries(kinds, ids, counts, flags) -> bytes:
+    """Request entry columns → packed wire bytes (n × 14 B)."""
+    kinds = np.ascontiguousarray(kinds, np.uint8)
+    ids = np.ascontiguousarray(ids, np.int64)
+    counts = np.ascontiguousarray(counts, np.int32)
+    flags = np.ascontiguousarray(flags, np.uint8)
+    n = kinds.shape[0]
+    lib = load_native()
+    if lib is not None:
+        out = np.empty(n * BATCH_ENTRY_SIZE, np.uint8)
+        lib.sx_frame_pack_entries(n, _cp(kinds), _cp(ids), _cp(counts),
+                                  _cp(flags), _cp(out))
+        return out.tobytes()
+    rec = np.empty(n, _ENTRY_DT)
+    rec["kind"], rec["id"], rec["count"], rec["flags"] = kinds, ids, counts, flags
+    return rec.tobytes()
+
+
+def unpack_batch_entries(buf: bytes) -> Tuple[np.ndarray, ...]:
+    """Packed wire bytes → ``(kinds, ids, counts, flags)`` native-endian
+    columns; raises on a length that is not a whole number of entries."""
+    n, rem = divmod(len(buf), BATCH_ENTRY_SIZE)
+    if rem:
+        raise ValueError(f"truncated batch entries ({len(buf)} bytes)")
+    lib = load_native()
+    if lib is not None:
+        raw = np.frombuffer(buf, np.uint8)
+        kinds = np.empty(n, np.uint8)
+        ids = np.empty(n, np.int64)
+        counts = np.empty(n, np.int32)
+        flags = np.empty(n, np.uint8)
+        lib.sx_frame_unpack_entries(n, _cp(raw), _cp(kinds), _cp(ids),
+                                    _cp(counts), _cp(flags))
+        return kinds, ids, counts, flags
+    rec = np.frombuffer(buf, _ENTRY_DT)
+    return (
+        rec["kind"].astype(np.uint8),
+        rec["id"].astype(np.int64),
+        rec["count"].astype(np.int32),
+        rec["flags"].astype(np.uint8),
+    )
+
+
+def pack_batch_results(statuses, remainings, waits, tokens) -> bytes:
+    """Response entry columns → packed wire bytes (n × 17 B)."""
+    statuses = np.ascontiguousarray(statuses, np.int8)
+    remainings = np.ascontiguousarray(remainings, np.int32)
+    waits = np.ascontiguousarray(waits, np.int32)
+    tokens = np.ascontiguousarray(tokens, np.int64)
+    n = statuses.shape[0]
+    lib = load_native()
+    if lib is not None:
+        out = np.empty(n * BATCH_RESULT_SIZE, np.uint8)
+        lib.sx_frame_pack_results(n, _cp(statuses), _cp(remainings),
+                                  _cp(waits), _cp(tokens), _cp(out))
+        return out.tobytes()
+    rec = np.empty(n, _RESULT_DT)
+    rec["status"], rec["remaining"] = statuses, remainings
+    rec["wait"], rec["token"] = waits, tokens
+    return rec.tobytes()
+
+
+def unpack_batch_results(buf: bytes) -> Tuple[np.ndarray, ...]:
+    """Packed wire bytes → ``(statuses, remainings, waits, tokens)``."""
+    n, rem = divmod(len(buf), BATCH_RESULT_SIZE)
+    if rem:
+        raise ValueError(f"truncated batch results ({len(buf)} bytes)")
+    lib = load_native()
+    if lib is not None:
+        raw = np.frombuffer(buf, np.uint8)
+        statuses = np.empty(n, np.int8)
+        remainings = np.empty(n, np.int32)
+        waits = np.empty(n, np.int32)
+        tokens = np.empty(n, np.int64)
+        lib.sx_frame_unpack_results(n, _cp(raw), _cp(statuses),
+                                    _cp(remainings), _cp(waits), _cp(tokens))
+        return statuses, remainings, waits, tokens
+    rec = np.frombuffer(buf, _RESULT_DT)
+    return (
+        rec["status"].astype(np.int8),
+        rec["remaining"].astype(np.int32),
+        rec["wait"].astype(np.int32),
+        rec["token"].astype(np.int64),
+    )
+
+
 def batch_sort3(k0, k1, k2, want_inv: bool = False):
     """Stable argsort by (k0, k1, k2); see :func:`batch_sort5`."""
     k0, k1, k2 = map(_as_i32, (k0, k1, k2))

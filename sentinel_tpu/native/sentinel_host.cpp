@@ -939,4 +939,80 @@ int64_t sx_batch_sort3(int64_t n, const int32_t* k0, const int32_t* k1,
     return n;
 }
 
+// -- protocol v2 BATCH framing (cluster/protocol.py) ------------------------
+//
+// Fixed-width big-endian column entries:
+//   request entry  (14 B): [kind:u8][id:i64][count:i32][flags:u8]
+//   response entry (17 B): [status:i8][remaining:i32][wait:i32][token:i64]
+// Pack/unpack is the per-frame hot loop on both sides of the wire; the
+// numpy fallback (ring.py structured dtypes) produces IDENTICAL bytes.
+
+static inline void sxw_be32(uint8_t* p, uint32_t v) {
+    p[0] = (uint8_t)(v >> 24); p[1] = (uint8_t)(v >> 16);
+    p[2] = (uint8_t)(v >> 8);  p[3] = (uint8_t)v;
+}
+static inline void sxw_be64(uint8_t* p, uint64_t v) {
+    sxw_be32(p, (uint32_t)(v >> 32));
+    sxw_be32(p + 4, (uint32_t)v);
+}
+static inline uint32_t sxr_be32(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+static inline uint64_t sxr_be64(const uint8_t* p) {
+    return ((uint64_t)sxr_be32(p) << 32) | (uint64_t)sxr_be32(p + 4);
+}
+
+int64_t sx_frame_pack_entries(int64_t n, const uint8_t* kinds,
+                              const int64_t* ids, const int32_t* counts,
+                              const uint8_t* flags, uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t* e = out + i * 14;
+        e[0] = kinds[i];
+        sxw_be64(e + 1, (uint64_t)ids[i]);
+        sxw_be32(e + 9, (uint32_t)counts[i]);
+        e[13] = flags[i];
+    }
+    return n;
+}
+
+int64_t sx_frame_unpack_entries(int64_t n, const uint8_t* buf, uint8_t* kinds,
+                                int64_t* ids, int32_t* counts,
+                                uint8_t* flags) {
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* e = buf + i * 14;
+        kinds[i] = e[0];
+        ids[i] = (int64_t)sxr_be64(e + 1);
+        counts[i] = (int32_t)sxr_be32(e + 9);
+        flags[i] = e[13];
+    }
+    return n;
+}
+
+int64_t sx_frame_pack_results(int64_t n, const int8_t* statuses,
+                              const int32_t* remainings, const int32_t* waits,
+                              const int64_t* tokens, uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t* e = out + i * 17;
+        e[0] = (uint8_t)statuses[i];
+        sxw_be32(e + 1, (uint32_t)remainings[i]);
+        sxw_be32(e + 5, (uint32_t)waits[i]);
+        sxw_be64(e + 9, (uint64_t)tokens[i]);
+    }
+    return n;
+}
+
+int64_t sx_frame_unpack_results(int64_t n, const uint8_t* buf,
+                                int8_t* statuses, int32_t* remainings,
+                                int32_t* waits, int64_t* tokens) {
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* e = buf + i * 17;
+        statuses[i] = (int8_t)e[0];
+        remainings[i] = (int32_t)sxr_be32(e + 1);
+        waits[i] = (int32_t)sxr_be32(e + 5);
+        tokens[i] = (int64_t)sxr_be64(e + 9);
+    }
+    return n;
+}
+
 }  // extern "C"

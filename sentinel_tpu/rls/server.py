@@ -127,16 +127,36 @@ class SentinelEnvoyRlsService:
         hits = request.hits_addend or 1
         rsp = pb.RateLimitResponse()
         overall = pb.RateLimitResponse.OK
-        for desc in request.descriptors:
-            entries = [(e.key, e.value) for e in desc.entries]
-            fid = self.rules.lookup_flow_id(request.domain, entries)
+        # resolve every descriptor up front: a multi-descriptor request
+        # against a sharded fleet then rides ONE batched token exchange
+        # per owning shard (request_token_many groups by ring owner and
+        # sends a protocol-v2 batch frame) instead of paying a blocking
+        # round-trip per descriptor
+        resolved = [
+            self.rules.lookup_flow_id(
+                request.domain, [(e.key, e.value) for e in desc.entries]
+            )
+            for desc in request.descriptors
+        ]
+        idxs = [i for i, fid in enumerate(resolved) if fid is not None]
+        many = getattr(self.token_service, "request_token_many", None)
+        results = {}
+        if many is not None and len(idxs) > 1:
+            batch = many([(resolved[i], hits) for i in idxs])
+            results = dict(zip(idxs, batch))
+        else:
+            for i in idxs:
+                results[i] = self.token_service.request_token(
+                    resolved[i], hits, False
+                )
+        for i, _desc in enumerate(request.descriptors):
             status = rsp.statuses.add()
-            if fid is None:
+            if resolved[i] is None:
                 # no rule for this descriptor → not limited (reference
                 # returns OK for unmatched descriptors)
                 status.code = pb.RateLimitResponse.OK
                 continue
-            r = self.token_service.request_token(fid, hits, False)
+            r = results[i]
             if r.status in (C.STATUS_OK, C.STATUS_NO_RULE):
                 # NO_RULE happens when a concurrent rule push removed the
                 # flow id between lookup and check — unmatched descriptors

@@ -624,3 +624,266 @@ def test_authority_mirror_unintered_origin_never_preblocks(client_factory):
     assert svc.calls == 1, (
         "mirror pre-blocked device-passing traffic: cluster limit unenforced"
     )
+
+
+# ---------------------------------------------------------------------------
+# protocol v2: batched frames, HELLO negotiation, fail-closed framing
+# ---------------------------------------------------------------------------
+
+import socket  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+def _batch_req(xid=11, tid=0, sid=0):
+    return P.ClusterBatchRequest(
+        xid=xid,
+        kinds=np.array([C.BATCH_KIND_FLOW, C.BATCH_KIND_FLOW_BATCH, C.BATCH_KIND_LEASE], np.uint8),
+        ids=np.array([101, 2**40, -7], np.int64),
+        counts=np.array([1, 500, 32], np.int32),
+        flags=np.array([C.BATCH_FLAG_PRIORITIZED, 0, 0], np.uint8),
+        trace_id=tid,
+        span_id=sid,
+    )
+
+
+def test_batch_frame_roundtrip_with_and_without_trace_tail():
+    for tid, sid in ((0, 0), (0xABCDEF0123456789, 0x1122334455667788)):
+        got = P.decode_batch_request(P.FrameReader().feed(
+            P.encode_batch_request(_batch_req(tid=tid, sid=sid)))[0])
+        want = _batch_req(tid=tid, sid=sid)
+        assert got.xid == 11 and (got.trace_id, got.span_id) == (tid, sid)
+        for f in ("kinds", "ids", "counts", "flags"):
+            assert np.array_equal(getattr(got, f), getattr(want, f)), f
+        rsp = P.ClusterBatchResponse(
+            xid=11, status=C.STATUS_OK,
+            statuses=np.array([C.STATUS_OK, C.STATUS_BLOCKED, C.STATUS_FAIL], np.int8),
+            remainings=np.array([3, 0, -1], np.int32),
+            waits=np.array([0, 250, 0], np.int32),
+            token_ids=np.array([0, 0, 2**50], np.int64),
+            trace_id=tid, span_id=sid,
+        )
+        got_r = P.decode_batch_response(P.FrameReader().feed(P.encode_batch_response(rsp))[0])
+        assert (got_r.status, got_r.trace_id, got_r.span_id) == (C.STATUS_OK, tid, sid)
+        for f in ("statuses", "remainings", "waits", "token_ids"):
+            assert np.array_equal(getattr(got_r, f), getattr(rsp, f)), f
+
+
+def test_batch_frame_golden_bytes():
+    """Pin the v2 wire layout byte-for-byte: a future refactor that
+    shifts a field breaks THIS test, not a live fleet mid-upgrade."""
+    req = P.ClusterBatchRequest(
+        xid=7,
+        kinds=np.array([C.BATCH_KIND_FLOW], np.uint8),
+        ids=np.array([12], np.int64),
+        counts=np.array([3], np.int32),
+        flags=np.array([1], np.uint8),
+    )
+    body = struct.pack(">iBH", 7, C.MSG_TYPE_BATCH, 1) + struct.pack(">BqiB", C.BATCH_KIND_FLOW, 12, 3, 1)
+    assert P.encode_batch_request(req) == struct.pack(">H", len(body)) + body
+    rsp = P.ClusterBatchResponse(
+        xid=7, status=C.STATUS_OK,
+        statuses=np.array([C.STATUS_BLOCKED], np.int8),
+        remainings=np.array([2], np.int32),
+        waits=np.array([9], np.int32),
+        token_ids=np.array([5], np.int64),
+    )
+    body_r = struct.pack(">iBbH", 7, C.MSG_TYPE_BATCH, C.STATUS_OK, 1) + struct.pack(
+        ">biiq", C.STATUS_BLOCKED, 2, 9, 5
+    )
+    assert P.encode_batch_response(rsp) == struct.pack(">H", len(body_r)) + body_r
+    # and the type byte sits where peek_type reads it, on BOTH frames
+    assert P.peek_type(body) == C.MSG_TYPE_BATCH == P.peek_type(body_r)
+
+
+def test_batch_frame_strict_length_rejects_whole_frame():
+    """_batch_payload: any length that is not exactly n entries (plus an
+    optional well-formed trace block) rejects the WHOLE frame — a
+    corrupted count byte or short read never yields partial entries."""
+    raw = P.encode_batch_request(_batch_req())
+    body = bytearray(P.FrameReader().feed(raw)[0])
+    with pytest.raises(ValueError):
+        P.decode_batch_request(bytes(body[:-1]))  # short read
+    mangled = bytearray(body)
+    mangled[6] ^= 0xFF  # count byte bit-flip -> slab length mismatch
+    with pytest.raises(ValueError):
+        P.decode_batch_request(bytes(mangled))
+    with pytest.raises(ValueError):
+        P.decode_batch_request(bytes(body) + b"x")  # trailing garbage
+
+
+def test_hello_negotiation_flips_client_to_v2(tcp_cluster):
+    server, tok, svc = tcp_cluster
+    assert tok.request_token(101).status in (C.STATUS_OK, C.STATUS_BLOCKED)
+    deadline = time.monotonic() + 2
+    while tok.peer_version < 2:
+        assert time.monotonic() < deadline, "HELLO response not observed"
+        time.sleep(0.01)
+
+
+def test_request_batch_v2_end_to_end(tcp_cluster):
+    """One BATCH frame, many flows: per-entry verdicts match the
+    sequential semantics of the device column batcher (limit 3)."""
+    server, tok, svc = tcp_cluster
+    assert tok.request_token(101).ok  # also completes HELLO negotiation
+    results = tok.request_batch([
+        (C.BATCH_KIND_FLOW, 101, 1),
+        (C.BATCH_KIND_FLOW_BATCH, 101, 5),
+        (C.BATCH_KIND_FLOW, 101, 1),
+        (C.BATCH_KIND_FLOW, 31337, 1),
+    ])
+    assert tok.peer_version == 2
+    assert results[0].status == C.STATUS_OK
+    # partial grant: 1 unit already spent above, 1 by entry 0 -> 1 left
+    assert results[1].status == C.STATUS_OK and results[1].remaining == 1
+    assert results[2].status == C.STATUS_BLOCKED
+    assert results[3].status == C.STATUS_NO_RULE
+
+
+def test_batch_frames_carry_trace_context(tcp_cluster):
+    """The 17-byte trace tail rides batched frames end to end: the
+    client's cluster.rpc span for a BATCH exchange carries the ambient
+    trace id, and the server echoes the context on the response."""
+    from sentinel_tpu import obs
+    from sentinel_tpu.obs import trace as OT
+
+    server, tok, svc = tcp_cluster
+    assert tok.request_token(101).ok  # negotiate v2 first
+    obs.TRACER.reset()
+    obs.enable()
+    try:
+        tid, sid = OT.new_trace_id(), OT.new_span_id()
+        with OT.trace_ctx(tid, sid):
+            tok.request_batch([(C.BATCH_KIND_FLOW, 101, 1)])
+    finally:
+        obs.disable()
+    rpc = [s for s in obs.TRACER.snapshot() if s["name"] == "cluster.rpc"]
+    assert rpc and rpc[-1]["trace"] == tid
+    assert rpc[-1]["attrs"].get("type") == C.MSG_TYPE_BATCH
+
+
+class _V1Server(threading.Thread):
+    """Hand-rolled LEGACY token server: answers PING and FLOW frames and
+    silently drops anything it does not know — exactly how the v1
+    decoder treats a type-15 HELLO (decode error -> frame dropped)."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.seen_types = []
+        self._halt = threading.Event()
+
+    def run(self):
+        self.sock.settimeout(2.0)
+        try:
+            conn, _ = self.sock.accept()
+        except OSError:
+            return
+        reader = P.FrameReader()
+        conn.settimeout(0.1)
+        while not self._halt.is_set():
+            try:
+                data = conn.recv(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            for body in reader.feed(data):
+                xid, t = struct.unpack_from(">iB", body, 0)
+                self.seen_types.append(t)
+                if t == C.MSG_TYPE_PING:
+                    rsp = P.ClusterResponse(xid, t, C.STATUS_OK)
+                elif t == C.MSG_TYPE_FLOW:
+                    rsp = P.ClusterResponse(xid, t, C.STATUS_OK, remaining=1)
+                else:
+                    continue  # v1: unknown frame type is dropped
+                try:
+                    conn.sendall(P.encode_response(rsp))
+                except OSError:
+                    return
+        conn.close()
+
+    def stop(self):
+        self._halt.set()
+        self.sock.close()
+        self.join(timeout=3)
+
+
+def test_v1_server_keeps_client_on_v1_and_batches_pipeline():
+    """Negotiation back-compat, server side: a legacy peer drops the
+    HELLO, the client's reaper resolves it to v1 after timeout_ms, and
+    request_batch transparently degrades to PIPELINED legacy frames on
+    the same multiplexed socket — correct answers, no v2 frames sent."""
+    v1 = _V1Server()
+    v1.start()
+    tok = ClusterTokenClient("127.0.0.1", v1.port, timeout_ms=300,
+                             reconnect_interval_s=0.0)
+    try:
+        assert tok.request_token(5).status == C.STATUS_OK
+        time.sleep(0.5)  # past the HELLO reaper: negotiation settled
+        assert tok.peer_version == 1
+        results = tok.request_batch([
+            (C.BATCH_KIND_FLOW, 5, 1),
+            (C.BATCH_KIND_FLOW, 6, 1),
+        ])
+        assert [r.status for r in results] == [C.STATUS_OK, C.STATUS_OK]
+        assert C.MSG_TYPE_BATCH not in v1.seen_types
+        assert C.MSG_TYPE_HELLO in v1.seen_types  # offered, ignored
+    finally:
+        tok.close()
+        v1.stop()
+
+
+def test_corrupt_batch_frame_fails_closed(tcp_cluster):
+    """cluster.batch.frame chaos site: a structurally corrupted or
+    truncated BATCH frame fails the WHOLE exchange closed — every entry
+    STATUS_FAIL, no partial answers applied — and the connection keeps
+    working after.  (The wire carries no checksum, so a flip inside the
+    entry slab just decodes as a different ask; the strict-length
+    contract is about frame STRUCTURE: header, count, slab size.  The
+    seed is picked so the deterministic fault lands structurally.)"""
+    from sentinel_tpu.chaos import failpoints as FP
+    from sentinel_tpu.chaos.plans import FaultPlan, FaultSpec
+
+    server, tok, svc = tcp_cluster
+    assert tok.request_token(101).ok  # negotiate v2 first
+    body_len = 7 + 2 * 14  # [xid:4][type:1][n:2] + 2 entries, no trace tail
+
+    def _plan(action, seed):
+        return FaultPlan(
+            name=f"batch-{action}", seed=seed,
+            faults=[FaultSpec("cluster.batch.frame", action, max_fires=1)],
+        )
+
+    def _pick_seed(action):
+        for s in range(500):
+            rng = _plan(action, s).spec_rng(0)
+            if action == "corrupt":
+                # flip must land in the header (type/count bytes) to be
+                # structurally detectable
+                if rng.randrange(body_len) in (4, 5, 6):
+                    return s
+            else:
+                # cut must keep the xid readable so the server can send
+                # the frame-level FAIL instead of forcing a 5 s timeout
+                if rng.randrange(1, body_len) >= 4:
+                    return s
+        raise AssertionError(f"no structural seed for {action}")
+
+    for action in ("corrupt", "short_read"):
+        plan = _plan(action, _pick_seed(action))
+        with FP.armed(plan) as st:
+            results = tok.request_batch([
+                (C.BATCH_KIND_FLOW, 101, 1),
+                (C.BATCH_KIND_FLOW, 101, 1),
+            ])
+        assert st.injected().get(f"cluster.batch.frame:{action}") == 1
+        assert all(r.status == C.STATUS_FAIL for r in results), action
+    # the frame-level reject did not poison the connection or the budget
+    r = tok.request_batch([(C.BATCH_KIND_FLOW, 101, 1)])
+    assert r[0].status in (C.STATUS_OK, C.STATUS_BLOCKED)

@@ -160,3 +160,50 @@ def test_batch_sort_native_matches_numpy_fallback(monkeypatch):
         assert np.array_equal(o5f, np.lexsort((k5[4], k5[3], k5[2], k5[1], k5[0])))
         if n:
             assert np.array_equal(i5n[o5n], np.arange(n))
+
+
+def test_batch_framing_native_matches_numpy_fallback(monkeypatch):
+    """Protocol-v2 frame pack/unpack (sx_frame_pack_entries & co) must be
+    BYTE-identical to the numpy big-endian structured fallback — the two
+    ends of one connection may be built differently."""
+    import sentinel_tpu.native.ring as RM
+
+    assert native_available()
+    rng = np.random.default_rng(13)
+    for n in (0, 1, 5, 2048):
+        kinds = rng.integers(0, 255, n).astype(np.uint8)
+        ids = rng.integers(-(2**62), 2**62, n).astype(np.int64)
+        counts = rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32)
+        flags = rng.integers(0, 255, n).astype(np.uint8)
+        statuses = rng.integers(-128, 127, n).astype(np.int8)
+        waits = rng.integers(0, 2**31 - 1, n).astype(np.int32)
+        wire_e_n = RM.pack_batch_entries(kinds, ids, counts, flags)
+        wire_r_n = RM.pack_batch_results(statuses, counts, waits, ids)
+        cols_e_n = RM.unpack_batch_entries(wire_e_n)
+        cols_r_n = RM.unpack_batch_results(wire_r_n)
+        with monkeypatch.context() as m:
+            m.setattr(RM, "load_native", lambda: None)
+            assert RM.pack_batch_entries(kinds, ids, counts, flags) == wire_e_n
+            assert RM.pack_batch_results(statuses, counts, waits, ids) == wire_r_n
+            cols_e_f = RM.unpack_batch_entries(wire_e_n)
+            cols_r_f = RM.unpack_batch_results(wire_r_n)
+        for a, b in zip(cols_e_n, cols_e_f):
+            assert np.array_equal(a, b)
+        for a, b in zip(cols_r_n, cols_r_f):
+            assert np.array_equal(a, b)
+        # round-trip restores the original columns exactly
+        for a, b in zip(cols_e_n, (kinds, ids, counts, flags)):
+            assert np.array_equal(a, b)
+        for a, b in zip(cols_r_n, (statuses, counts, waits, ids)):
+            assert np.array_equal(a, b)
+    # a length that is not a whole number of entries is rejected on BOTH paths
+    wire = RM.pack_batch_entries(*(np.zeros(2, dt) for dt in
+                                   (np.uint8, np.int64, np.int32, np.uint8)))
+    for use_fallback in (False, True):
+        with monkeypatch.context() as m:
+            if use_fallback:
+                m.setattr(RM, "load_native", lambda: None)
+            with pytest.raises(ValueError):
+                RM.unpack_batch_entries(wire[:-1])
+            with pytest.raises(ValueError):
+                RM.unpack_batch_results(wire)  # 28 bytes is not k × 17

@@ -37,6 +37,7 @@ def fleet(client_factory):
         lease_ttl_ms=600_000,
         timeout_ms=5000,
         reconnect_interval_s=0.0,
+        lease_refresh_async=False,  # top-ups run inline: exact sequences below
     )
     yield f
     f.stop()
@@ -566,3 +567,114 @@ def test_shard_metrics_are_labeled(fleet):
     snap = REGISTRY.snapshot()
     assert snap['sentinel_shard_requests_total{shard="shard-1"}'] >= 1
     assert 'sentinel_shard_degraded{shard="shard-1"}' in snap
+
+
+# ---------------------------------------------------------------------------
+# lease-first admission (protocol v2)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_first_steady_state_is_rpc_free(fleet):
+    """After the bootstrap round-trip a healthy flow admits locally
+    against its standing lease: zero routed RPCs per decision."""
+    fid = owned_flow(fleet, "shard-0")
+    fleet.load_flow_rules("default", [flow_rule(fid, 100.0)])
+    st = fleet.client._shards["shard-0"]
+    assert fleet.client.request_token(fid).ok  # remote + lease bootstrap
+    base = st.c_requests.value
+    lease = st.leases[fid]
+    assert (lease.granted, lease.used) == (50, 0)  # slack 0.5 × count 100
+    admits0 = st.c_local_admits.value
+    for _ in range(10):
+        assert fleet.client.request_token(fid).ok
+    assert st.c_requests.value == base  # no further routed requests
+    assert st.c_local_admits.value == admits0 + 10
+    assert st.leases[fid].used == 10
+
+
+def test_lease_tops_up_ahead_of_exhaustion(fleet):
+    """Once the spendable remainder dips to refresh_frac of the grant
+    the top-up (inline here: the fixture sets async off) refills the
+    lease before it empties — the flow never pays a remote decision."""
+    fid = owned_flow(fleet, "shard-0")
+    fleet.load_flow_rules("default", [flow_rule(fid, 100.0)])
+    st = fleet.client._shards["shard-0"]
+    fleet.client.request_token(fid)  # bootstrap: granted 50, used 0
+    base = st.c_requests.value
+    for _ in range(25):  # 25th admit leaves remaining == 25 → top-up fires
+        assert fleet.client.request_token(fid).ok
+    lease = st.leases[fid]
+    assert (lease.granted, lease.used) == (50, 0)  # refilled, carry folded in
+    assert st.c_requests.value == base  # top-up was a LEASE frame, not a route
+
+
+def test_async_refresher_tops_up_in_background(client_factory):
+    """The default configuration hands top-ups to the background
+    refresher thread; flush_lease_refresh() sequences the assertion."""
+    f = ShardFleet(
+        client_factory,
+        n_shards=2,
+        lease_slack=0.5,
+        retry_interval_s=300.0,
+        lease_ttl_ms=600_000,
+        timeout_ms=5000,
+        reconnect_interval_s=0.0,
+    )
+    try:
+        fid = owned_flow(f, "shard-0")
+        f.load_flow_rules("default", [flow_rule(fid, 100.0)])
+        st = f.client._shards["shard-0"]
+        f.client.request_token(fid)
+        base = st.c_requests.value
+        for _ in range(25):
+            assert f.client.request_token(fid).ok
+        assert f.client.flush_lease_refresh(5.0)
+        lease = st.leases[fid]
+        assert (lease.granted, lease.used) == (50, 0)
+        assert st.c_requests.value == base
+    finally:
+        f.stop()
+
+
+def test_request_token_many_one_exchange_per_owner(fleet):
+    """Multi-flow admission groups by ring owner and rides one batched
+    exchange per shard, preserving per-entry order semantics."""
+    fid_a = owned_flow(fleet, "shard-0")
+    fid_b = owned_flow(fleet, "shard-1")
+    fleet.load_flow_rules("default", [flow_rule(fid_a, 3.0), flow_rule(fid_b, 3.0)])
+    fleet.client.lease_slack = 0.0  # exact budgets: every decision remote
+    rs = fleet.client.request_token_many(
+        [(fid_a, 1), (fid_b, 1), (fid_a, 1), (999_999, 1), (fid_a, 2)]
+    )
+    assert [r.status for r in rs] == [
+        C.STATUS_OK,
+        C.STATUS_OK,
+        C.STATUS_OK,
+        C.STATUS_NO_RULE,
+        C.STATUS_BLOCKED,  # 2 more against count 3 with 2 spent
+    ]
+
+
+def test_request_token_many_admits_locally_against_leases(fleet):
+    fid = owned_flow(fleet, "shard-0")
+    fleet.load_flow_rules("default", [flow_rule(fid, 100.0)])
+    st = fleet.client._shards["shard-0"]
+    fleet.client.request_token(fid)  # bootstrap lease
+    base = st.c_requests.value
+    rs = fleet.client.request_token_many([(fid, 1)] * 5)
+    assert all(r.ok for r in rs)
+    assert st.c_requests.value == base  # all five admitted off the lease
+
+
+def test_request_token_many_fails_over_per_shard(fleet):
+    """A dead owner degrades only its own entries; with leasing off the
+    fallback fails CLOSED, and the other shard's entries are untouched."""
+    fid_a = owned_flow(fleet, "shard-0")
+    fid_b = owned_flow(fleet, "shard-1")
+    fleet.load_flow_rules("default", [flow_rule(fid_a, 4.0), flow_rule(fid_b, 4.0)])
+    fleet.client.lease_slack = 0.0
+    fleet.kill("shard-0")
+    rs = fleet.client.request_token_many([(fid_a, 1), (fid_b, 1)])
+    assert rs[0].status == C.STATUS_BLOCKED
+    assert rs[1].status == C.STATUS_OK
+    assert fleet.client.shard_degraded("shard-0")
