@@ -372,19 +372,32 @@ class TokenColumnBatcher:
                     W.RT_MIN_INIT,
                     np.float32,
                 )
+                run = np.zeros((cap, W.NUM_EVENTS), np.int32)
+                run_rt = np.zeros((cap,), np.float32)
+                run_rt_min = np.full((cap,), W.RT_MIN_INIT, np.float32)
                 old = self._cap
                 counts[:old] = np.asarray(self._state.win.counts)
                 rt_sum[:old] = np.asarray(self._state.win.rt_sum)
                 rt_min[:old] = np.asarray(self._state.win.rt_min)
+                run[:old] = np.asarray(self._state.win.run)
+                run_rt[:old] = np.asarray(self._state.win.run_rt)
+                run_rt_min[:old] = np.asarray(self._state.win.run_rt_min)
                 if zero_rows:
                     counts[zero_rows] = 0
                     rt_sum[zero_rows] = 0.0
                     rt_min[zero_rows] = W.RT_MIN_INIT
+                    run[zero_rows] = 0
+                    run_rt[zero_rows] = 0.0
+                    run_rt_min[zero_rows] = W.RT_MIN_INIT
                 win = W.WindowState(
                     counts=jnp.asarray(counts),
                     rt_sum=jnp.asarray(rt_sum),
                     rt_min=jnp.asarray(rt_min),
                     epochs=self._state.win.epochs,
+                    run=jnp.asarray(run),
+                    run_rt=jnp.asarray(run_rt),
+                    run_rt_min=jnp.asarray(run_rt_min),
+                    rot_wid=self._state.win.rot_wid,
                 )
                 grew = cap != self._cap
                 self._state = TC.TokenColState(win=win, limits=self._state.limits)

@@ -222,6 +222,17 @@ class EngineConfig:
     # interval (rule_tensors.compile_tail_flow_rules)
     sketch_sample_count: int = 0
     sketch_window_ms: int = 0
+    # slack-window maintenance for the sketch tier (arXiv 1703.01166):
+    # batch bucket rotation/expiry to every ceil(slack_frac * sample_count)
+    # buckets, carrying slack_buckets - 1 extra physical ring columns so
+    # the write cursor only reaches already-purged columns.  Expired
+    # buckets linger in the running sums for up to that many bucket
+    # lengths — a bounded OVERESTIMATE (fail-closed).  At the default
+    # second-window fallback shape (nb=2) this rounds to g=1 (exact, no
+    # extra columns); at the minute-scale tier (nb=60) it batches expiry
+    # to every 3 buckets.  The EXACT second/minute windows never take
+    # slack — their WindowConfig pins slack_frac=0.
+    sketch_slack_frac: float = 0.05
     # hot-set manager (sentinel_tpu/sketch/hotset.py): the tick emits the
     # top-K sketched resources of each batch by windowed pass estimate
     # (TickOutput.hot, device top_k over ids the batch actually carried);

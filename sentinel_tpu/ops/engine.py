@@ -269,8 +269,9 @@ def _device_stats(
     sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
     erow = cfg.entry_node_row
     entry = jnp.array([erow], dtype=jnp.int32)
-    ec = W.gather_window_counts(state.win_sec, now_ms, entry, sec_cfg)[0]
-    ert, emin = W.gather_window_rt(state.win_sec, now_ms, entry, sec_cfg)
+    # effects for this tick already landed (and refreshed) — run is exact
+    ec = W.gather_window_counts_run(state.win_sec, entry)[0]
+    ert, emin = W.gather_window_rt_run(state.win_sec, entry)
 
     def n_of(code):
         return jnp.sum(valid & (verdict == jnp.int8(code)))
@@ -359,16 +360,14 @@ def _device_res_stats(cfg: EngineConfig, state: EngineState, now_ms):
     K = timeline_k(cfg)
     sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
     win = state.win_sec
-    wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
-    bidx = wid % cfg.second_sample_count
+    wid = W._wid(now_ms, sec_cfg)
+    bidx = W.current_index(now_ms, sec_cfg)
     # rank resource rows [1, max_resources) by windowed pass+block; row 0
-    # is the global ENTRY node (already covered by the scalar stats row)
-    mask = W.valid_mask(win, now_ms, sec_cfg)  # [nb]
-    counts = win.counts[1 : cfg.max_resources]
-    score = jnp.sum(
-        (counts[:, :, W.EV_PASS] + counts[:, :, W.EV_BLOCK]) * mask[None, :],
-        axis=1,
-    )
+    # is the global ENTRY node (already covered by the scalar stats row).
+    # The effects phase refreshed at this now_ms, so the running sums are
+    # exact here — O(rows) instead of the old masked [rows, nb] reduction.
+    r = win.run[1 : cfg.max_resources]
+    score = r[:, W.EV_PASS] + r[:, W.EV_BLOCK]
     _, idx = jax.lax.top_k(score, K)
     rows = idx.astype(jnp.int32) + 1
     fresh = win.epochs[bidx] == wid
@@ -449,6 +448,7 @@ def sketch_config(cfg: EngineConfig) -> GS.SketchConfig:
         window_ms=wms,
         depth=cfg.sketch_depth,
         width=cfg.sketch_width,
+        slack_frac=cfg.sketch_slack_frac,
     )
 
 
@@ -643,27 +643,18 @@ def _stat_update(
         full = jnp.zeros((deltas.shape[0], W.NUM_EVENTS), deltas.dtype)
         deltas = full.at[:, jnp.asarray(plane_idx)].set(deltas)
     win_sec = W.add_batch(state.win_sec, now_ms, rows, deltas, rt, sec_cfg)
-    win_sec = W.WindowState(
-        counts=win_sec.counts.at[erow, W.current_index(now_ms, sec_cfg), :].add(
-            entry_deltas
-        ),
-        rt_sum=win_sec.rt_sum
-        if rt is None
-        else win_sec.rt_sum.at[erow, W.current_index(now_ms, sec_cfg)].add(entry_rt),
-        rt_min=win_sec.rt_min,
-        epochs=win_sec.epochs,
+    win_sec = W.add_row_delta(
+        win_sec, now_ms, erow, entry_deltas,
+        None if rt is None else entry_rt, sec_cfg,
     )
     if entry_rt_min is not None:
         win_sec = W.min_into_row(win_sec, now_ms, erow, entry_rt_min, sec_cfg)
     win_min = state.win_min
     if cfg.enable_minute_window:
         win_min = W.add_batch(state.win_min, now_ms, rows, deltas, rt, min_cfg)
-        idx_m = W.current_index(now_ms, min_cfg)
-        win_min = win_min._replace(
-            counts=win_min.counts.at[erow, idx_m, :].add(entry_deltas),
-            rt_sum=win_min.rt_sum
-            if rt is None
-            else win_min.rt_sum.at[erow, idx_m].add(entry_rt),
+        win_min = W.add_row_delta(
+            win_min, now_ms, erow, entry_deltas,
+            None if rt is None else entry_rt, min_cfg,
         )
     return state._replace(win_sec=win_sec, win_min=win_min), None
 
@@ -882,6 +873,7 @@ def _process_completions(
                 (W.EV_SUCCESS, W.EV_EXCEPTION, GS.RT_PLANE),
                 valid,
                 sketch_config(cfg),
+                ecfg=cfg,
             )
         )
 
@@ -1479,7 +1471,7 @@ def _acquire_effects_fused(
                 jnp.zeros((cfg.node_rows - cfg.max_nodes,), jnp.float32),
             ]
         )
-        cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+        cur_wid = W.wid_of(now_ms, cfg.second_window_ms)
         pool_vec = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
         state = state._replace(
             occ_tokens=pool_vec + add,
@@ -1523,8 +1515,10 @@ def _check_system(
     (SystemRuleManager.checkSystem / checkBbr)."""
     sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
     entry = jnp.array([cfg.entry_node_row], dtype=jnp.int32)
-    ec = W.gather_window_counts(state.win_sec, now_ms, entry, sec_cfg)[0]
-    ert, emin = W.gather_window_rt(state.win_sec, now_ms, entry, sec_cfg)
+    # completions refreshed at this now_ms before checks run, so the
+    # running sums are exact — single gathers, no [nb] reduction per read
+    ec = W.gather_window_counts_run(state.win_sec, entry)[0]
+    ert, emin = W.gather_window_rt_run(state.win_sec, entry)
     e_pass = ec[W.EV_PASS].astype(jnp.float32)
     e_succ = ec[W.EV_SUCCESS].astype(jnp.float32)
     e_rt_avg = jnp.where(e_succ > 0, ert[0] / jnp.maximum(e_succ, 1.0), 0.0)
@@ -1675,8 +1669,9 @@ def _fold_occupied(cfg: EngineConfig, state: EngineState, now_ms):
     The occupy state is keyed by node row, so the fold is a pure
     elementwise land: no histogram, no rule lookup — RELATE/CHAIN/origin-
     metered grants fold exactly like DIRECT ones."""
-    cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
-    due = (state.occ_epoch <= cur_wid) & (state.occ_tokens > 0)
+    cur_wid = W.wid_of(now_ms, cfg.second_window_ms)
+    # modular age (wrap-safe) — occ_epoch is at most one bucket ahead
+    due = (cur_wid - state.occ_epoch >= 0) & (state.occ_tokens > 0)
     # debt whose target bucket already rolled OUT of the sliding window
     # (idle gap longer than the interval) is discarded, not charged — the
     # borrowed-against budget expired unused
@@ -1879,13 +1874,14 @@ def _check_flow(
     # node row (the reference's FutureBucket lives on the node, so RELATE/
     # CHAIN/origin-metered rules can borrow too — the deferred PASS lands
     # on whatever row the grant recorded)
-    cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+    cur_wid = W.wid_of(now_ms, cfg.second_window_ms)
     pool_dense = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
     if cfg.use_mxu_tables:
-        # dense per-row windowed pass totals once (elementwise over the
-        # window tensor), then ONE one-hot gather for (pass, concurrency,
-        # borrow pool)
-        wsum = W.window_event(state.win_sec, now_ms, sec_cfg, W.EV_PASS)
+        # per-row windowed pass totals straight off the running sums
+        # (exact: completions refreshed at this now_ms before checks run;
+        # the old masked [rows, nb] reduction per tick is gone), then ONE
+        # one-hot gather for (pass, concurrency, borrow pool)
+        wsum = W.window_event_run(state.win_sec, W.EV_PASS)
         tab = jnp.stack(
             [wsum, state.concurrency, jnp.round(pool_dense).astype(jnp.int32)],
             axis=1,
@@ -1907,7 +1903,7 @@ def _check_flow(
         conc = both[:, 1].astype(jnp.float32)
         pool = both[:, 2].astype(jnp.float32)
     else:
-        wp = W.gather_window_event(state.win_sec, now_ms, node_safe, sec_cfg, W.EV_PASS)
+        wp = W.gather_window_event_run(state.win_sec, node_safe, W.EV_PASS)
         wp = wp.astype(jnp.float32)
         conc = state.concurrency[node_safe].astype(jnp.float32)
         pool = pool_dense[node_safe]
@@ -2083,16 +2079,15 @@ def _check_tail_flow(
     thr_tab = jnp.asarray(rules.tail.thr)
 
     def _run():
-        # thresholds: max over depth of hashed cells (+inf = unruled)
+        # thresholds: max over depth of hashed cells (+inf = unruled) —
+        # ONE flat gather across all depths (tables.depth_gather_1col;
+        # float table, so the MXU path rides the lane-packed gather)
         cols = P.cms_cell(acq.res, cfg.sketch_depth, cfg.sketch_width)
-        thrs = []
-        for d in range(cfg.sketch_depth):
-            t = T.lane_gather_1col(
-                cfg, thr_tab[d], cols[:, d], cfg.sketch_width
-            )
-            # invalid ids gather 0 — restore the unruled sentinel for them
-            thrs.append(jnp.where(elig, t, RT.TAIL_UNRULED))
-        thr = jnp.max(jnp.stack(thrs, axis=0), axis=0)
+        t = T.depth_gather_1col(cfg, thr_tab, cols, cfg.sketch_width)
+        # invalid ids gather 0 — restore the unruled sentinel for them
+        thr = jnp.max(
+            jnp.where(elig[None, :], t, RT.TAIL_UNRULED), axis=0
+        )
         # sentinel is FINITE (2e38): +inf would ride the one-hot matmul as
         # 0*inf = NaN on the MXU path and kill enforcement silently
         ruled = elig & (thr < RT.TAIL_UNRULED / 2)
@@ -2463,7 +2458,7 @@ def tick(
             jnp.where(commit, jnp.round(ocnt).astype(jnp.int32), 0),
             cfg.node_rows,
         ).astype(jnp.float32)
-        cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+        cur_wid = W.wid_of(now_ms, cfg.second_window_ms)
         pool_vec = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
         state = state._replace(
             occ_tokens=pool_vec + add,
@@ -2596,6 +2591,7 @@ def tick(
                 valid,
                 sketch_config(cfg),
                 pre_refreshed=True,
+                ecfg=cfg,
             )
         )
 
@@ -2818,13 +2814,18 @@ def migrate_state(
     def carry(old_win, o_cfg: W.WindowConfig, n_cfg: W.WindowConfig, new_win):
         counts = W.window_counts(old_win, now, o_cfg)  # [rows, NE]
         rt_tot, rt_min = W.window_rt(old_win, now, o_cfg)
-        wid = (now // n_cfg.window_ms).astype(jnp.int32)
-        idx = wid % n_cfg.sample_count
+        wid = W.wid_of(now, n_cfg.window_ms)
+        idx = W.current_index(now, n_cfg)
         return W.WindowState(
             counts=new_win.counts.at[:, idx, :].set(counts.astype(jnp.int32)),
             rt_sum=new_win.rt_sum.at[:, idx].set(rt_tot),
             rt_min=new_win.rt_min.at[:, idx].set(rt_min),
             epochs=new_win.epochs.at[idx].set(wid),
+            # running sums mirror the single carried bucket exactly
+            run=counts.astype(jnp.int32),
+            run_rt=rt_tot,
+            run_rt_min=rt_min,
+            rot_wid=jnp.asarray(wid, jnp.int32),
         )
 
     o_sec = W.WindowConfig(old_cfg.second_sample_count, old_cfg.second_window_ms)

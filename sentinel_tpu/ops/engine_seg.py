@@ -463,11 +463,13 @@ def run_checks_seg(
             jnp.maximum(rcount, 1e-9),
         )
         thr_eff = jnp.where(is_warm, warm_qps, rcount)
-        cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+        cur_wid = W.wid_of(now_ms, cfg.second_window_ms)
         pool_dense = jnp.where(
             state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0
         )
-        wsum = W.window_event(state.win_sec, now_ms, sec_cfg, W.EV_PASS)
+        # running sums are exact here: completions refreshed this now_ms
+        # before checks (ops/window.py Option-B read contract)
+        wsum = W.window_event_run(state.win_sec, W.EV_PASS)
         tab = jnp.stack(
             [wsum, state.concurrency, jnp.round(pool_dense).astype(jnp.int32)],
             axis=1,
@@ -504,13 +506,11 @@ def run_checks_seg(
         tres_u = jnp.where(live, carry.res, -1)
         tail_u = live & (tres_u >= cfg.node_rows)
         tcols = P.cms_cell(tres_u, cfg.sketch_depth, cfg.sketch_width)
-        thrs = []
-        for d in range(cfg.sketch_depth):
-            t = T.lane_gather_1col(
-                cfg, thr_tab[d], tcols[:, d], cfg.sketch_width
-            )
-            thrs.append(jnp.where(tail_u, t, RT.TAIL_UNRULED))
-        thr_u = jnp.max(jnp.stack(thrs, axis=0), axis=0)
+        # ONE flat gather across all depths (tables.depth_gather_1col)
+        t = T.depth_gather_1col(cfg, thr_tab, tcols, cfg.sketch_width)
+        thr_u = jnp.max(
+            jnp.where(tail_u[None, :], t, RT.TAIL_UNRULED), axis=0
+        )
         est_u = _sketch(cfg).estimate_plane_mxu(
             cfg, state.gs, now_ms, tres_u, W.EV_PASS, E.sketch_config(cfg)
         )
@@ -1369,7 +1369,7 @@ def acquire_effects_seg(
                 jnp.zeros((cfg.node_rows - cfg.max_nodes,), jnp.float32),
             ]
         )
-        cur_wid = (now_ms // cfg.second_window_ms).astype(jnp.int32)
+        cur_wid = W.wid_of(now_ms, cfg.second_window_ms)
         pool_vec = jnp.where(state.occ_epoch == cur_wid + 1, state.occ_tokens, 0.0)
         state = state._replace(
             occ_tokens=pool_vec + add,

@@ -46,9 +46,15 @@ class TablePlan(NamedTuple):
 
 
 def make_plan(n: int, n_lo: int = 512) -> TablePlan:
-    """Split [0, n) ids as hi*n_lo + lo. n_lo is lane-friendly (mult of 128)."""
-    n_lo = min(n_lo, max(128, 1 << (max(n - 1, 1)).bit_length() - 1)) if n < n_lo else n_lo
-    n_lo = max(n_lo, 128)
+    """Split [0, n) ids as hi*n_lo + lo. n_lo is lane-friendly (mult of 128).
+
+    The Lo axis never exceeds what ``n`` needs: for small tables it clamps
+    to the smallest multiple of 128 covering ``n`` (one Hi row, minimal
+    padding) instead of the caller's wide default.  Invariants pinned by
+    tests/test_mxu_table.py: ``n_lo % 128 == 0``, ``padded >= n``."""
+    need = max(128, ((n + 127) // 128) * 128)  # smallest lane multiple >= n
+    n_lo = min(max(n_lo, 128), need)
+    n_lo = ((n_lo + 127) // 128) * 128
     n_hi = max((n + n_lo - 1) // n_lo, 1)
     return TablePlan(n=n, n_hi=n_hi, n_lo=n_lo)
 

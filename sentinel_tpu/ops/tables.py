@@ -180,6 +180,84 @@ def histogram(
     return big_scatter_add(cfg, zeros, idx, values, n, max_int=max_int)
 
 
+def depth_histogram(
+    cfg: EngineConfig,
+    cols: jax.Array,  # int32 [N, depth] — per-depth column per event
+    values: jax.Array,  # int32 [N, P] — deltas, landed at EVERY depth
+    valid: jax.Array,  # bool [N]
+    depth: int,
+    width: int,
+    max_int: int = 65535,
+) -> jax.Array:
+    """Dense [depth, width, P] histogram of a CMS-style batch — every event
+    lands its value row at one column PER depth.
+
+    The sketch tier's write kernel.  All depths share ONE flat
+    [depth*width] id space (column + d*width), so the MXU path is a single
+    digit-plane contraction over the whole flat table instead of a
+    per-depth loop of narrower ones (same MACs, 1/depth the pass count —
+    and one plan, so tick-identity holds across depths).  The CPU path is
+    one native scatter-add on the same flat ids; ``cfg=None`` forces it
+    (hosts without an EngineConfig in reach, e.g. cluster token columns).
+    """
+    N = cols.shape[0]
+    P = values.shape[1]
+    off = jax.lax.broadcasted_iota(jnp.int32, (1, depth), 1) * width
+    ok = valid[:, None] & (cols >= 0) & (cols < width)
+    flat_idx = jnp.where(ok, cols + off, jnp.int32(-1)).T.reshape(-1)  # [depth*N]
+    flat_val = jnp.broadcast_to(values[None], (depth, N, P)).reshape(depth * N, P)
+    if cfg is None or not cfg.use_mxu_tables:
+        hist = (
+            jnp.zeros((depth * width, P), jnp.int32)
+            .at[jnp.where(flat_idx >= 0, flat_idx, jnp.int32(2**30))]
+            .add(jnp.where(flat_idx[:, None] >= 0, flat_val, 0), mode="drop")
+        )
+        return hist.reshape(depth, width, P)
+    plan = MX.plan_for(depth * width, min(cfg.mxu_n_lo, 128))
+    Hi, Lo = MX.onehots(flat_idx, plan)
+    hist = MX.scatter_add(
+        jnp.zeros((depth * width, P), jnp.int32), plan, Hi, Lo, flat_val,
+        max_int=max_int,
+    )
+    return hist.reshape(depth, width, P)
+
+
+def depth_gather_1col(
+    cfg: EngineConfig,
+    tab: jax.Array,  # [depth, width] — one table column per depth
+    cols: jax.Array,  # int32 [N, depth]
+    width: int,
+    max_int: int = None,
+) -> jax.Array:
+    """f32 [depth, N] = tab[d, cols[:, d]] for every depth at once, zeros
+    for ids outside [0, width).
+
+    The sketch tier's read kernel (min-over-depth runs on the result).
+    Same flat [depth*width] id trick as depth_histogram: the MXU path is
+    ONE digit-plane contraction (pass ``max_int`` — the max CELL value —
+    for nonnegative int tables) or one lane-packed gather for float
+    tables; the CPU path one native gather."""
+    depth = tab.shape[0]
+    N = cols.shape[0]
+    off = jax.lax.broadcasted_iota(jnp.int32, (1, depth), 1) * width
+    ok = (cols >= 0) & (cols < width)
+    flat_idx = (jnp.where(ok, cols, 0) + off).T.reshape(-1)  # [depth*N]
+    flat_ok = ok.T.reshape(-1)
+    flat_tab = tab.reshape(depth * width)
+    if cfg is None or not cfg.use_mxu_tables:
+        g = jnp.where(flat_ok, flat_tab[flat_idx].astype(jnp.float32), 0.0)
+        return g.reshape(depth, N)
+    if max_int is not None and jnp.issubdtype(flat_tab.dtype, jnp.integer):
+        plan = MX.plan_for(depth * width, cfg.mxu_n_lo)
+        Hi, Lo = MX.onehots(jnp.where(flat_ok, flat_idx, jnp.int32(-1)), plan)
+        g = MX.gather(flat_tab, plan, Hi, Lo, max_int=max_int).astype(jnp.float32)
+    else:
+        g = lane_gather_1col(
+            cfg, flat_tab, jnp.where(flat_ok, flat_idx, jnp.int32(-1)), depth * width
+        )
+    return g.reshape(depth, N)
+
+
 # ---------------------------------------------------------------------------
 # small tables: per-rule-slot field rows, S <= a few thousand
 # ---------------------------------------------------------------------------

@@ -75,7 +75,11 @@ def decide_batch(
     cfg: W.WindowConfig = DEFAULT_CFG,
 ) -> Tuple[jax.Array, TokenColState]:
     """granted int32 [B] plus the updated ledger state."""
-    used = W.gather_window_event(state.win, now_ms, slots, cfg, W.EV_PASS)
+    # rotate once up front so the O(1) running sums are exact at this
+    # now_ms, then the ledger read is a single [B] gather instead of the
+    # old masked [B, nb] reduction per batch
+    win = W.refresh(state.win, now_ms, cfg)
+    used = W.gather_window_event_run(win, slots, W.EV_PASS)
     # per-entry ask clipped so an int32 cumsum over MAX_BATCH_ENTRIES
     # cannot overflow (2048 × 2^20 < 2^31); a single ask beyond 1M units
     # is already past every sane threshold and the lease ceiling
@@ -96,7 +100,7 @@ def decide_batch(
     deltas = jnp.zeros((slots.shape[0], W.NUM_EVENTS), dtype=jnp.int32)
     deltas = deltas.at[:, W.EV_PASS].set(granted)
     deltas = deltas.at[:, W.EV_BLOCK].set(units - granted)
-    win = W.add_batch(state.win, now_ms, slots, deltas, cfg=cfg)
+    win = W.add_batch(win, now_ms, slots, deltas, cfg=cfg)
     return granted, TokenColState(win=win, limits=state.limits)
 
 

@@ -9,23 +9,57 @@ Per-bucket counters are LongAdders over the event enum
 
 Here ALL resources share one ring-buffer tensor:
 
-    counts : int32  [rows, nb, NE]   (PASS, BLOCK, EXCEPTION, SUCCESS, OCCUPIED)
-    rt_sum : float32[rows, nb]
-    rt_min : float32[rows, nb]
-    epochs : int32  [nb]             window-id currently held by each column
+    counts : int32  [rows, nbp, NE]  (PASS, BLOCK, EXCEPTION, SUCCESS, OCCUPIED)
+    rt_sum : float32[rows, nbp]
+    rt_min : float32[rows, nbp]
+    epochs : int32  [nbp]            window-id currently held by each column
 
-and the per-resource CAS dance collapses into two vectorized rules:
+plus O(1) RUNNING window sums (arXiv 1604.02450 — subtract-expired /
+add-new), maintained at write time and corrected at bucket rotation:
 
-  * WRITE  (add_batch): all events in a micro-batch share one ``now_ms``,
-    so only column ``wid % nb`` is touched; if its epoch != wid the whole
-    column (all rows at once) is zeroed first — the batched form of
-    "reset deprecated bucket on wrap".
-  * READ: a column is valid iff ``epochs[b] > wid - nb`` — the batched form
-    of ``!isWindowDeprecated`` (LeapArray.java:241-245 clock-drift branch
-    included: columns from the future simply never exist because time is a
-    single host-stamped scalar).
+    run        : int32  [rows, NE]  windowed event totals
+    run_rt     : float32[rows]      windowed RT sum
+    run_rt_min : float32[rows]      windowed RT minimum
+    rot_wid    : int32  []          wid of the last batched expiry
+
+and the per-resource CAS dance collapses into three vectorized rules:
+
+  * WRITE  (add_batch / add_dense): all events in a micro-batch share one
+    ``now_ms``, so only column ``wid % nbp`` is touched; if its epoch !=
+    wid the whole column (all rows at once) is zeroed first — the batched
+    form of "reset deprecated bucket on wrap".  Every write also lands in
+    the running sums.
+  * ROTATE (refresh): when the bucket id advances past the last expiry
+    (every ``slack_buckets`` buckets — 1 by default), ALL expired columns
+    leave the running sums in one vectorized masked reduction (the
+    2305.16513 batched rotation kernel) under a lax.cond whose outputs are
+    only the O(rows) running-sum arrays — the big bucket tensors stay out
+    of the cond, so its identity branch copies O(rows) bytes, not the
+    window.  Expired columns are stamped ``PURGED`` (never re-subtracted)
+    and their storage is zeroed lazily when the cursor next reaches them.
+  * READ: exact masked reads stay available — a column is valid iff its
+    AGE ``wid - epochs[b]`` lies in [0, nb) (wraparound-safe modular
+    arithmetic; columns from the future simply never exist because time is
+    a single host-stamped scalar).  The ``*_run`` read family instead
+    returns the running sums directly — single O(rows)/O(B) gathers with
+    no per-read reduction over the bucket axis.  They are EXACT whenever a
+    refresh ran in the same bucket as the read (the engine-tick contract:
+    completions refresh before any check reads); between refreshes they
+    only ever OVERESTIMATE (lazy expiry — the fail-closed direction).
+
+Slack windows (arXiv 1703.01166): ``WindowConfig.slack_frac > 0`` batches
+rotation/expiry to every ``ceil(slack_frac * nb)`` buckets.  The ring
+carries ``slack_buckets - 1`` extra physical columns so the write cursor
+only ever lands on columns the last batched expiry already purged — no
+live/stale mixing.  Expired-but-unpurged columns remain counted for at
+most ``slack_buckets - 1`` bucket lengths: a bounded OVERESTIMATE (the
+documented error direction), zero when slack is off (the default for the
+exact second-scale window).
 
 Everything is a pure function of (state, now_ms); nothing reads a clock.
+``now_ms`` is interpreted as UNSIGNED 32-bit engine-ms: the window id
+stays continuous when the host's int32 engine clock wraps past 2^31
+(~24.8 days at 1 ms buckets) and only resets at the full 2^32 horizon.
 """
 
 from __future__ import annotations
@@ -48,56 +82,168 @@ NUM_EVENTS = 5
 # SentinelConfig.java:63); this also matches StatisticNode minRt semantics.
 RT_MIN_INIT = 5000.0
 
+#: epoch sentinel for a column whose contents already left the running sums
+#: (batched expiry) but whose storage has not been zeroed yet — far outside
+#: any reachable window id so the age test can never read it as live
+PURGED = -(1 << 30)
+
 
 class WindowConfig(NamedTuple):
-    sample_count: int  # number of buckets (nb)
+    sample_count: int  # number of logical buckets (nb)
     window_ms: int  # bucket length
+    # slack fraction (arXiv 1703.01166): batch rotation/expiry to every
+    # ceil(slack_frac * nb) buckets, accepting a bounded overestimate-only
+    # window slack.  0.0 (default) = exact rotation every bucket.
+    slack_frac: float = 0.0
 
     @property
     def interval_ms(self) -> int:
         return self.sample_count * self.window_ms
 
+    @property
+    def slack_buckets(self) -> int:
+        """Buckets between batched expiries (g) — 1 means no slack."""
+        import math
+
+        if self.slack_frac <= 0.0:
+            return 1
+        return max(1, math.ceil(self.slack_frac * self.sample_count))
+
+    @property
+    def phys_buckets(self) -> int:
+        """Physical ring columns (nbp = nb + g - 1): the extra ``g - 1``
+        columns guarantee the write cursor only reaches columns the last
+        batched expiry already purged."""
+        return self.sample_count + self.slack_buckets - 1
+
 
 class WindowState(NamedTuple):
-    counts: jax.Array  # int32 [rows, nb, NUM_EVENTS]
-    rt_sum: jax.Array  # float32 [rows, nb]
-    rt_min: jax.Array  # float32 [rows, nb]
-    epochs: jax.Array  # int32 [nb]
+    counts: jax.Array  # int32 [rows, nbp, NUM_EVENTS]
+    rt_sum: jax.Array  # float32 [rows, nbp]
+    rt_min: jax.Array  # float32 [rows, nbp]
+    epochs: jax.Array  # int32 [nbp]
+    run: jax.Array  # int32 [rows, NUM_EVENTS] — O(1) windowed totals
+    run_rt: jax.Array  # float32 [rows] — O(1) windowed RT sum
+    run_rt_min: jax.Array  # float32 [rows] — windowed RT minimum
+    rot_wid: jax.Array  # int32 [] — wid of the last batched expiry
 
 
 def init_window(rows: int, cfg: WindowConfig) -> WindowState:
-    nb = cfg.sample_count
+    nbp = cfg.phys_buckets
     return WindowState(
-        counts=jnp.zeros((rows, nb, NUM_EVENTS), dtype=jnp.int32),
-        rt_sum=jnp.zeros((rows, nb), dtype=jnp.float32),
-        rt_min=jnp.full((rows, nb), RT_MIN_INIT, dtype=jnp.float32),
+        counts=jnp.zeros((rows, nbp, NUM_EVENTS), dtype=jnp.int32),
+        rt_sum=jnp.zeros((rows, nbp), dtype=jnp.float32),
+        rt_min=jnp.full((rows, nbp), RT_MIN_INIT, dtype=jnp.float32),
         # any epoch older than (0 - nb) is invalid from t=0
-        epochs=jnp.full((nb,), -(cfg.sample_count + 1), dtype=jnp.int32),
+        epochs=jnp.full((nbp,), -(cfg.sample_count + 1), dtype=jnp.int32),
+        run=jnp.zeros((rows, NUM_EVENTS), dtype=jnp.int32),
+        run_rt=jnp.zeros((rows,), dtype=jnp.float32),
+        run_rt_min=jnp.full((rows,), RT_MIN_INIT, dtype=jnp.float32),
+        rot_wid=jnp.int32(-(cfg.sample_count + 1)),
     )
 
 
+def wid_of(now_ms: jax.Array, window_ms: int) -> jax.Array:
+    """Window id of an engine-ms timestamp, continuous across the int32
+    clock wrap.
+
+    ``now_ms`` bits are reinterpreted as UNSIGNED 32-bit before the
+    division: the old signed form snapped to a discontinuous negative wid
+    at 2^31 (~24.8 days of engine-ms at 1 ms buckets) and silently reset
+    every window; unsigned division keeps ids marching to the full 2^32
+    horizon (~49.7 days), and all epoch comparisons downstream use modular
+    AGE differences, which stay exact for spans < 2^31 windows."""
+    u = jnp.asarray(now_ms).astype(jnp.uint32)
+    return (u // jnp.uint32(window_ms)).astype(jnp.int32)
+
+
 def _wid(now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
-    return (now_ms // cfg.window_ms).astype(jnp.int32)
+    return wid_of(now_ms, cfg.window_ms)
 
 
 def current_index(now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
-    return _wid(now_ms, cfg) % cfg.sample_count
+    u = jnp.asarray(now_ms).astype(jnp.uint32)
+    return ((u // jnp.uint32(cfg.window_ms)) % jnp.uint32(cfg.phys_buckets)).astype(
+        jnp.int32
+    )
+
+
+def _age(wid: jax.Array, epochs: jax.Array) -> jax.Array:
+    """Buckets-ago of each column, in wraparound-safe modular int32."""
+    return wid - epochs
 
 
 def refresh(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> WindowState:
-    """Lazily reset the current column if it holds an old window.
+    """Rotate: batched expiry of the running sums + lazy reset of the
+    current column.
 
     Batched analog of LeapArray.java:149-248 (CAS-create / reuse /
     tryLock-reset), applied to all rows of the column at once.
 
-    Masked single-column update instead of lax.cond: an XLA cond's
-    identity branch materializes a copy of every carried buffer (~20 MB
-    for the minute window — a measured ~0.1 ms/tick fixed cost each),
-    while the masked form touches one column in place under donation.
+    The expiry reductions (one masked pass over [rows, nbp] — the
+    2305.16513 rotation kernel) run under lax.cond gated on the bucket id
+    actually advancing past the last expiry, so steady-state ticks inside
+    one bucket pay O(rows) for the cond pass-through, not O(rows * nb).
+    The big bucket tensors are NOT cond outputs (an identity branch would
+    copy them — ~20 MB for the minute window, a measured ~0.1 ms/tick
+    fixed cost each); the current column is zeroed with a masked
+    single-column update in place under donation, exactly as before.
     """
+    nb = cfg.sample_count
+    nbp = cfg.phys_buckets
+    g = cfg.slack_buckets
     wid = _wid(now_ms, cfg)
-    idx = wid % cfg.sample_count
-    fresh = state.epochs[idx] == wid
+    idx = current_index(now_ms, cfg)
+
+    cur_epoch = state.epochs[idx]
+    fresh = cur_epoch == wid
+    cur_unpurged = ~fresh & (cur_epoch != PURGED)
+    # rotation due: the bucket id advanced g past the last batched expiry,
+    # or the write cursor reached a column whose contents are still in the
+    # running sums (safety net: slack invariant violations can only come
+    # from the 2^32 engine-clock horizon — never let run leak permanently)
+    due = (_age(wid, state.rot_wid) >= g) | cur_unpurged
+
+    cur_onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (nbp,), 0) == idx
+    )
+
+    def _expire(run, run_rt, run_rt_min, epochs):
+        age = _age(wid, epochs)
+        live = (age >= 0) & (age < nb) & (epochs != PURGED)
+        # everything outside the window — plus the cursor's own column if
+        # it is about to be recycled — leaves the running sums at once
+        doomed = (~live | (cur_onehot & ~fresh)) & (epochs != PURGED)
+        dm_i = doomed.astype(jnp.int32)[None, :, None]
+        dm_f = doomed.astype(jnp.float32)[None, :]
+        gone = jnp.sum(state.counts * dm_i, axis=1)
+        gone_rt = jnp.sum(state.rt_sum * dm_f, axis=1)
+        survivors = live & ~doomed
+        new_min = jnp.min(
+            jnp.where(survivors[None, :], state.rt_min, jnp.float32(RT_MIN_INIT)),
+            axis=1,
+        )
+        return (
+            run - gone,
+            run_rt - gone_rt,
+            new_min,
+            jnp.where(doomed, PURGED, epochs),
+            wid,
+        )
+
+    def _skip(run, run_rt, run_rt_min, epochs):
+        return run, run_rt, run_rt_min, epochs, state.rot_wid
+
+    run, run_rt, run_rt_min, epochs, rot_wid = jax.lax.cond(
+        due,
+        _expire,
+        _skip,
+        state.run,
+        state.run_rt,
+        state.run_rt_min,
+        state.epochs,
+    )
+
     keep_i = fresh.astype(state.counts.dtype)
     keep_f = fresh.astype(jnp.float32)
     return WindowState(
@@ -107,7 +253,11 @@ def refresh(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> WindowS
             jnp.where(fresh, state.rt_min[:, idx], RT_MIN_INIT)
         ),
         # reuse keeps epoch == wid, reset stamps it — identical either way
-        epochs=state.epochs.at[idx].set(wid),
+        epochs=epochs.at[idx].set(wid),
+        run=run,
+        run_rt=run_rt,
+        run_rt_min=run_rt_min,
+        rot_wid=jnp.asarray(rot_wid, jnp.int32),
     )
 
 
@@ -122,20 +272,31 @@ def add_batch(
     """Scatter a micro-batch of events into the current bucket column.
 
     Duplicate rows accumulate (scatter-add), which is the batched form of
-    the reference's LongAdder.add on the current WindowWrap.
-    """
+    the reference's LongAdder.add on the current WindowWrap.  Every delta
+    also lands in the running sums (the 1604.02450 add-new half)."""
     state = refresh(state, now_ms, cfg)
     idx = current_index(now_ms, cfg)
     counts = state.counts.at[rows, idx, :].add(deltas, mode="drop")
+    run = state.run.at[rows, :].add(deltas, mode="drop")
+    run_rt_min = state.run_rt_min
     if rt is not None:
         rt_sum = state.rt_sum.at[rows, idx].add(rt, mode="drop")
+        run_rt = state.run_rt.at[rows].add(rt, mode="drop")
         # min only among events that actually carry an RT (rt > 0 marks them;
         # use a large fill for non-carriers so they don't clobber the min)
         rt_for_min = jnp.where(rt > 0, rt, jnp.float32(RT_MIN_INIT))
         rt_min = state.rt_min.at[rows, idx].min(rt_for_min, mode="drop")
+        run_rt_min = run_rt_min.at[rows].min(rt_for_min, mode="drop")
     else:
-        rt_sum, rt_min = state.rt_sum, state.rt_min
-    return WindowState(counts=counts, rt_sum=rt_sum, rt_min=rt_min, epochs=state.epochs)
+        rt_sum, rt_min, run_rt = state.rt_sum, state.rt_min, state.run_rt
+    return state._replace(
+        counts=counts,
+        rt_sum=rt_sum,
+        rt_min=rt_min,
+        run=run,
+        run_rt=run_rt,
+        run_rt_min=run_rt_min,
+    )
 
 
 def add_dense(
@@ -150,21 +311,62 @@ def add_dense(
 
     The MXU-path companion of add_batch: the batch is first reduced to a
     dense histogram (ops/tables.histogram — one-hot matmuls), then landing
-    it in the window is a plain elementwise add on the current column.
-    Per-row rt_min lands from ``row_min`` — the exact dense minimum built
-    by ops/rowmin.py (sort + segmented scan + head sum-scatter)."""
+    it in the window is a plain elementwise add on the current column AND
+    on the running sums.  Per-row rt_min lands from ``row_min`` — the
+    exact dense minimum built by ops/rowmin.py (sort + segmented scan +
+    head sum-scatter)."""
     state = refresh(state, now_ms, cfg)
     idx = current_index(now_ms, cfg)
-    counts = state.counts.at[:, idx, :].add(count_hist.astype(state.counts.dtype))
-    rt_sum = state.rt_sum if rt_hist is None else state.rt_sum.at[:, idx].add(rt_hist)
+    ch = count_hist.astype(state.counts.dtype)
+    counts = state.counts.at[:, idx, :].add(ch)
+    run = state.run + ch
+    if rt_hist is None:
+        rt_sum, run_rt = state.rt_sum, state.run_rt
+    else:
+        rt_sum = state.rt_sum.at[:, idx].add(rt_hist)
+        run_rt = state.run_rt + rt_hist
     rt_min = state.rt_min
+    run_rt_min = state.run_rt_min
     if row_min is not None:
         mins, present = row_min
-        rt_min = rt_min.at[:, idx].min(
-            jnp.where(present, mins, jnp.float32(RT_MIN_INIT))
-        )
-    return WindowState(
-        counts=counts, rt_sum=rt_sum, rt_min=rt_min, epochs=state.epochs
+        filled = jnp.where(present, mins, jnp.float32(RT_MIN_INIT))
+        rt_min = rt_min.at[:, idx].min(filled)
+        run_rt_min = jnp.minimum(run_rt_min, filled)
+    return state._replace(
+        counts=counts,
+        rt_sum=rt_sum,
+        rt_min=rt_min,
+        run=run,
+        run_rt=run_rt,
+        run_rt_min=run_rt_min,
+    )
+
+
+def add_row_delta(
+    state: WindowState,
+    now_ms: jax.Array,
+    row: int,
+    deltas: jax.Array,  # int32 [NUM_EVENTS]
+    rt: Optional[jax.Array],  # float32 scalar or None
+    cfg: WindowConfig,
+) -> WindowState:
+    """Add a single fixed row's delta vector (static row index — cheap).
+
+    The ENTRY-node reduction path: the caller already summed the batch, so
+    this is one .at[row] update on the bucket column and the running sums
+    (keeping both in lockstep — direct field writes would silently leave
+    the running sums behind).  The caller must have refreshed this
+    ``now_ms`` already (it always lands right after add_batch/add_dense)."""
+    idx = current_index(now_ms, cfg)
+    counts = state.counts.at[row, idx, :].add(deltas)
+    run = state.run.at[row, :].add(deltas)
+    if rt is None:
+        return state._replace(counts=counts, run=run)
+    return state._replace(
+        counts=counts,
+        run=run,
+        rt_sum=state.rt_sum.at[row, idx].add(rt),
+        run_rt=state.run_rt.at[row].add(rt),
     )
 
 
@@ -176,18 +378,25 @@ def min_into_row(
     while the dense path skips per-row minimums."""
     idx = current_index(now_ms, cfg)
     rt_min = state.rt_min.at[row, idx].min(value)
-    return state._replace(rt_min=rt_min)
+    run_rt_min = state.run_rt_min.at[row].min(value)
+    return state._replace(rt_min=rt_min, run_rt_min=run_rt_min)
 
 
 def valid_mask(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
-    """bool [nb] — which columns fall inside [now - interval, now]."""
-    wid = _wid(now_ms, cfg)
-    return (state.epochs > wid - cfg.sample_count) & (state.epochs <= wid)
+    """bool [nbp] — which columns fall inside [now - interval, now]."""
+    age = _age(_wid(now_ms, cfg), state.epochs)
+    return (age >= 0) & (age < cfg.sample_count) & (state.epochs != PURGED)
+
+
+# -- exact masked reads (host observability, migration, oracles) -------------
 
 
 def window_counts(state: WindowState, now_ms: jax.Array, cfg: WindowConfig) -> jax.Array:
-    """int32 [rows, NUM_EVENTS] — sum over valid buckets (ArrayMetric reads)."""
-    mask = valid_mask(state, now_ms, cfg)  # [nb]
+    """int32 [rows, NUM_EVENTS] — sum over valid buckets (ArrayMetric reads).
+
+    Exact at any ``now_ms`` — pays a [rows, nbp] reduction per call; the
+    tick hot path reads the running sums instead (window_counts_run)."""
+    mask = valid_mask(state, now_ms, cfg)  # [nbp]
     return jnp.sum(state.counts * mask[None, :, None], axis=1)
 
 
@@ -216,13 +425,10 @@ def gather_window_event(
     cfg: WindowConfig,
     event: int,
 ) -> jax.Array:
-    """int32 [B] — windowed event total for selected rows only.
-
-    The decision path reads only the rows referenced by the batch, so this
-    is a [B, nb] gather instead of a full [rows, nb] reduction.
-    """
-    mask = valid_mask(state, now_ms, cfg)  # [nb]
-    vals = state.counts[rows, :, event]  # [B, nb] gather
+    """int32 [B] — windowed event total for selected rows only (exact
+    masked form — a [B, nbp] gather + reduction)."""
+    mask = valid_mask(state, now_ms, cfg)  # [nbp]
+    vals = state.counts[rows, :, event]  # [B, nbp] gather
     return jnp.sum(vals * mask[None, :], axis=1)
 
 
@@ -234,7 +440,7 @@ def gather_window_counts(
 ) -> jax.Array:
     """int32 [B, NUM_EVENTS] for selected rows."""
     mask = valid_mask(state, now_ms, cfg)
-    vals = state.counts[rows, :, :]  # [B, nb, NE]
+    vals = state.counts[rows, :, :]  # [B, nbp, NE]
     return jnp.sum(vals * mask[None, :, None], axis=1)
 
 
@@ -252,3 +458,40 @@ def gather_window_rt(
         axis=1,
     )
     return rt_total, rt_min
+
+
+# -- O(1) running-sum reads (the tick hot path) ------------------------------
+#
+# Single gathers from the running sums: no bucket-axis reduction, cost
+# O(rows) / O(B) regardless of the window shape.  EXACT whenever refresh
+# ran in the read's bucket (the engine tick refreshes on the completion
+# write before any check reads, all at one now_ms); otherwise they lag
+# expiry and only ever OVERESTIMATE (lazy expiry — fail-closed).  Under
+# slack they additionally carry the configured bounded slack overestimate.
+
+
+def window_counts_run(state: WindowState) -> jax.Array:
+    """int32 [rows, NUM_EVENTS] — windowed totals, zero reduction."""
+    return state.run
+
+
+def window_event_run(state: WindowState, event: int) -> jax.Array:
+    """int32 [rows] — one event's windowed totals, zero reduction."""
+    return state.run[:, event]
+
+
+def gather_window_event_run(
+    state: WindowState, rows: jax.Array, event: int
+) -> jax.Array:
+    """int32 [B] — single gather from the running sums."""
+    return state.run[rows, event]
+
+
+def gather_window_counts_run(state: WindowState, rows: jax.Array) -> jax.Array:
+    """int32 [B, NUM_EVENTS] — single gather from the running sums."""
+    return state.run[rows, :]
+
+
+def gather_window_rt_run(state: WindowState, rows: jax.Array):
+    """(rt_total f32 [B], rt_min f32 [B]) — single gathers."""
+    return state.run_rt[rows], state.run_rt_min[rows]

@@ -52,6 +52,10 @@ def _sketch_shardings(cfg: EngineConfig, mesh: Mesh, rep):
             lvlmap=NamedSharding(mesh, PS(None, None, None, "res")),
             run=NamedSharding(mesh, PS(None, None, "res")),
             epochs=rep,
+            rot_wid=rep,
+            # the unpacked current bucket shards on width like run
+            cur=NamedSharding(mesh, PS(None, None, "res")),
+            cur_wid=rep,
         )
     return GS.SketchState(
         counts=NamedSharding(mesh, PS(None, None, "res", None)),
@@ -67,7 +71,12 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
 
     def win(ws_rows_sharded: bool) -> W.WindowState:
         r = row if ws_rows_sharded else rep
-        return W.WindowState(counts=r, rt_sum=r, rt_min=r, epochs=rep)
+        # the O(1) running sums are row-indexed like the bucket tensors,
+        # so they shard on the same axis; epoch/rotation scalars replicate
+        return W.WindowState(
+            counts=r, rt_sum=r, rt_min=r, epochs=rep,
+            run=r, run_rt=r, run_rt_min=r, rot_wid=rep,
+        )
 
     return E.EngineState(
         win_sec=win(True),

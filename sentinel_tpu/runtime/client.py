@@ -218,6 +218,30 @@ _C_COLS_SKIPPED = OBS.counter(
     "sentinel_wire_cols_skipped_total",
     "batch-column uploads skipped because the column matched the previous tick",
 )
+# -- window rotation cadence (r14 running-sum windows, ops/window.py):
+# refresh() is a pure function of the stamped tick timestamp, so the
+# host derives the device's rotation/skip decisions from the timestamps
+# it stamps — no readback.  "second" is the exact tier (g=1, every
+# boundary rotates); "sketch" is the minute-scale tier where slack_frac
+# batches the purge every g buckets (skips = deferred boundaries).
+_C_WIN_ROT = {
+    w: OBS.counter(
+        "sentinel_window_rotations_total",
+        "window bucket rotations whose batched expiry purge ran (host-derived"
+        " from the tick timestamps; mirrors the device rotation condition)",
+        labels={"window": w},
+    )
+    for w in ("second", "sketch")
+}
+_C_WIN_SLACK = {
+    w: OBS.counter(
+        "sentinel_window_slack_skips_total",
+        "window bucket boundaries crossed with the expiry purge deferred by"
+        " slack batching (bounded overestimate until the next rotation)",
+        labels={"window": w},
+    )
+    for w in ("second", "sketch")
+}
 
 
 def _shed_counter(stage: str, reason: str):
@@ -714,6 +738,19 @@ class SentinelClient:
         self._seg_resizing = False
         self._build_ms_sum = 0.0
         self._build_ticks = 0
+        # host mirror of the device window-rotation cadence: refresh is a
+        # pure function of the stamped tick timestamp, so bucket-boundary
+        # crossings and the slack-deferred purges are derivable here
+        # without any readback ({window: (window_ms, slack_buckets,
+        # last_wid, last_rot_wid)})
+        self._rot_track = {
+            "second": [cfg.second_window_ms, 1, None, None],
+        }
+        if cfg.sketch_stats:
+            scfg = E.sketch_config(cfg)
+            self._rot_track["sketch"] = [
+                scfg.window_ms, scfg.slack_buckets, None, None,
+            ]
         #: items whose EFFECTS a seg_fallback=False engine dropped on
         #: capacity overflow (verdicts fail closed; see EngineConfig.seg_u)
         self.seg_dropped_total = 0
@@ -3051,6 +3088,7 @@ class SentinelClient:
         load, cpu = self._sys.sample()
         t = now_ms if now_ms is not None else self.time.now_ms()
         t += FP.skew_ms(_FP_TICK_CLOCK)  # chaos: deterministic clock skew
+        self._count_rotations(int(t))
         ad = self._adaptive
         if ad is not None:
             # closed loop: signals row -> controller -> ladder + live
@@ -3103,6 +3141,26 @@ class SentinelClient:
             except Exception:  # stlint: disable=fail-open — prefetch hint only; _resolve_tick still reads the verdict synchronously
                 pass
         return p
+
+    def _count_rotations(self, t: int) -> None:
+        """Advance the host mirror of the device window-rotation cadence
+        for one stamped tick timestamp (see _C_WIN_ROT): a refresh at a
+        new bucket rotates iff ``wid - last_rot_wid >= slack_buckets``
+        (ops/window.refresh's cond), otherwise slack deferred it."""
+        for key, tr in self._rot_track.items():
+            wms, g, last_wid, last_rot = tr
+            wid = (t & 0xFFFFFFFF) // wms  # uint32 view, as W.wid_of
+            if last_wid is None:
+                tr[2] = tr[3] = wid
+                continue
+            if wid == last_wid:
+                continue
+            if wid - last_rot >= g:
+                _C_WIN_ROT[key].inc()
+                tr[3] = wid
+            else:
+                _C_WIN_SLACK[key].inc()
+            tr[2] = wid
 
     def _pool(self):
         """Lazily (re)create the resolver pool — stop() shuts it down."""

@@ -23,24 +23,49 @@ counter's coverage, so estimates stay upper bounds (min-over-depth CMS
 semantics intact; heavy neighborhoods degrade toward width/4, the
 documented SALSA trade).
 
+The CURRENT bucket is the exception: it accumulates UNPACKED in ``cur``
+(one int32 plane set, ~4W bytes) so the per-tick write is a plain
+clamped vector add — no packed-word decode/escalate arithmetic, and no
+functional update of the O(nbp * W) ring tensors, which would copy tens
+of MB per tick on backends without buffer donation.  The SALSA packing
+runs ONCE per bucket, when refresh lands the finished ``cur`` into its
+ring column (amortized ~window_ms per pack instead of per tick).
+Intra-bucket estimates read exact values; the merge overestimate enters
+only at landing — strictly tighter than packing eagerly.
+
 READS — O(1) windowed sums (arXiv 1604.02450): ``run`` holds the decoded
 window total per logical column, maintained INCREMENTALLY — adds land
-their decoded delta, and a bucket subtracts its decoded contents exactly
-once, when it rotates out.  Reads gather ``run`` directly; no per-read
-sum over sample_count buckets, and the estimate cost is independent of
-the window shape.
+their decoded delta, and expired buckets subtract their decoded contents
+exactly once, at a batched rotation.  Reads gather ``run`` directly; no
+per-read sum over sample_count buckets, and the estimate cost is
+independent of the window shape.
+
+ROTATION — batched expiry under slack (arXiv 1703.01166 +
+2305.16513-style vectorized kernel): every ``slack_buckets`` buckets (1
+when ``cfg.slack_frac`` is 0), ONE masked decode-and-subtract pass
+expires every out-of-window bucket from ``run`` at once, inside a
+lax.cond whose outputs are only the O(depth·P·W) running sums + epochs —
+the big packed-word tensors never cross the cond, so steady-state ticks
+inside a bucket pay a scalar predicate, not a decode.  Expired columns
+are stamped ``window.PURGED`` (subtract-once) and their storage is zeroed
+lazily when the write cursor next lands on them; the ring carries
+``slack_buckets - 1`` extra physical columns so the cursor only reaches
+already-purged columns.  Under slack, expired-but-unpurged buckets remain
+counted for at most ``slack_buckets - 1`` bucket lengths — a bounded
+OVERESTIMATE, the enforcement-safe direction.
 
 Lazy expiry (documented transient): after an idle gap longer than the
-window interval, buckets that expired WITHOUT being rotated into still
-sit in ``run`` until traffic rotates them out (one per window_ms).  Until
-then estimates OVERESTIMATE by at most one pre-gap window volume — the
-conservative direction for enforcement (blocks fire early, never late).
+window interval, buckets that expired WITHOUT a rotation running still
+sit in ``run`` until the next write triggers one.  Until then estimates
+OVERESTIMATE by at most one pre-gap window volume — the conservative
+direction for enforcement (blocks fire early, never late).
 ``sweep_expired`` purges them eagerly for callers that care (tests,
 post-idle maintenance).
 
 Every estimate here is >= the true windowed count: CMS collision, SALSA
-merge, and lazy expiry all err upward.  Tail-rule enforcement built on it
-therefore fails CLOSED (tests/test_salsa.py pins the invariant).
+merge, slack, and lazy expiry all err upward.  Tail-rule enforcement
+built on it therefore fails CLOSED (tests/test_salsa.py pins the
+invariant).
 """
 
 from __future__ import annotations
@@ -50,8 +75,14 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from sentinel_tpu.ops import mxu_table as MX
-from sentinel_tpu.ops.gsketch import PLANES, RT_PLANE, RT_SCALE, SketchConfig, _wid
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops.gsketch import (
+    PLANES,
+    RT_PLANE,
+    RT_SCALE,
+    SketchConfig,
+    _wid,
+)
 from sentinel_tpu.ops.param import cms_cell
 
 #: words per packed int32 of the width bitmap (2 bits per word level)
@@ -60,20 +91,23 @@ _BMP = 16
 
 def _cap2(cfg: SketchConfig) -> int:
     """Level-2 cell clamp, sized so the OVERFLOW-FREE invariant holds by
-    construction: ``run`` sums at most sample_count decoded buckets, each
-    cell <= cap2, so run <= sample_count * cap2 <= int32 max — the
+    construction: ``run`` sums at most phys_buckets decoded buckets, each
+    cell <= cap2, so run <= phys_buckets * cap2 <= int32 max — the
     running sums can never wrap negative and silently invert the
     fail-closed bias to fail-open for the heaviest cell.  At the minute
-    window (nb=60) this still allows ~35 M token-weighted events per
+    window (nb=60) this still allows ~33 M token-weighted events per
     cell per SECOND-long bucket, far past the device's total peak."""
-    return ((1 << 31) - 1) // max(cfg.sample_count, 2)
+    return ((1 << 31) - 1) // max(cfg.phys_buckets, 2)
 
 
 class SalsaState(NamedTuple):
-    words: jax.Array  # int32 [nb, depth, PLANES, Wp]  packed counter words
-    lvlmap: jax.Array  # int32 [nb, depth, PLANES, Wp // 16]  2-bit width bitmap
+    words: jax.Array  # int32 [nbp, depth, PLANES, Wp]  packed counter words
+    lvlmap: jax.Array  # int32 [nbp, depth, PLANES, Wp // 16]  2-bit width bitmap
     run: jax.Array  # int32 [depth, PLANES, W]  O(1) running window sums
-    epochs: jax.Array  # int32 [nb]  window-id per bucket column
+    epochs: jax.Array  # int32 [nbp]  window-id per bucket column
+    rot_wid: jax.Array  # int32 []  wid of the last batched expiry
+    cur: jax.Array  # int32 [depth, PLANES, W]  UNPACKED current bucket
+    cur_wid: jax.Array  # int32 []  wid the cur buffer belongs to
 
 
 def _wp(cfg: SketchConfig) -> int:
@@ -87,14 +121,23 @@ def _wp(cfg: SketchConfig) -> int:
 
 def init_sketch(cfg: SketchConfig) -> SalsaState:
     wp = _wp(cfg)
+    nbp = cfg.phys_buckets
     return SalsaState(
-        words=jnp.zeros((cfg.sample_count, cfg.depth, PLANES, wp), jnp.int32),
-        lvlmap=jnp.zeros(
-            (cfg.sample_count, cfg.depth, PLANES, wp // _BMP), jnp.int32
-        ),
+        words=jnp.zeros((nbp, cfg.depth, PLANES, wp), jnp.int32),
+        lvlmap=jnp.zeros((nbp, cfg.depth, PLANES, wp // _BMP), jnp.int32),
         run=jnp.zeros((cfg.depth, PLANES, cfg.width), jnp.int32),
-        epochs=jnp.full((cfg.sample_count,), -(cfg.sample_count + 1), jnp.int32),
+        epochs=jnp.full((nbp,), -(cfg.sample_count + 1), jnp.int32),
+        rot_wid=jnp.int32(-(cfg.sample_count + 1)),
+        cur=jnp.zeros((cfg.depth, PLANES, cfg.width), jnp.int32),
+        cur_wid=jnp.int32(-(cfg.sample_count + 1)),
     )
+
+
+def _index_of(wid, cfg: SketchConfig):
+    """Ring column of a window id (same modular view as gsketch._index)."""
+    return (
+        jnp.asarray(wid).astype(jnp.uint32) % jnp.uint32(cfg.phys_buckets)
+    ).astype(jnp.int32)
 
 
 # -- width bitmap ------------------------------------------------------------
@@ -176,43 +219,121 @@ def _land_words(words: jax.Array, lvl: jax.Array, upd: jax.Array, cap2: int):
 
 
 def refresh(state: SalsaState, now_ms, cfg: SketchConfig) -> SalsaState:
-    """Rotate the current bucket column: when it still holds an expired
-    window, subtract its decoded contents from the running sums (the
-    1604.02450 subtract-expired step) and zero its words + bitmap.
+    """Rotate: batched expiry of the running sums + landing of the
+    finished bucket into the packed ring.
 
-    Masked single-column math, no lax.cond (a cond's identity branch
-    would copy every carried buffer each tick — ops/window.refresh)."""
+    The current bucket lives UNPACKED in ``cur`` (adds are a plain
+    vector add — no packed-word arithmetic, no touch of the big ring
+    tensors), so the per-tick steady state here is two scalar predicates
+    and one single-column write-back of unchanged values.  When the
+    bucket id advances, ``cur`` is packed ONCE (the SALSA escalation,
+    amortized from every tick to every bucket) and landed into its ring
+    column; ``run`` absorbs the encode delta (decode >= exact per cell —
+    the merge overestimate enters only at landing, never mid-bucket).
+
+    The expiry (decode every column once, subtract all expired buckets
+    from ``run`` in one masked pass — the 1604.02450 subtract-expired
+    step, vectorized over the whole ring) runs under lax.cond, gated on
+    the bucket id advancing ``slack_buckets`` past the last expiry or the
+    landing cursor reaching a column whose contents are still in ``run``
+    (the safety net that makes leaks impossible even across the 2^32
+    engine-clock horizon).  Only ``run`` + ``epochs`` + ``rot_wid`` cross
+    that cond, and only column-sized tensors cross the landing cond — the
+    big packed ring tensors cross neither (an identity branch would copy
+    them every tick).  Expired columns are stamped ``window.PURGED`` so
+    they subtract exactly once; landing OVERWRITES its (always purged)
+    target column, which retires the seed's per-tick lazy zeroing."""
     wp = _wp(cfg)
+    nb = cfg.sample_count
+    nbp = cfg.phys_buckets
+    g = cfg.slack_buckets
     wid = _wid(now_ms, cfg)
-    idx = wid % cfg.sample_count
-    fresh = state.epochs[idx] == wid
-    keep = fresh.astype(jnp.int32)
-    dec = _decode(state.words[idx], unpack_levels(state.lvlmap[idx], wp))
+    land = state.cur_wid != wid
+    land_idx = _index_of(state.cur_wid, cfg)
+    tgt_epoch = state.epochs[land_idx]
+    due = (wid - state.rot_wid >= g) | (land & (tgt_epoch != W.PURGED))
+    land_onehot = jax.lax.broadcasted_iota(jnp.int32, (nbp,), 0) == land_idx
+
+    def _expire(run, epochs):
+        age = wid - epochs
+        live = (age >= 0) & (age < nb) & (epochs != W.PURGED)
+        doomed = (~live | (land_onehot & land)) & (epochs != W.PURGED)
+        lvl = unpack_levels(state.lvlmap, wp)
+        dec = _decode(state.words, lvl)  # [nbp, depth, P, W]
+        gone = jnp.sum(dec * doomed.astype(jnp.int32)[:, None, None, None], axis=0)
+        return run - gone, jnp.where(doomed, W.PURGED, epochs), wid
+
+    def _skip(run, epochs):
+        return run, epochs, state.rot_wid
+
+    run, epochs, rot_wid = jax.lax.cond(
+        due, _expire, _skip, state.run, state.epochs
+    )
+
+    col_w = state.words[land_idx]
+    col_l = state.lvlmap[land_idx]
+
+    def _land(run, epochs, cur):
+        # pack the finished bucket into an empty column (the target is
+        # purged by construction — the expiry cond above guarantees it)
+        nw, nl, _, dec_a = _land_words(
+            jnp.zeros_like(col_w),
+            jnp.zeros((cfg.depth, PLANES, wp), jnp.int32),
+            cur,
+            _cap2(cfg),
+        )
+        return (
+            nw,
+            pack_levels(nl),
+            run + (dec_a - cur),
+            epochs.at[land_idx].set(state.cur_wid),
+            jnp.zeros_like(cur),
+        )
+
+    def _stay(run, epochs, cur):
+        return col_w, col_l, run, epochs, cur
+
+    ncw, ncl, run, epochs, cur = jax.lax.cond(
+        land, _land, _stay, run, epochs, state.cur
+    )
     return SalsaState(
-        words=state.words.at[idx].multiply(keep),
-        lvlmap=state.lvlmap.at[idx].multiply(keep),
-        run=state.run - jnp.where(fresh, 0, dec),
-        epochs=state.epochs.at[idx].set(wid),
+        words=state.words.at[land_idx].set(ncw),
+        lvlmap=state.lvlmap.at[land_idx].set(ncl),
+        run=run,
+        epochs=epochs,
+        rot_wid=jnp.asarray(rot_wid, jnp.int32),
+        cur=cur,
+        cur_wid=jnp.asarray(wid, jnp.int32),
     )
 
 
 def sweep_expired(state: SalsaState, now_ms, cfg: SketchConfig) -> SalsaState:
-    """Eagerly purge EVERY expired bucket from the running sums (not just
-    the current rotation target).  O(nb * W) — the cost refresh avoids on
-    the hot path; callers use it after known idle gaps or in tests to
+    """Eagerly purge EVERY expired bucket from the running sums and zero
+    their storage.  O(nbp * W) — the cost refresh amortizes over
+    slack_buckets; callers use it after known idle gaps or in tests to
     collapse the lazy-expiry overestimate immediately."""
     wp = _wp(cfg)
     wid = _wid(now_ms, cfg)
-    live = (state.epochs > wid - cfg.sample_count) & (state.epochs <= wid)
+    age = wid - state.epochs
+    live = (age >= 0) & (age < cfg.sample_count) & (state.epochs != W.PURGED)
+    # PURGED columns already left run — zero their storage, subtract nothing
+    doomed = ~live & (state.epochs != W.PURGED)
     lvl = unpack_levels(state.lvlmap, wp)
-    dec = _decode(state.words, lvl)  # [nb, depth, P, W]
-    gone = jnp.sum(dec * jnp.where(live, 0, 1)[:, None, None, None], axis=0)
+    dec = _decode(state.words, lvl)  # [nbp, depth, P, W]
+    gone = jnp.sum(dec * doomed.astype(jnp.int32)[:, None, None, None], axis=0)
     keep = live.astype(jnp.int32)[:, None, None, None]
+    # the unpacked current bucket expires with its wid like any column
+    cage = wid - state.cur_wid
+    cur_live = (cage >= 0) & (cage < cfg.sample_count)
+    ckeep = cur_live.astype(jnp.int32)
     return SalsaState(
         words=state.words * keep,
         lvlmap=state.lvlmap * keep,
-        run=state.run - gone,
-        epochs=state.epochs,
+        run=state.run - gone - (1 - ckeep) * state.cur,
+        epochs=jnp.where(live, state.epochs, W.PURGED),
+        rot_wid=jnp.asarray(wid, jnp.int32),
+        cur=state.cur * ckeep,
+        cur_wid=jnp.where(cur_live, state.cur_wid, wid).astype(jnp.int32),
     )
 
 
@@ -227,29 +348,26 @@ def add_dense(
     cfg: SketchConfig,
     pre_refreshed: bool = False,
 ) -> SalsaState:
-    """Land a precomputed logical-width histogram into the current bucket,
-    escalating saturated words and folding the decoded delta into the
-    running window sums.  ``pre_refreshed``: see ops/gsketch.add."""
+    """Land a precomputed logical-width histogram into the current bucket
+    accumulator — a plain clamped vector add on the UNPACKED ``cur``
+    buffer, mirrored into the running window sums.  The packed-word
+    escalation happens once per bucket, at refresh's landing step, not
+    here.  ``pre_refreshed``: see ops/gsketch.add."""
     if not pre_refreshed:
         state = refresh(state, now_ms, cfg)
-    wp = _wp(cfg)
-    idx = _wid(now_ms, cfg) % cfg.sample_count
     # scatter the touched planes into a full-plane update: untouched
-    # planes land zeros, which _land_words treats as an exact no-op —
-    # simpler than plane-sliced advanced indexing on the packed tensors
+    # planes land zeros — simpler than plane-sliced advanced indexing
     u_full = jnp.zeros((cfg.depth, PLANES, cfg.width), jnp.int32)
     u_full = u_full.at[:, jnp.asarray(plane_idx), :].set(
         jnp.swapaxes(upd, 1, 2).astype(jnp.int32)
     )
-    lvl = unpack_levels(state.lvlmap[idx], wp)
-    new_words, new_lvl, dec_b, dec_a = _land_words(
-        state.words[idx], lvl, u_full, _cap2(cfg)
-    )
-    return SalsaState(
-        words=state.words.at[idx].set(new_words),
-        lvlmap=state.lvlmap.at[idx].set(pack_levels(new_lvl)),
-        run=state.run + (dec_a - dec_b),
-        epochs=state.epochs,
+    # cap2 clamp per cell keeps the bucket's run contribution bounded, so
+    # the _cap2 overflow-free invariant holds exactly as it did when the
+    # clamp sat in the per-tick packed landing
+    new_cur = jnp.minimum(state.cur + u_full, _cap2(cfg))
+    return state._replace(
+        cur=new_cur,
+        run=state.run + (new_cur - state.cur),
     )
 
 
@@ -263,28 +381,21 @@ def add(
     cfg: SketchConfig,
     max_int: int = 65535,
     pre_refreshed: bool = False,
+    ecfg=None,  # EngineConfig — tables.py backend dispatch (None = native)
 ) -> SalsaState:
-    """Batched event ingest: per-depth MXU one-hot histograms at LOGICAL
-    width (same contraction as ops/gsketch.add — the packed storage only
+    """Batched event ingest: ONE flat histogram at LOGICAL width across
+    all depths (ops/tables.depth_histogram — native scatter on CPU, a
+    single digit-plane MXU contraction on TPU; the packed storage only
     changes how the histogram lands, not how it is built)."""
+    from sentinel_tpu.ops import tables as T
+
     if not pre_refreshed:
         state = refresh(state, now_ms, cfg)
     cols = cms_cell(res, cfg.depth, cfg.width)  # [N, depth]
-    plan = MX.plan_for(cfg.width, 512)
-    upds = []
-    for d in range(cfg.depth):
-        Hi, Lo = MX.onehots(cols[:, d], plan, valid=valid)
-        upds.append(
-            MX.scatter_add(
-                jnp.zeros((cfg.width, len(plane_idx)), jnp.int32),
-                plan,
-                Hi,
-                Lo,
-                values,
-                max_int=max_int,
-            )
-        )
-    upd = jnp.stack(upds, axis=0)  # [depth, width, len(plane_idx)]
+    upd = T.depth_histogram(
+        ecfg, cols, values.astype(jnp.int32), valid, cfg.depth, cfg.width,
+        max_int=max_int,
+    )  # [depth, width, len(plane_idx)]
     return add_dense(state, now_ms, upd, plane_idx, cfg, pre_refreshed=True)
 
 
@@ -300,19 +411,21 @@ def estimate_plane_mxu(
     cfg: SketchConfig,
 ) -> jax.Array:
     """f32 [N]: min-over-depth windowed estimate of ONE plane, read
-    straight from the running sums — O(1) in the window shape (the seed
-    impl summed all sample_count buckets per read)."""
+    straight from the running sums — O(1) in the window shape, and ONE
+    flat gather/contraction across all depths (tables.depth_gather_1col;
+    the seed looped a lane gather per depth)."""
     from sentinel_tpu.ops import tables as T
 
     cols = cms_cell(res, cfg.depth, cfg.width)
     cap = jnp.int32((1 << 24) - 1)
-    ests = []
-    for d in range(cfg.depth):
-        g = T.lane_gather_1col(
-            ecfg, jnp.minimum(state.run[d, plane], cap), cols[:, d], cfg.width
-        )
-        ests.append(g)
-    return jnp.min(jnp.stack(ests, axis=0), axis=0).astype(jnp.float32)
+    g = T.depth_gather_1col(
+        ecfg,
+        jnp.minimum(state.run[:, plane, :], cap),
+        cols,
+        cfg.width,
+        max_int=(1 << 24) - 1,
+    )  # [depth, N]
+    return jnp.min(g, axis=0).astype(jnp.float32)
 
 
 def estimate(
@@ -335,20 +448,31 @@ def level_histogram(state: SalsaState, cfg: SketchConfig) -> jax.Array:
     the whole sketch — the saturation/merge telemetry the hot-set manager
     exports (``sentinel_sketch_merged_words``).  Effective width for the
     error bound degrades with merged share: eps ~ e / (W * (n0 + n1/2 +
-    n2/4) / (n0 + n1 + n2))."""
-    lvl = unpack_levels(state.lvlmap, _wp(cfg))
+    n2/4) / (n0 + n1 + n2)).  The unpacked current bucket reports the
+    levels it WILL land at (its ring column — stale until landing — is
+    replaced by that virtual view)."""
+    wp = _wp(cfg)
+    lvl = unpack_levels(state.lvlmap, wp)
+    u = state.cur.reshape(cfg.depth, PLANES, wp, 4)
+    u1 = u[..., 0::2] + u[..., 1::2]
+    fit0 = jnp.all(u <= 255, axis=-1)
+    fit1 = ~fit0 & jnp.all(u1 <= 65535, axis=-1)
+    vlvl = jnp.where(fit0, 0, jnp.where(fit1, 1, 2)).astype(jnp.int32)
+    lvl = lvl.at[_index_of(state.cur_wid, cfg)].set(vlvl)
     return jnp.stack([jnp.sum(lvl == k) for k in range(3)]).astype(jnp.int32)
 
 
 def hbm_bytes(cfg: SketchConfig) -> int:
     """Persistent HBM bytes of a SalsaState at this config (words + bitmap
-    + running sums + epochs) — the BENCH sketch_tier row's storage
-    number."""
+    + running sums + unpacked current bucket + epochs + watermarks) — the
+    BENCH sketch_tier row's storage number."""
     wp = cfg.width // 4
-    nb, d = cfg.sample_count, cfg.depth
+    nbp, d = cfg.phys_buckets, cfg.depth
     return 4 * (
-        nb * d * PLANES * wp  # words
-        + nb * d * PLANES * (wp // _BMP)  # width bitmap
+        nbp * d * PLANES * wp  # words
+        + nbp * d * PLANES * (wp // _BMP)  # width bitmap
         + d * PLANES * cfg.width  # running sums
-        + nb  # epochs
+        + d * PLANES * cfg.width  # unpacked current bucket
+        + nbp  # epochs
+        + 2  # rot_wid + cur_wid
     )

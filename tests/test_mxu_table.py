@@ -120,6 +120,108 @@ def test_lane_gather_1col_matches_big_gather():
     np.testing.assert_array_equal(got, tab[ids])
 
 
+@pytest.mark.parametrize(
+    "n,n_lo",
+    [
+        (1, 512), (7, 512), (127, 64), (128, 512), (129, 512), (131, 128),
+        (255, 1000), (4093, 512), (1 << 14, 512), ((1 << 14) + 1, 512),
+        (70_000, 384),
+    ],
+)
+def test_make_plan_clamp_invariants(n, n_lo):
+    """The clamp must hold for ANY (n, n_lo): the padded id space covers
+    every logical id, the Lo axis is lane-friendly, and small tables never
+    keep a caller's wide default (minimal padding, one Hi row)."""
+    plan = M.make_plan(n, n_lo)
+    assert plan.n_lo % 128 == 0
+    assert plan.n_lo >= 128
+    assert plan.padded >= n, (plan, n)
+    # the Lo axis never exceeds the smallest lane multiple covering n
+    assert plan.n_lo <= max(128, ((n + 127) // 128) * 128)
+    assert plan.n_hi >= 1
+
+
+def test_make_plan_small_n_full_coverage():
+    """Scatter then gather across EVERY id of an awkward small size (the
+    default n_lo=512 must clamp down, not truncate the id space)."""
+    n = 131
+    plan = M.make_plan(n)
+    assert plan.padded >= n
+    idx = jnp.arange(n, dtype=jnp.int32)
+    Hi, Lo = M.onehots(idx, plan)
+    vals = jnp.arange(1, n + 1, dtype=jnp.int32)
+    tab = M.scatter_add(jnp.zeros((n,), jnp.int32), plan, Hi, Lo, vals)
+    np.testing.assert_array_equal(np.asarray(tab), np.arange(1, n + 1))
+    got = np.asarray(M.gather(tab, plan, Hi, Lo))
+    np.testing.assert_array_equal(got, np.arange(1, n + 1))
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_depth_histogram_mxu_native_parity(depth):
+    """tables.depth_histogram: the flat [depth*width] MXU contraction must
+    be BIT-equal to the native scatter path and the per-event oracle —
+    including invalid rows and out-of-range columns (dropped)."""
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.ops import tables as T
+
+    rng = np.random.default_rng(17 + depth)
+    width, N, P = 1 << 10, 513, 3
+    cols = rng.integers(-2, width + 2, (N, depth)).astype(np.int32)
+    vals = rng.integers(0, 50, (N, P)).astype(np.int32)
+    valid = rng.random(N) < 0.8
+    args = (jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(valid), depth, width)
+    oracle = np.zeros((depth, width, P), np.int64)
+    for i in range(N):
+        if not valid[i]:
+            continue
+        for d in range(depth):
+            c = cols[i, d]
+            if 0 <= c < width:
+                oracle[d, c] += vals[i]
+    native = np.asarray(T.depth_histogram(None, *args))
+    mxu = np.asarray(
+        T.depth_histogram(small_engine_config(use_mxu_tables=True), *args)
+    )
+    np.testing.assert_array_equal(native, oracle)
+    np.testing.assert_array_equal(mxu, oracle)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+@pytest.mark.parametrize("kind", ["int", "float"])
+def test_depth_gather_1col_mxu_native_parity(depth, kind):
+    """tables.depth_gather_1col: one flat contraction (int digit planes) /
+    one lane gather (float) per batch must match the native gather and the
+    oracle exactly for both table dtypes, depths 1–3, out-of-range ids."""
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.ops import tables as T
+
+    rng = np.random.default_rng(23 + depth)
+    width, N = 1 << 10, 777
+    if kind == "int":
+        tab = rng.integers(0, (1 << 24) - 1, (depth, width)).astype(np.int32)
+        max_int = (1 << 24) - 1
+    else:
+        tab = (rng.random((depth, width)) * 5000.0).astype(np.float32)
+        max_int = None
+    cols = rng.integers(-2, width + 2, (N, depth)).astype(np.int32)
+    oracle = np.zeros((depth, N), np.float32)
+    for d in range(depth):
+        ok = (cols[:, d] >= 0) & (cols[:, d] < width)
+        oracle[d] = np.where(ok, tab[d, np.clip(cols[:, d], 0, width - 1)], 0)
+    native = np.asarray(
+        T.depth_gather_1col(None, jnp.asarray(tab), jnp.asarray(cols), width,
+                            max_int=max_int)
+    )
+    mxu = np.asarray(
+        T.depth_gather_1col(
+            small_engine_config(use_mxu_tables=True),
+            jnp.asarray(tab), jnp.asarray(cols), width, max_int=max_int,
+        )
+    )
+    np.testing.assert_array_equal(native, oracle)
+    np.testing.assert_array_equal(mxu, oracle)
+
+
 def test_lane_gather_multi_matches_oracle():
     """tables.lane_gather_multi: k tables, one shared row gather — exact
     vs numpy for odd/even n, k=1..4, out-of-range ids."""
