@@ -54,7 +54,10 @@ _LOCK = threading.Lock()
 _SITE_RE = re.compile(
     r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$"
 )
-_LAYERS = ("transport", "cluster", "runtime", "parallel", "datasource")
+_LAYERS = (
+    "transport", "cluster", "runtime", "parallel", "datasource", "obs",
+    "sketch",
+)
 
 #: actions a call style supports: ``hit`` sites can only raise or stall,
 #: ``pipe`` sites additionally mangle the payload, ``skew`` sites shift

@@ -41,7 +41,9 @@ Scenarios (the acceptance set):
   hotset_promote_fail sketch-tier promotion faults: ruled tail resources
                       stay sketched with stats failing OPEN and
                       tail-rule verdicts failing CLOSED; a clean load
-                      heals and enforces exactly
+                      heals and enforces exactly; a second window proves
+                      the profiling plane (shadow audit + deep capture)
+                      fails OPEN with exact counter accounting
 """
 
 from __future__ import annotations
@@ -1199,9 +1201,21 @@ def _scn_hotset_promote_fail(seed: int) -> ScenarioResult:
     window — all traffic is appended AFTER it, keeping injected counts a
     pure function of the seed (one promotion attempt per ruled tail
     resource in the load) — a clean rule load proves promotion heals and
-    the healed resource enforces exactly."""
+    the healed resource enforces exactly.
+
+    A second armed window exercises the profiling plane's failpoints
+    (obs/profile.py): ``sketch.audit.shadow`` raising on every shadow
+    tick must fail OPEN into ``sentinel_sketch_audit_failures_total``
+    with EXACT seed-pure counts (no check/underestimate/eps counter
+    moves), and ``obs.profile.capture`` raising must return an error
+    payload with the tracer's enabled state restored; both heal on the
+    first un-armed call."""
+    import numpy as np
+
     from sentinel_tpu.core import rules as R
     from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.obs import profile as PROF
+    from sentinel_tpu.obs import trace as OT
 
     t0 = mono_s()
     # tiny exact space (1-row promotion reserve) + sketch tail; the
@@ -1287,10 +1301,67 @@ def _scn_hotset_promote_fail(seed: int) -> ScenarioResult:
             "passed": 2,
             "blocked": 2,
         }
+        # -- profiling-plane fault window (obs/profile.py) ----------------
+        # standalone shadow audit: every observe under the armed raise
+        # burst fails OPEN (failure counter only — check/underestimate/
+        # eps counters must not move), and one armed capture returns an
+        # error payload with tracer state restored.  Counts are a pure
+        # function of the loop bounds — seed-pure by construction.
+        AUDIT_TICKS = 4
+        audit = PROF.SketchAudit(
+            node_rows=8, window_ms=500, sample_count=2, slack_buckets=1,
+            width=256, k=1, period=2,
+        )
+        a_res = np.asarray([9], np.int32)
+        a_cnt = np.asarray([1], np.int32)
+        tracer_was = OT.TRACER.enabled
+        plan2 = FaultPlan(
+            name="profile_plane_fail",
+            seed=seed,
+            faults=[
+                FaultSpec(
+                    "sketch.audit.shadow", "raise",
+                    burst_start=0, burst_len=AUDIT_TICKS, exc="RuntimeError",
+                ),
+                FaultSpec(
+                    "obs.profile.capture", "raise",
+                    burst_start=0, burst_len=1, exc="RuntimeError",
+                ),
+            ],
+        )
+        with session.window(plan2):
+            for i in range(AUDIT_TICKS):
+                audit.observe(1_000 + i, a_res, a_cnt)
+            cap = PROF.capture_profile(
+                ms=1.0, min_interval_s=0.0, sleep=lambda _s: None
+            )
+        extra["capture_failed_open"] = (
+            "error" in cap and OT.TRACER.enabled == tracer_was
+        )
+        # heal: the first un-armed observe folds (shadow admits the id)
+        # and a clean capture returns a chrome trace
+        audit.observe(2_000, a_res, a_cnt)
+        cap2 = PROF.capture_profile(
+            ms=1.0, min_interval_s=0.0, sleep=lambda _s: None
+        )
+        extra["profile_plane_heals"] = (
+            len(audit._tracked) == 1
+            and "chrome_trace" in cap2
+            and OT.TRACER.enabled == tracer_was
+        )
     finally:
         client.stop()
     extra["expect_metric_deltas"] = {
         "sentinel_sketch_promotion_failures_total": 2,
+        # profiling-plane window: EXACT fail-open accounting — the raise
+        # burst lands only in the failure counter, never in the audit's
+        # comparison counters
+        "sentinel_sketch_audit_failures_total": float(AUDIT_TICKS),
+        "sentinel_sketch_audit_checks_total": 0.0,
+        "sentinel_sketch_underestimates_total": 0.0,
+        "sentinel_sketch_eps_violations_total": 0.0,
+        'sentinel_profile_captures_total{result="error"}': 1.0,
+        'sentinel_profile_captures_total{result="ok"}': 1.0,
     }
     ctx = ScenarioContext(
         metrics=metrics,
@@ -1299,7 +1370,11 @@ def _scn_hotset_promote_fail(seed: int) -> ScenarioResult:
         passed=totals["passed"],
         blocked=totals["blocked"],
         injected=session.injected,
-        expect_injected={"runtime.hotset.promote:raise": 2},
+        expect_injected={
+            "runtime.hotset.promote:raise": 2,
+            "sketch.audit.shadow:raise": 4,
+            "obs.profile.capture:raise": 1,
+        },
         extra=extra,
     )
     verdicts = evaluate(
@@ -1320,6 +1395,11 @@ def _scn_hotset_promote_fail(seed: int) -> ScenarioResult:
          "the sketch must keep observing resources promotion failed for"),
         ("heal-promotes-exactly", "heal_promotes_and_enforces",
          "a clean load must promote into the reserve and enforce exactly"),
+        ("profile-capture-fails-open", "capture_failed_open",
+         "an injected capture fault must return an error payload and "
+         "restore the tracer's enabled state"),
+        ("profile-plane-heals", "profile_plane_heals",
+         "the first un-armed audit tick and capture must succeed"),
     ):
         verdicts.append(Verdict(nm, bool(extra.get(key)), detail))
     return _result("hotset_promote_fail", seed, session, verdicts, t0)
@@ -1398,7 +1478,8 @@ SCENARIOS: Dict[str, Scenario] = {
         Scenario(
             "hotset_promote_fail",
             _scn_hotset_promote_fail,
-            "hot-set promotion faults: stats fail open, tail verdicts fail closed",
+            "hot-set promotion + profiling-plane faults: stats/audit/capture "
+            "fail open, tail verdicts fail closed",
         ),
     )
 }

@@ -43,6 +43,7 @@ from sentinel_tpu.cluster.rules import (
 )
 from sentinel_tpu.core import errors as ERR
 from sentinel_tpu.core import rules as R
+from sentinel_tpu.obs import profile as PROF
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.utils.host_window import HostWindow
@@ -283,6 +284,11 @@ class TokenColumnBatcher:
         self._next_slot = 0
         self._cap = 8
         self._state = TC.init_state(self._cap)
+        # memory ledger (obs/profile.py): token-column device state under
+        # a per-batcher owner so close() releases exactly this claim
+        self._ledger_name = f"tokencol:{id(self):x}"
+        with PROF.ledger_owner(self._ledger_name):
+            PROF.LEDGER.track("tokens", "token_col.state", self._state)
         self._decide = TC.jitted_decide()
         self._closed = False
         self._worker = threading.Thread(
@@ -316,6 +322,7 @@ class TokenColumnBatcher:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+        PROF.LEDGER.drop_owner(self._ledger_name)
 
     def warm(self) -> None:
         """Pay the XLA compile for the current capacity off the request
@@ -402,6 +409,8 @@ class TokenColumnBatcher:
                 grew = cap != self._cap
                 self._state = TC.TokenColState(win=win, limits=self._state.limits)
                 self._cap = cap
+                with PROF.ledger_owner(self._ledger_name):
+                    PROF.LEDGER.track("tokens", "token_col.state", self._state)
             else:
                 grew = False
             limits = np.zeros(cap, np.float32)

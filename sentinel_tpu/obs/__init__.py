@@ -38,6 +38,16 @@ from sentinel_tpu.obs.registry import (
     register_scrape_id,
 )
 from sentinel_tpu.obs.flight import FLIGHT, FlightRecorder, load_bundle
+from sentinel_tpu.obs.profile import (
+    LEDGER,
+    RETRACE,
+    MemoryLedger,
+    RetraceObservatory,
+    SketchAudit,
+    capture_profile,
+    expected_retrace,
+    ledger_owner,
+)
 from sentinel_tpu.obs.trace import (
     TRACER,
     SpanTracer,
@@ -81,15 +91,23 @@ def span(name: str, trace: int = 0, **attrs):
 
 __all__ = [
     "FLIGHT",
+    "LEDGER",
     "REGISTRY",
+    "RETRACE",
     "TRACER",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "MemoryLedger",
     "MetricRegistry",
+    "RetraceObservatory",
+    "SketchAudit",
     "SpanTracer",
+    "capture_profile",
     "current_ctx",
+    "expected_retrace",
+    "ledger_owner",
     "enable",
     "disable",
     "enabled",

@@ -7,6 +7,7 @@ Usage:
     python -m sentinel_tpu.obs --json [trace.json]
     python -m sentinel_tpu.obs --merge a.json b.json ... -o merged.json
     python -m sentinel_tpu.obs --postmortem bundle.json
+    python -m sentinel_tpu.obs --profile [ms] [-o capture.json]
 
 With a ``trace.json`` argument (a Chrome-trace file from ``GET
 /api/traces`` or ``SpanTracer.dump``) the CLI reads it; with no input it
@@ -112,6 +113,31 @@ def _self_capture(n_blocks: int = 4, block: int = 64) -> List[dict]:
         if not was_enabled:
             OT.TRACER.disable()
     return OT.TRACER.snapshot()
+
+
+def _profile_capture(ms: float, blocks: int) -> dict:
+    """``--profile``: one bounded dense-capture window
+    (obs/profile.capture_profile) over the self-capture workload running
+    on a background thread — the standalone analog of ``GET
+    /api/profile?ms=``.  Returns the capture payload (fail-open: an
+    ``error`` key instead of a trace on any failure)."""
+    import threading
+
+    from sentinel_tpu.obs.profile import capture_profile
+
+    done = threading.Event()
+
+    def work() -> None:
+        try:
+            _self_capture(n_blocks=max(1, blocks))
+        finally:
+            done.set()
+
+    t = threading.Thread(target=work, name="obs-profile-workload", daemon=True)
+    t.start()
+    cap = capture_profile(ms)
+    done.wait(timeout=300.0)
+    return cap
 
 
 def merge_traces(paths: List[str]) -> dict:
@@ -392,6 +418,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="output path for --merge (default: stdout)",
     )
     ap.add_argument(
+        "--profile",
+        nargs="?",
+        const=250.0,
+        type=float,
+        metavar="MS",
+        help="deep-profile capture: force-enable tracing for MS "
+        "milliseconds (default 250) over a self-capture workload and "
+        "emit the window as a Chrome trace (-o/--chrome to write it)",
+    )
+    ap.add_argument(
         "--postmortem",
         metavar="BUNDLE",
         help="analyze a flight-recorder bundle (GET /api/flight / "
@@ -454,6 +490,33 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"wrote {args.out} ({len(text.splitlines())} lines)")
         else:
             sys.stdout.write(text)
+        return 0
+    if args.profile is not None:
+        cap = _profile_capture(args.profile, max(1, args.blocks))
+        if "error" in cap:
+            print(f"capture failed: {json.dumps(cap)}", file=sys.stderr)
+            return 1
+        out_path = args.out or args.chrome
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(cap["chrome_trace"], f)
+            print(
+                f"wrote {out_path} ({cap['span_count']} spans, "
+                f"{cap['ms']:g}ms window)"
+            )
+        else:
+            print(
+                json.dumps(
+                    {k: cap[k] for k in ("ms", "t0_ns", "t1_ns", "span_count")},
+                    indent=2,
+                )
+            )
+            window = [
+                s
+                for s in OT.TRACER.snapshot()
+                if cap["t0_ns"] <= s["t0_ns"] <= cap["t1_ns"]
+            ]
+            _print_summary(window)
         return 0
     if args.postmortem:
         _print_postmortem(args.postmortem)

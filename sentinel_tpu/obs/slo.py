@@ -119,11 +119,13 @@ class SloStatus:
 
 
 def default_slos(req_p99_ms: float = 10.0) -> Tuple[SloSpec, ...]:
-    """The four stock objectives: request latency, shed ratio,
-    fail-closed rate, and the fleet's routing error budget.  Totals are
-    denominated in the device telemetry verdict counters
-    (``sentinel_device_verdicts_total``) — the fleet's decisions as the
-    DEVICE counted them."""
+    """The six stock objectives: request latency, shed ratio,
+    fail-closed rate, the fleet's routing error budget, the online
+    sketch-accuracy eps posture, and the memory ledger's capacity
+    posture.  Totals are denominated in the device telemetry verdict
+    counters (``sentinel_device_verdicts_total``) — the fleet's
+    decisions as the DEVICE counted them — except the last two, which
+    ride their own check counters (obs/profile.py)."""
     verdicts = ("sentinel_device_verdicts_total",)
     return (
         SloSpec(
@@ -159,6 +161,26 @@ def default_slos(req_p99_ms: float = 10.0) -> Tuple[SloSpec, ...]:
                 )
             ),
             total=CounterSum(("sentinel_shard_requests_total",)),
+        ),
+        # online sketch-accuracy audit (obs/profile.SketchAudit): the
+        # offline BENCH posture (within_eps ≈ 0.993) continuously — bad
+        # events are estimates above the slack-adjusted exact bound plus
+        # the CMS eps budget; underestimates alert through the chaos
+        # invariant (must stay 0), not a ratio
+        SloSpec(
+            "sketch_eps",
+            objective=0.99,
+            bad=CounterSum(("sentinel_sketch_eps_violations_total",)),
+            total=CounterSum(("sentinel_sketch_audit_checks_total",)),
+        ),
+        # HBM memory ledger capacity (obs/profile.MemoryLedger): every
+        # ledger mutation while a capacity is configured is one check;
+        # mutations that leave tracked bytes above capacity burn budget
+        SloSpec(
+            "hbm_capacity",
+            objective=0.999,
+            bad=CounterSum(("sentinel_hbm_capacity_breaches_total",)),
+            total=CounterSum(("sentinel_hbm_capacity_checks_total",)),
         ),
     )
 

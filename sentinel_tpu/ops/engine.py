@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from sentinel_tpu.core import rule_tensors as RT
 from sentinel_tpu.core.config import EngineConfig
+from sentinel_tpu.obs import profile as PROF
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
 from sentinel_tpu.core.errors import (
@@ -394,6 +395,19 @@ def _device_res_stats(cfg: EngineConfig, state: EngineState, now_ms):
 
 
 def init_state(cfg: EngineConfig) -> EngineState:
+    state = _init_state(cfg)
+    # memory ledger (obs/profile.py): the window rings + breaker/param/
+    # rtq state are the "windows" pool; the global sketch is accounted
+    # separately by its own init (salsa/gsketch), so subtract its leaves
+    PROF.LEDGER.set(
+        "windows",
+        "engine.init_state",
+        PROF.tree_nbytes(state) - PROF.tree_nbytes(state.gs),
+    )
+    return state
+
+
+def _init_state(cfg: EngineConfig) -> EngineState:
     rows = cfg.node_rows
     sec_cfg = W.WindowConfig(cfg.second_sample_count, cfg.second_window_ms)
     min_cfg = W.WindowConfig(cfg.minute_sample_count, cfg.minute_window_ms)
@@ -2712,10 +2726,14 @@ def compile_ruleset(
     # span ends in finally: a rule push that raises mid-compile (device
     # OOM, malformed rule) is exactly the slow event worth seeing traced
     try:
-        return _compile_ruleset(
+        rs = _compile_ruleset(
             cfg, registry, flow_rules, degrade_rules, param_rules,
             authority_rules, system_rules, param_lanes,
         )
+        # memory ledger: compiled rule tensors are the "rules" pool (the
+        # latest compile at this site replaces the previous claim)
+        PROF.LEDGER.track("rules", "engine.compile_ruleset", rs)
+        return rs
     finally:
         OT.TRACER.end(_span)
 
@@ -2929,5 +2947,12 @@ def make_tick(
             OT.event(
                 "engine.make_tick",
                 attrs={"features": ",".join(sorted(features)), "seg_u": cfg.seg_u},
+            )
+            # retrace observatory (obs/profile.py): the miss is journaled
+            # with its CAUSE — the key diff against the previous build —
+            # and counted expected/surprise.  Cache hits never reach here.
+            PROF.RETRACE.observe(
+                "engine.tick", cfg=cfg, donate=donate, jit=jit,
+                features=features,
             )
     return fn

@@ -37,6 +37,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from sentinel_tpu.obs import profile as PROF
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.param import cms_cell
 
@@ -79,10 +80,14 @@ class SketchState(NamedTuple):
 
 def init_sketch(cfg: SketchConfig) -> SketchState:
     nbp = cfg.phys_buckets
-    return SketchState(
+    state = SketchState(
         counts=jnp.zeros((nbp, cfg.depth, cfg.width, PLANES), jnp.int32),
         epochs=jnp.full((nbp,), -(cfg.sample_count + 1), jnp.int32),
     )
+    # memory ledger (obs/profile.py): seed CMS tier under the same
+    # "sketch" pool the salsa tier reports to
+    PROF.LEDGER.track("sketch", "gsketch.init_sketch", state)
+    return state
 
 
 def _wid(now_ms, cfg: SketchConfig):

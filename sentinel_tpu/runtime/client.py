@@ -58,6 +58,7 @@ from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops import wire as WIRE
 from sentinel_tpu.obs import flight as FL
+from sentinel_tpu.obs import profile as PROF
 from sentinel_tpu.obs import timeline as TLM
 from sentinel_tpu.obs import trace as OT
 from sentinel_tpu.obs.registry import REGISTRY as OBS
@@ -538,6 +539,8 @@ class SentinelClient:
         pipeline_depth: int = 0,
         watchdog_timeout_s: float = 0.0,
         admission_queue_limit: int = 0,
+        sketch_audit_k: int = 0,
+        sketch_audit_period: int = 16,
     ):
         from sentinel_tpu.core.config import app_name as cfg_app_name
         from sentinel_tpu.core.config import platform_engine_config
@@ -640,9 +643,19 @@ class SentinelClient:
         # SPI slot-chain analog: absent slots cost nothing); rule loads that
         # change the feature set swap in a freshly compiled tick
         self._features = self._select_features()
-        self._tick = E.make_tick(self.cfg, donate=True, features=self._features)
-        self._state = E.init_state(self.cfg)
-        self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
+        # memory-ledger ownership (obs/profile.py): every device buffer
+        # built FOR this client — engine state (the sketch tier registers
+        # itself inside init_state), ruleset tensors, wire staging — is
+        # claimed under this owner tag so stop() releases exactly them;
+        # the first make_tick per config is a warmup retrace by contract
+        self._ledger_name = f"client:{self.app_name}:{id(self):x}"
+        with PROF.ledger_owner(self._ledger_name), \
+                PROF.expected_retrace("client-init"):
+            self._tick = E.make_tick(
+                self.cfg, donate=True, features=self._features
+            )
+            self._state = E.init_state(self.cfg)
+            self._rules_dev = E.compile_ruleset(self.cfg, self.registry)
         self._system_static = compile_system_rules([], self.cfg)
         self._rules_dirty = False
 
@@ -725,6 +738,29 @@ class SentinelClient:
             from sentinel_tpu.sketch.hotset import HotSetManager
 
             self.hotset = HotSetManager(self)
+
+        # online sketch-accuracy audit (obs/profile.SketchAudit): a
+        # rotating exact shadow of up to sketch_audit_k sketched
+        # resources, compared against the device estimates every
+        # sketch_audit_period ticks.  Disarmed (k=0, the default) the
+        # tick hot path pays exactly ONE `is not None` check.
+        self._audit = None
+        self._audit_scfg = None
+        self._audit_provider = None
+        self._audit_est = None
+        if sketch_audit_k > 0 and self.cfg.sketch_stats:
+            scfg = E.sketch_config(self.cfg)
+            self._audit_scfg = scfg
+            self._audit = PROF.SketchAudit(
+                node_rows=self.cfg.node_rows,
+                window_ms=scfg.window_ms,
+                sample_count=scfg.sample_count,
+                slack_buckets=scfg.slack_buckets,
+                width=scfg.width,
+                k=int(sketch_audit_k),
+                period=int(sketch_audit_period),
+                trash_row=self.cfg.trash_row,
+            )
 
         # segment-compacted path bookkeeping: the tick builder presorts
         # batches by the engine's segment keys (see _presort_cols) and
@@ -850,6 +886,9 @@ class SentinelClient:
         # and a config digest (last started client wins the name)
         self._flight_provider = self._flight_state
         FL.FLIGHT.register_provider("client", self._flight_provider)
+        if self._audit is not None:
+            self._audit_provider = self._audit.flight_section
+            FL.FLIGHT.register_provider("audit", self._audit_provider)
 
     def _flight_state(self) -> dict:
         """Flight-bundle section: what a post-mortem needs to know about
@@ -902,6 +941,10 @@ class SentinelClient:
         if fp is not None:
             # only if still ours — a newer client may have taken the slot
             FL.FLIGHT.unregister_provider("client", fp)
+        ap = getattr(self, "_audit_provider", None)
+        if ap is not None:
+            FL.FLIGHT.unregister_provider("audit", ap)
+            self._audit_provider = None
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
@@ -936,6 +979,9 @@ class SentinelClient:
             self.timeline = None
         if self.block_log is not None:
             self.block_log.flush()
+        # release this client's memory-ledger claims (engine state, rule
+        # tensors, wire staging) — the owner tag brackets exactly them
+        PROF.LEDGER.drop_owner(self._ledger_name)
         self._started = False
 
     # -- adaptive protection / backpressure ---------------------------------
@@ -1317,7 +1363,10 @@ class SentinelClient:
             changed = static_flip or feats != self._features
             if changed:
                 self._features = feats
-                self._tick = E.make_tick(self.cfg, donate=True, features=feats)
+                with PROF.expected_retrace("rule-feature-change"):
+                    self._tick = E.make_tick(
+                        self.cfg, donate=True, features=feats
+                    )
         # compile the new tick NOW for BOTH batch shapes so the first
         # post-reload entry doesn't eat the XLA compile inside its
         # entry_timeout_s window.  Under _tick_mutex: the warm-up ticks
@@ -2467,14 +2516,22 @@ class SentinelClient:
             return
         _h = OT.TRACER.begin("client.window_reshape", **changes)
         try:
-            new_tick = E.make_tick(new_cfg, donate=True, features=self._features)
+            with PROF.ledger_owner(self._ledger_name), \
+                    PROF.expected_retrace("window-reshape"):
+                new_tick = E.make_tick(
+                    new_cfg, donate=True, features=self._features
+                )
             # pre-compile BOTH batch shapes against a throwaway state while
             # the old engine keeps serving: XLA compiles take seconds, and a
             # window whose budget migrated would legitimately EXPIRE during
             # that gap — compiling first makes the actual swap a few ms of
             # migration math
             z = jnp.float32(0.0)
-            dummy = E.init_state(new_cfg)
+            # ledger_owner: the throwaway state re-claims this client's
+            # windows/sketch pool entries at the NEW config's sizes — the
+            # same shapes the migrated state lands in below
+            with PROF.ledger_owner(self._ledger_name):
+                dummy = E.init_state(new_cfg)
             for bs in {min(256, new_cfg.batch_size), new_cfg.batch_size}:
                 dummy, _ = new_tick(
                     dummy,
@@ -2574,6 +2631,7 @@ class SentinelClient:
                 c = jnp.asarray(x)
                 self._const_cols[key] = c
                 _C_WIRE["tx"].inc(x.nbytes)  # first (only) upload of the const
+                self._ledger_wire()  # cold: new (field, dtype, shape) const
             # the dirty ref would go stale while const ticks bypass it —
             # drop it so the next varying tick uploads fresh
             self._col_last.pop(field, None)
@@ -2603,7 +2661,20 @@ class SentinelClient:
         s = self._stage.get(key)
         if s is None:
             s = self._stage[key] = [np.empty(shape, dt), np.empty(shape, dt)]
+            self._ledger_wire()  # cold: new staging slot pair
         return s[self._stage_parity]
+
+    def _ledger_wire(self) -> None:
+        """Re-claim the wire pool (obs/profile.LEDGER) after a cold
+        allocation: two-slot host staging buffers plus cached
+        device-resident constant columns.  The dirty-column device copies
+        (_col_last) churn with traffic and are excluded — ledger entries
+        must change only on allocation events, never per tick."""
+        nb = sum(
+            s[0].nbytes + s[1].nbytes for s in self._stage.values()
+        ) + sum(int(c.nbytes) for c in self._const_cols.values())
+        with PROF.ledger_owner(self._ledger_name):
+            PROF.LEDGER.set("wire", "client.staging", nb)
 
     def _wire_layout(self, cfg, b: int) -> WIRE.WireLayout:
         """Cached packed-wire offset table for (cfg, batch shape)."""
@@ -2692,9 +2763,11 @@ class SentinelClient:
             FP.hit(_FP_SEG_RESIZE)  # chaos: a raise keeps the old capacity
             feats = self._features
             new_cfg = dataclasses.replace(self.cfg, seg_u=int(new_u))
-            new_tick = E.make_tick(new_cfg, donate=True, features=feats)
-            z = jnp.float32(0.0)
-            dummy = E.init_state(new_cfg)
+            with PROF.ledger_owner(self._ledger_name), \
+                    PROF.expected_retrace("segment-resize"):
+                new_tick = E.make_tick(new_cfg, donate=True, features=feats)
+                z = jnp.float32(0.0)
+                dummy = E.init_state(new_cfg)
             for bs in sorted({min(256, new_cfg.batch_size), new_cfg.batch_size}):
                 dummy, _ = new_tick(
                     dummy,
@@ -2791,10 +2864,42 @@ class SentinelClient:
                 ES.seg_capacity(self.cfg, self.cfg.batch_size),
             )
 
+    def _audit_attempts(self, rids, now_ms: int):
+        """SketchAudit reader: the device sketch's windowed ATTEMPTS
+        estimate (PASS + BLOCK planes — exactly the units the engine
+        folds: ``acq.count`` per valid entry) for the tracked ids.
+
+        The estimate is jit-cached and the id column padded to the
+        audit's fixed K, so steady-state audits dispatch ONE compiled
+        executable instead of tracing op-by-op — this read is the whole
+        serving-path cost of the audit, amortized over its period."""
+        if self._audit_est is None:
+            from sentinel_tpu.sketch import impl_for
+
+            impl, scfg = impl_for(self.cfg), self._audit_scfg
+            self._audit_est = jax.jit(
+                lambda gs, t, r: impl.estimate(gs, t, r, scfg)
+            )
+        k = len(rids)
+        ids = list(rids) + [self.cfg.node_rows] * (self._audit.k - k)
+        with self._engine_lock:
+            est = np.asarray(
+                self._audit_est(
+                    self._state.gs,
+                    jnp.int32(now_ms),
+                    jnp.asarray(ids, jnp.int32),
+                )
+            )[:k]
+        return est[:, W.EV_PASS] + est[:, W.EV_BLOCK]
+
     def _warm_shapes(self) -> None:
         """Compile the tick for both batch shapes (small + full) with
         no-op batches so serving never waits on XLA."""
+        _tw = _time.perf_counter()
         self._resolve_tick(self._run_tick([], None, self.time.now_ms()))
+        PROF.RETRACE.observe_compile_ms(
+            "engine.tick", (_time.perf_counter() - _tw) * 1000.0
+        )
         if self.cfg.batch_size > 256:
             filler = AcquireRequest(
                 res=self.cfg.trash_row, count=0, prio=0, origin_id=-1,
@@ -2804,8 +2909,12 @@ class SentinelClient:
             )
             # 257 trash-row entries force the full-shape executable; trash
             # rows are engine no-ops and carry no futures to resolve
+            _tw = _time.perf_counter()
             self._resolve_tick(
                 self._run_tick([filler] * 257, None, self.time.now_ms())
+            )
+            PROF.RETRACE.observe_compile_ms(
+                "engine.tick", (_time.perf_counter() - _tw) * 1000.0
             )
 
     def _run_tick(
@@ -2871,6 +2980,7 @@ class SentinelClient:
 
         a = E.empty_acquire(cfg, b=min(256, cfg.batch_size))
         inv_a = None
+        _au_cols = None
         if acq or n_front or n_blk:
             n = len(acq)
             def arr(f, fill, dt, front_col=None, blk_default=None):
@@ -2927,6 +3037,12 @@ class SentinelClient:
             cnt_np = arr("count", 0, np.int32, f_cnt, blk_default=1)
             if clamp:
                 np.minimum(cnt_np, cfg.max_batch_count, out=cnt_np)
+            if self._audit is not None:
+                # shadow-fold input: the CLAMPED columns, pre-presort
+                # (fold order is irrelevant — sums) — exactly the units
+                # the engine lands in the sketch.  The staging buffers
+                # are not reused before observe() runs below this tick.
+                _au_cols = (res_np, cnt_np)
             prio_np = arr("prio", 0, np.int32, f_prio)
             oid_np = arr("origin_id", -1, np.int32)
             onode_np = arr("origin_node", trash, np.int32)
@@ -3089,6 +3205,18 @@ class SentinelClient:
         t = now_ms if now_ms is not None else self.time.now_ms()
         t += FP.skew_ms(_FP_TICK_CLOCK)  # chaos: deterministic clock skew
         self._count_rotations(int(t))
+        au = self._audit
+        if au is not None:
+            # audit-then-fold (obs/profile.py): the estimate read and the
+            # shadow both cover the stream through the PREVIOUS tick —
+            # this tick's batch lands on device only in the dispatch
+            # below.  Runs outside _engine_lock; fails OPEN internally.
+            au.observe(
+                int(t),
+                _au_cols[0] if _au_cols is not None else None,
+                _au_cols[1] if _au_cols is not None else None,
+                self._audit_attempts,
+            )
         ad = self._adaptive
         if ad is not None:
             # closed loop: signals row -> controller -> ladder + live

@@ -75,6 +75,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from sentinel_tpu.obs import profile as PROF
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.gsketch import (
     PLANES,
@@ -122,7 +123,7 @@ def _wp(cfg: SketchConfig) -> int:
 def init_sketch(cfg: SketchConfig) -> SalsaState:
     wp = _wp(cfg)
     nbp = cfg.phys_buckets
-    return SalsaState(
+    state = SalsaState(
         words=jnp.zeros((nbp, cfg.depth, PLANES, wp), jnp.int32),
         lvlmap=jnp.zeros((nbp, cfg.depth, PLANES, wp // _BMP), jnp.int32),
         run=jnp.zeros((cfg.depth, PLANES, cfg.width), jnp.int32),
@@ -131,6 +132,10 @@ def init_sketch(cfg: SketchConfig) -> SalsaState:
         cur=jnp.zeros((cfg.depth, PLANES, cfg.width), jnp.int32),
         cur_wid=jnp.int32(-(cfg.sample_count + 1)),
     )
+    # memory ledger (obs/profile.py): the measured live counterpart of
+    # the static hbm_bytes(cfg) claim — the two must agree within 10%
+    PROF.LEDGER.track("sketch", "salsa.init_sketch", state)
+    return state
 
 
 def _index_of(wid, cfg: SketchConfig):

@@ -257,6 +257,33 @@ class DefaultHandlerGroup:
             return CommandResponse.of_success(FLIGHT.bundles()[-n:] if n else [])
         return CommandResponse.of_success(FLIGHT.dump_bundle(reason="api"))
 
+    @command_mapping("api/profile", "bounded deep-profile capture (Chrome trace)")
+    def api_profile(self, req: CommandRequest) -> CommandResponse:
+        """``GET /api/profile?ms=250`` — one bounded dense-capture window
+        (obs/profile.capture_profile): the span tracer is force-enabled
+        (with jax.profiler annotation passthrough) for at most ``ms``
+        milliseconds and the window's spans come back as a Chrome-trace
+        payload, mergeable via ``python -m sentinel_tpu.obs --merge``.
+        Rate-limited (a second capture inside the interval returns
+        ``{"error": "rate_limited", "retry_after_s": ...}``) and
+        fail-OPEN: errors return a payload, decisions are untouched."""
+        from sentinel_tpu.obs.profile import capture_profile
+
+        return CommandResponse.of_success(
+            capture_profile(req.param("ms") or 250.0)
+        )
+
+    @command_mapping("api/memory", "HBM memory-ledger reconciliation")
+    def api_memory(self, req: CommandRequest) -> CommandResponse:
+        """``GET /api/memory`` — the memory ledger's view (per-pool
+        bytes, per-entry breakdown, capacity posture) reconciled on
+        demand against ``jax.live_arrays()`` and the backend's own
+        memory stats (``unaccounted_bytes`` = live bytes no ledger entry
+        claims).  Backend reads fail open on CPU-only processes."""
+        from sentinel_tpu.obs.profile import LEDGER
+
+        return CommandResponse.of_success(LEDGER.reconcile())
+
     @command_mapping("api/shards", "token-fleet topology + per-shard health")
     def api_shards(self, req: CommandRequest) -> CommandResponse:
         """``GET /api/shards`` — every live sharded token client in the

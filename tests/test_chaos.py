@@ -43,6 +43,7 @@ def _import_instrumented_modules():
     import sentinel_tpu.cluster.server  # noqa: F401
     import sentinel_tpu.cluster.shard  # noqa: F401
     import sentinel_tpu.datasource.stores  # noqa: F401
+    import sentinel_tpu.obs.profile  # noqa: F401
     import sentinel_tpu.obs.timeline  # noqa: F401
     import sentinel_tpu.parallel.remote_shard  # noqa: F401
     import sentinel_tpu.runtime.client  # noqa: F401
@@ -56,7 +57,10 @@ def _import_instrumented_modules():
 # ---------------------------------------------------------------------------
 
 _SCHEME = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
-_LAYERS = {"transport", "cluster", "runtime", "parallel", "datasource"}
+_LAYERS = {
+    "transport", "cluster", "runtime", "parallel", "datasource", "obs",
+    "sketch",
+}
 
 
 def test_catalog_sites_unique_registered_and_scheme_conformant():

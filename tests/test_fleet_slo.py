@@ -357,9 +357,16 @@ def test_slo_latency_spec_histogram_over():
     eng.close()
 
 
-def test_slo_default_specs_cover_the_four_objectives():
+def test_slo_default_specs_cover_the_six_objectives():
     names = {s.name for s in S.default_slos()}
-    assert names == {"req_p99", "shed_ratio", "fail_closed", "fleet_error_budget"}
+    assert names == {
+        "req_p99",
+        "shed_ratio",
+        "fail_closed",
+        "fleet_error_budget",
+        "sketch_eps",
+        "hbm_capacity",
+    }
     for s in S.default_slos():
         assert 0.0 < s.objective < 1.0 and s.windows
 
