@@ -1,12 +1,16 @@
-"""sentinel_tpu.analysis — the two-tier TPU-hazard analyzer.
+"""sentinel_tpu.analysis — the three-tier TPU-hazard analyzer.
 
 Tier 1 (this package's ``passes/``): five AST passes over source files
 (fail-open, host-sync, jit-recompile, time-source, unguarded-global).
 Tier 2 (``analysis/jaxpr/``): five semantic passes over the traced
 engine/ops entry points (transfer-guard, dtype-overflow, const-hoist,
-recompile-fingerprint, flops-bytes-budget).  See README.md in this
-directory for the full rule catalog, suppression anchoring, and the
-fingerprint/budget/baseline workflows.
+recompile-fingerprint, flops-bytes-budget).
+Tier 3 (``analysis/concurrency/``): four whole-program concurrency
+passes over interprocedural lock/blocking summaries (lock-order-cycle,
+lock-order-new-edge, blocking-under-lock, thread-lifecycle) plus the
+opt-in runtime lock witness.  See README.md in this directory for the
+full rule catalog, suppression anchoring, and the fingerprint/budget/
+lock-order/baseline workflows.
 
 Programmatic surface::
 
@@ -14,12 +18,15 @@ Programmatic surface::
     findings, new = run_repo_analysis()          # AST tier
     from sentinel_tpu.analysis.jaxpr import run_jaxpr_analysis
     findings = run_jaxpr_analysis()              # jaxpr tier
+    from sentinel_tpu.analysis.concurrency import run_concurrency_analysis
+    findings = run_concurrency_analysis()        # concurrency tier
 
 CLI::
 
-    python -m sentinel_tpu.analysis            # BOTH tiers, exit 1 on new findings
+    python -m sentinel_tpu.analysis            # ALL tiers, exit 1 on new findings
     python -m sentinel_tpu.analysis --json     # machine-readable report
     python -m sentinel_tpu.analysis --sarif    # GitHub-annotation-ready report
+    python -m sentinel_tpu.analysis --jobs 3   # tiers in parallel
 """
 
 from __future__ import annotations
@@ -50,12 +57,17 @@ DEFAULT_BASELINE = os.path.join(
 
 
 def rule_catalog() -> dict:
-    """rule id -> one-line description, across BOTH tiers (importing the
-    jaxpr pass classes is cheap; tracing only happens when they run)."""
+    """rule id -> one-line description, across ALL tiers (importing the
+    jaxpr/concurrency pass classes is cheap; tracing and whole-program
+    summary building only happen when they run)."""
+    from sentinel_tpu.analysis.concurrency.passes import ALL_CONCURRENCY_PASSES
     from sentinel_tpu.analysis.jaxpr.passes import ALL_JAXPR_PASSES
 
     return {
-        p.name: p.description for p in tuple(ALL_PASSES) + tuple(ALL_JAXPR_PASSES)
+        p.name: p.description
+        for p in tuple(ALL_PASSES)
+        + tuple(ALL_JAXPR_PASSES)
+        + tuple(ALL_CONCURRENCY_PASSES)
     }
 
 

@@ -1,11 +1,19 @@
 """CLI: ``python -m sentinel_tpu.analysis [paths...]``.
 
-Runs BOTH analyzer tiers by default:
+Runs ALL analyzer tiers by default:
 
 * tier 1 — the AST linter over source files (cheap, per-file);
 * tier 2 — the jaxpr semantic analyzer over the traced engine/ops entry
   points (traces on CPU; repo-global, so it is skipped when explicit
-  paths are given — pass ``--tier jaxpr`` to force it).
+  paths are given — pass ``--tier jaxpr`` to force it);
+* tier 3 — the whole-program concurrency analyzer (interprocedural
+  lock-order graph, blocking-under-lock, thread-lifecycle; repo-global
+  like tier 2, skipped under explicit paths — ``--tier concurrency``
+  forces it).
+
+``--jobs N`` runs the selected tiers concurrently (threads; the jaxpr
+trace dominates wall clock, so the AST and concurrency tiers ride along
+for free).
 
 Exit status: 0 — no findings beyond the checked-in baseline;
 1 — new findings (print + fail, the CI contract); 2 — usage error.
@@ -61,12 +69,23 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--tier",
-        choices=("ast", "jaxpr", "both", "metrics"),
+        choices=("ast", "jaxpr", "concurrency", "both", "all", "metrics"),
         default=None,
         help=(
-            "which analyzer tier(s) to run (default: both without explicit "
-            "paths, ast with them; 'metrics' runs only the metric-catalog "
-            "lint — registry names in source vs the README catalog table)"
+            "which analyzer tier(s) to run (default: all without explicit "
+            "paths, ast with them; 'both' = ast+jaxpr for older scripts; "
+            "'metrics' runs only the metric-catalog lint — registry names "
+            "in source vs the README catalog table)"
+        ),
+    )
+    ap.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help=(
+            "run the selected tiers concurrently on N threads (default 1: "
+            "sequential; tiers are the unit of parallelism)"
         ),
     )
     ap.add_argument(
@@ -102,6 +121,16 @@ def main(argv=None) -> int:
         ),
     )
     ap.add_argument(
+        "--update-lock-order",
+        action="store_true",
+        help=(
+            "re-derive the blessed held->acquired lock-order edge set "
+            "(sentinel_tpu/analysis/concurrency/lock_order.json); commit "
+            "the diff ONLY after reviewing each new edge — every edge is "
+            "an ordering constraint all future acquisitions must respect"
+        ),
+    )
+    ap.add_argument(
         "--rules",
         default="",
         help="comma-separated pass names to run (default: all, both tiers)",
@@ -112,19 +141,27 @@ def main(argv=None) -> int:
         print("--json and --sarif are mutually exclusive", file=sys.stderr)
         return 2
 
-    # -- golden updates (tier-2 maintenance verbs) --------------------------
-    if args.update_fingerprints or args.update_budgets:
-        from sentinel_tpu.analysis import jaxpr as J
+    # -- golden updates (tier-2/3 maintenance verbs) ------------------------
+    if args.update_fingerprints or args.update_budgets or args.update_lock_order:
+        if args.update_fingerprints or args.update_budgets:
+            from sentinel_tpu.analysis import jaxpr as J
 
-        if args.update_fingerprints:
-            n = J.update_fingerprints()
-            print(f"fingerprints updated: {n} entry point(s) -> {J.FINGERPRINTS_PATH}")
-        if args.update_budgets:
-            n = J.update_budgets()
-            print(f"budgets updated: {n} entry point(s) -> {J.BUDGETS_PATH}")
+            if args.update_fingerprints:
+                n = J.update_fingerprints()
+                print(
+                    f"fingerprints updated: {n} entry point(s) -> {J.FINGERPRINTS_PATH}"
+                )
+            if args.update_budgets:
+                n = J.update_budgets()
+                print(f"budgets updated: {n} entry point(s) -> {J.BUDGETS_PATH}")
+        if args.update_lock_order:
+            from sentinel_tpu.analysis import concurrency as CC
+
+            n = CC.update_lock_order()
+            print(f"lock order updated: {n} edge(s) -> {CC.LOCK_ORDER_PATH}")
         return 0
 
-    tier = args.tier or ("ast" if args.paths else "both")
+    tier = args.tier or ("ast" if args.paths else "all")
     if tier == "metrics":
         # standalone catalog lint: no Finding/baseline machinery — the
         # catalog is a strict contract, not accumulated debt
@@ -139,15 +176,33 @@ def main(argv=None) -> int:
         print(f"-- metric catalog: {len(problems)} problem(s)")
         return 1 if problems else 0
 
-    # -- pass selection (both tiers share the --rules namespace) ------------
+    # -- tier selection (--tier value -> the set of tiers to run) -----------
+    _TIER_SETS = {
+        "ast": ("ast",),
+        "jaxpr": ("jaxpr",),
+        "concurrency": ("concurrency",),
+        "both": ("ast", "jaxpr"),
+        "all": ("ast", "jaxpr", "concurrency"),
+    }
+    tiers = set(_TIER_SETS[tier])
+
+    # -- pass selection (all tiers share the --rules namespace) -------------
     ast_passes = list(ALL_PASSES)
     jaxpr_passes = None  # None = all (resolved lazily: importing them is free,
     # but building the entry list costs a trace)
+    conc_passes = None  # None = all tier-3 passes
     if args.rules:
+        from sentinel_tpu.analysis.concurrency.passes import (
+            ALL_CONCURRENCY_PASSES,
+        )
         from sentinel_tpu.analysis.jaxpr.passes import ALL_JAXPR_PASSES
 
         wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
-        known = {p.name for p in ALL_PASSES} | {p.name for p in ALL_JAXPR_PASSES}
+        known = (
+            {p.name for p in ALL_PASSES}
+            | {p.name for p in ALL_JAXPR_PASSES}
+            | {p.name for p in ALL_CONCURRENCY_PASSES}
+        )
         unknown = wanted - known
         if unknown:
             print(
@@ -158,28 +213,34 @@ def main(argv=None) -> int:
             return 2
         ast_passes = [p for p in ALL_PASSES if p.name in wanted]
         jaxpr_passes = [p for p in ALL_JAXPR_PASSES if p.name in wanted]
-        # a --rules list naming only one tier's passes narrows the run to
-        # that tier (running the other with zero passes is wasted tracing)
-        if not jaxpr_passes and tier == "both":
-            tier = "ast"
-        if not ast_passes and tier == "both":
-            tier = "jaxpr"
-        # ...and a selection that leaves the effective tier with ZERO
-        # passes must not masquerade as a clean run (exit 0 with nothing
-        # executed): `--rules const-hoist some_file.py` pins the tier to
-        # ast (explicit paths) while naming only jaxpr rules — usage error
-        if tier == "ast" and not ast_passes:
+        conc_passes = [p for p in ALL_CONCURRENCY_PASSES if p.name in wanted]
+        # a --rules list naming only some tiers' passes narrows a
+        # multi-tier run to those tiers (running the others with zero
+        # passes is wasted tracing)...
+        if len(tiers) > 1:
+            if not ast_passes:
+                tiers.discard("ast")
+            if not jaxpr_passes:
+                tiers.discard("jaxpr")
+            if not conc_passes:
+                tiers.discard("concurrency")
+        # ...and a selection that leaves the effective tier set with
+        # ZERO passes must not masquerade as a clean run (exit 0 with
+        # nothing executed): `--rules const-hoist some_file.py` pins the
+        # tier to ast (explicit paths) while naming only jaxpr rules —
+        # usage error
+        _tier_passes = {
+            "ast": ast_passes,
+            "jaxpr": jaxpr_passes,
+            "concurrency": conc_passes,
+        }
+        empty = sorted(t for t in tiers if not _tier_passes[t])
+        if empty or not tiers:
             print(
-                f"--rules {args.rules}: no AST-tier pass selected, but the "
-                "run is pinned to the ast tier (explicit paths or --tier "
-                "ast); jaxpr rules need `--tier jaxpr` without paths",
-                file=sys.stderr,
-            )
-            return 2
-        if tier == "jaxpr" and not jaxpr_passes:
-            print(
-                f"--rules {args.rules}: no jaxpr-tier pass selected, but "
-                "--tier jaxpr was requested",
+                f"--rules {args.rules}: no pass selected for tier(s) "
+                f"{', '.join(empty) or tier} (explicit paths pin the run "
+                "to the ast tier; jaxpr/concurrency rules need --tier "
+                "without paths)",
                 file=sys.stderr,
             )
             return 2
@@ -190,13 +251,39 @@ def main(argv=None) -> int:
             print(f"no such path: {r}", file=sys.stderr)
             return 2
 
-    findings = []
-    if tier in ("ast", "both"):
-        findings.extend(run_passes(roots, ast_passes, rel_to=REPO_ROOT))
-    if tier in ("jaxpr", "both"):
+    def _run_ast():
+        return run_passes(roots, ast_passes, rel_to=REPO_ROOT)
+
+    def _run_jaxpr():
         from sentinel_tpu.analysis.jaxpr import run_jaxpr_analysis
 
-        findings.extend(run_jaxpr_analysis(passes=jaxpr_passes))
+        return run_jaxpr_analysis(passes=jaxpr_passes)
+
+    def _run_concurrency():
+        from sentinel_tpu.analysis.concurrency import run_concurrency_analysis
+
+        return run_concurrency_analysis(passes=conc_passes)
+
+    # ordered so sequential runs report tiers 1..3 in catalog order
+    tasks = [
+        t
+        for t in (
+            ("ast", _run_ast),
+            ("jaxpr", _run_jaxpr),
+            ("concurrency", _run_concurrency),
+        )
+        if t[0] in tiers
+    ]
+    findings = []
+    if args.jobs > 1 and len(tasks) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(args.jobs, len(tasks))) as ex:
+            for chunk in ex.map(lambda t: t[1](), tasks):
+                findings.extend(chunk)
+    else:
+        for _name, fn in tasks:
+            findings.extend(fn())
 
     if args.update_baseline:
         # a SCOPED update (explicit paths / one tier / a --rules subset)
@@ -212,13 +299,24 @@ def main(argv=None) -> int:
             os.path.relpath(r, REPO_ROOT).replace(os.sep, "/") for r in roots
         ]
 
+        from sentinel_tpu.analysis.concurrency.passes import (
+            ALL_CONCURRENCY_PASSES as _CC_PASSES,
+        )
+
+        conc_rules = {p.name for p in _CC_PASSES}
+
         def _in_scope(key: str) -> bool:
             rule, _, path = key.partition(":")
             if wanted_rules is not None and rule not in wanted_rules:
                 return False
             if path.startswith("jaxpr://"):
-                return tier in ("jaxpr", "both")
-            if tier == "jaxpr":
+                return "jaxpr" in tiers
+            if path.startswith("concurrency://"):
+                return "concurrency" in tiers
+            # tier-3 rules also land on real files (blocking-under-lock
+            # et al.) — scope them by the concurrency tier, not ast
+            owner = "concurrency" if rule in conc_rules else "ast"
+            if owner not in tiers:
                 return False
             return any(
                 rr in (".", "") or path == rr or path.startswith(rr + "/")

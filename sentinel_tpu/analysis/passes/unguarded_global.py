@@ -29,6 +29,14 @@ Module-level initialization code is exempt (import is single-threaded
 per the import lock).  Lock identity is the dotted source name of the
 lock expression (``_LOCK``, ``self._lock``) — syntactic, so two names
 aliasing one lock object are conservatively treated as different locks.
+
+Interprocedural upgrade (tier 3): a write site's effective lockset is
+the locks held AT the site plus the locks provably held at entry to the
+enclosing function — the intersection over every known call site, from
+``analysis.concurrency.summaries.module_entry_locks``.  A private helper
+whose callers all wrap it in ``with _LOCK:`` no longer reports its
+writes as unguarded, and those writes join the callers' lockset for the
+consistency check instead of being invisible to it.
 """
 
 from __future__ import annotations
@@ -89,9 +97,10 @@ class _Write(NamedTuple):
 class _FuncScanner(ast.NodeVisitor):
     """Walk one function body tracking the enclosing with-lock stack."""
 
-    def __init__(self, mutables, fname):
+    def __init__(self, mutables, fname, entry_locks: FrozenSet[str] = frozenset()):
         self.mutables = mutables
         self.fname = fname
+        self.entry_locks = entry_locks
         self.lock_stack: List[str] = []
         self.writes: List[_Write] = []
 
@@ -113,7 +122,13 @@ class _FuncScanner(ast.NodeVisitor):
 
     def _record(self, node, gname: str, verb: str) -> None:
         self.writes.append(
-            _Write(node, gname, verb, self.fname, frozenset(self.lock_stack))
+            _Write(
+                node,
+                gname,
+                verb,
+                self.fname,
+                frozenset(self.lock_stack) | self.entry_locks,
+            )
         )
 
     def visit_Assign(self, node):  # noqa: N802
@@ -174,6 +189,13 @@ class UnguardedGlobalPass(Pass):
         mutables = A.module_mutables(mod.tree)
         if not mutables:
             return []
+        # tier-3 summaries: locks provably held at entry to each private
+        # helper (intersection over its known call sites)
+        from sentinel_tpu.analysis.concurrency.summaries import (
+            module_entry_locks,
+        )
+
+        entry = module_entry_locks(mod)
         writes: List[_Write] = []
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -182,12 +204,13 @@ class UnguardedGlobalPass(Pass):
             for stmt in ast.walk(fn):
                 if isinstance(stmt, ast.Global):
                     declared_global |= {n for n in stmt.names if n in mutables}
-            scanner = _FuncScanner(mutables, fn.name)
+            held = entry.get(fn.name, frozenset())
+            scanner = _FuncScanner(mutables, fn.name, held)
             for stmt in fn.body:
                 scanner.visit(stmt)
             writes.extend(scanner.writes)
             if declared_global:
-                rebind = _RebindScanner(declared_global, fn.name)
+                rebind = _RebindScanner(declared_global, fn.name, held)
                 for stmt in fn.body:
                     rebind.visit(stmt)
                 writes.extend(rebind.writes)

@@ -43,6 +43,10 @@ The catalog (README "Chaos & fault injection" documents each):
                        run climbed when the scenario expected it to, and
                        goodput never hit zero while the ladder sat below
                        FAIL_CLOSED
+  no-order-violations  the runtime lock witness recorded zero lock-order
+                       inversions and no dynamic held→acquired edge the
+                       static tier-3 graph missed (trivially green when
+                       the witness is not installed)
 """
 
 from __future__ import annotations
@@ -313,6 +317,18 @@ def injected_as_planned(ctx: ScenarioContext) -> Verdict:
     )
 
 
+def no_order_violations(ctx: ScenarioContext) -> Verdict:
+    """The runtime lock witness (analysis/concurrency/witness.py) saw no
+    lock-order inversion and no dynamic held→acquired edge the static
+    tier-3 graph missed.  Trivially green when the witness was never
+    installed — scenarios run unwitnessed by default; the witness matrix
+    turns it on."""
+    from sentinel_tpu.analysis.concurrency import witness as W
+
+    ok, detail = W.verdict()
+    return _v("no-order-violations", ok, detail)
+
+
 #: name -> check; scenarios select by name, README documents each
 CATALOG: Dict[str, Callable[[ScenarioContext], Verdict]] = {
     "verdict-accounting": verdict_accounting,
@@ -328,6 +344,7 @@ CATALOG: Dict[str, Callable[[ScenarioContext], Verdict]] = {
     "metric-deltas": metric_deltas,
     "ladder-monotone": ladder_monotone,
     "injected-as-planned": injected_as_planned,
+    "no-order-violations": no_order_violations,
 }
 
 
@@ -335,8 +352,16 @@ def evaluate(names: List[str], ctx: ScenarioContext) -> List[Verdict]:
     """Run the named invariants in order; unknown names fail loudly (a
     scenario typo must not silently skip a safety check).  Any RED
     verdict triggers a flight-recorder bundle (obs/flight.py) so the
-    state that produced the breach survives for post-mortem."""
+    state that produced the breach survives for post-mortem.
+
+    ``no-order-violations`` is UNIVERSAL: every scenario evaluates it
+    whether it names it or not (appended here, deterministically — the
+    check reads the witness ledger, never the seed), because a lock
+    acquired against the blessed order during ANY fault window is a
+    latent deadlock regardless of what the scenario was probing."""
     out: List[Verdict] = []
+    if "no-order-violations" not in names:
+        names = list(names) + ["no-order-violations"]
     for n in names:
         chk = CATALOG.get(n)
         if chk is None:

@@ -154,7 +154,15 @@ class ClusterTokenClient(TokenService):
             return True
         if self._closed:
             return False
-        with self._lock:
+        # single-flight the connect: create_connection stalls up to its
+        # 2 s timeout against a dead shard, and admission threads used to
+        # QUEUE on this lock behind the connecting thread for that whole
+        # window.  A busy lock now means someone else is already paying
+        # the connect (or a teardown is mid-swap) — report unconnected
+        # immediately and let the caller take its degraded fallback.
+        if not self._lock.acquire(blocking=False):
+            return False
+        try:
             if self._sock is not None:
                 return True
             # base stays live-tunable (tests zero reconnect_interval_s on
@@ -164,7 +172,7 @@ class ClusterTokenClient(TokenService):
                 return False
             try:
                 FP.hit(_FP_CONNECT)
-                s = socket.create_connection((self.host, self.port), timeout=2.0)
+                s = socket.create_connection((self.host, self.port), timeout=2.0)  # stlint: disable=blocking-under-lock — single-flight: _lock is only ever taken with blocking=False here, so no admission thread waits out this connect; the sole blocking acquirer is _teardown, off the admission path
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # the CONNECT timeout must not linger as a read deadline:
                 # create_connection leaves it on the socket, and a server
@@ -182,6 +190,8 @@ class ClusterTokenClient(TokenService):
                 target=self._read_loop, args=(s,), name="sentinel-token-client", daemon=True
             )
             self._reader.start()
+        finally:
+            self._lock.release()
         # announce namespace so the server's census counts us (PING)
         try:
             self._send_nowait(
@@ -306,7 +316,7 @@ class ClusterTokenClient(TokenService):
         if s is None:
             raise OSError("not connected")
         with self._send_lock:
-            s.sendall(raw)
+            s.sendall(raw)  # stlint: disable=blocking-under-lock — _send_lock IS the socket-write framing lock: serializing sendall is its entire purpose; replies arrive via the mux reader thread, never under it
 
     def _roundtrip(self, req: P.ClusterRequest) -> Optional[P.ClusterResponse]:
         if not self._ensure_connected():
@@ -343,7 +353,7 @@ class ClusterTokenClient(TokenService):
             # the server never answers this xid => timeout kind
             raw = FP.pipe(_FP_SEND, raw)
             with self._send_lock:
-                s.sendall(raw)
+                s.sendall(raw)  # stlint: disable=blocking-under-lock — _send_lock IS the socket-write framing lock: serializing sendall is its entire purpose; replies arrive via the mux reader thread, never under it
         except OSError:
             self._pend_pop(req.xid)
             self._teardown(kind="send_fail")
@@ -462,7 +472,7 @@ class ClusterTokenClient(TokenService):
                 raise OSError("not connected")
             raw = FP.pipe(_FP_SEND, raw)
             with self._send_lock:
-                s.sendall(raw)
+                s.sendall(raw)  # stlint: disable=blocking-under-lock — _send_lock IS the socket-write framing lock: serializing sendall is its entire purpose; replies arrive via the mux reader thread, never under it
         except OSError:
             self._pend_pop(req.xid)
             self._teardown(kind="send_fail")
@@ -533,7 +543,7 @@ class ClusterTokenClient(TokenService):
                 if s is None:
                     raise OSError("not connected")
                 with self._send_lock:
-                    s.sendall(raw)
+                    s.sendall(raw)  # stlint: disable=blocking-under-lock — _send_lock IS the socket-write framing lock: serializing sendall is its entire purpose; replies arrive via the mux reader thread, never under it
             except (ValueError, struct.error):
                 self._pend_pop(req.xid)
                 out[i] = TokenResult(C.STATUS_BAD_REQUEST)

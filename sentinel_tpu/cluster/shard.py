@@ -754,7 +754,7 @@ class ShardedTokenClient(TokenService):
             if degraded:
                 FP.hit(_FP_PROBE)
             FP.hit(_FP_ROUTE)
-            rs = st.client.request_batch(entries)
+            rs = st.client.request_batch(entries)  # stlint: disable=blocking-under-lock — single-flight probe: probe_lock is only taken with blocking=False, so contenders serve the lease fallback instantly instead of queuing behind this round-trip
         except Exception:  # stlint: disable=fail-open — degrade to the shard-local lease fallback (fail-closed when no lease), never PASS
             self._enter_degraded(st)
             return None
@@ -884,7 +884,11 @@ class _LeaseRefresher:
         while True:
             with self._cv:
                 while not self._q and not self._closed:
-                    self._cv.wait()
+                    # bounded: the predicate loop makes the timeout free
+                    # (spurious wakeups just re-check), and a notify lost
+                    # to a future refactor degrades to a 1 s idle poll
+                    # instead of wedging this thread and close() forever
+                    self._cv.wait(timeout=1.0)
                 if self._closed:
                     return
                 batch, self._q = self._q, []

@@ -427,7 +427,11 @@ class TokenColumnBatcher:
         while True:
             with self._cv:
                 while not self._pending and not self._closed:
-                    self._cv.wait()
+                    # bounded: the predicate loop makes the timeout free
+                    # (spurious wakeups just re-check), and a notify lost
+                    # to a future refactor degrades to a 1 s idle poll
+                    # instead of wedging this thread and close() forever
+                    self._cv.wait(timeout=1.0)
                 if not self._pending and self._closed:
                     return
                 batch, self._pending = self._pending, []

@@ -116,7 +116,7 @@ class ZkClient:
 
     def _send_frame(self, payload: bytes) -> None:
         with self._wlock:
-            self._sock.sendall(struct.pack(">i", len(payload)) + payload)
+            self._sock.sendall(struct.pack(">i", len(payload)) + payload)  # stlint: disable=blocking-under-lock — _wlock is the frame-write lock: serializing sendall is its purpose; replies ride the reader thread under _plock
 
     def _recv_frame(self) -> bytes:
         hdr = self._recv_n(4)

@@ -499,7 +499,7 @@ def _capture_counter(result: str):
             "/ error)",
             labels={"result": result},
         )
-        _C_CAPTURES[result] = c  # stlint: disable=unguarded-global — every caller already holds _CAPTURE_LOCK (non-reentrant)
+        _C_CAPTURES[result] = c
     return c
 
 

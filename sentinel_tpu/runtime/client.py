@@ -38,6 +38,7 @@ from __future__ import annotations
 import threading
 import time as _time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -1203,16 +1204,38 @@ class SentinelClient:
         # engine — except while degraded, when fallback-enabled cluster rules
         # are compiled in as local rules (fallbackToLocalOrPass semantics)
         with self._cluster_lock:
-            with OT.TRACER.span("client.recompile_rules"):
-                self._recompile_rules_locked()
-            FL.note(
-                "rules.recompile",
-                degraded=self._cluster_degraded_active,
-                flow=len(self.flow_rules.get()),
-                param=len(self.param_flow_rules.get()),
-            )
+            changed = self._recompile_rules_noted()
+        self._warm_after_recompile(changed)
 
-    def _recompile_rules_locked(self) -> None:
+    def _recompile_rules_noted(self) -> bool:
+        """The traced + journaled recompile body; caller holds
+        _cluster_lock.  Returns whether the compiled tick changed (the
+        caller owns warming it — see _warm_after_recompile)."""
+        with OT.TRACER.span("client.recompile_rules"):
+            changed = self._recompile_rules_locked()
+        FL.note(
+            "rules.recompile",
+            degraded=self._cluster_degraded_active,
+            flow=len(self.flow_rules.get()),
+            param=len(self.param_flow_rules.get()),
+        )
+        return changed
+
+    def _warm_after_recompile(self, changed: bool) -> None:
+        """Pre-compile a changed tick for both batch shapes, OUTSIDE
+        _cluster_lock.  Lock order: _tick_mutex is the canonical OUTER
+        lock — tick_once holds it across the serving tick, and the
+        sync-mode seg-resize acquires _cluster_lock under it — so the
+        warm-up (which needs _tick_mutex to keep first calls of the
+        jitted tick from interleaving with serving ticks) must never run
+        while _cluster_lock is held.  A recompile that lands between the
+        release and the warm just means we warm the newer tick: warming
+        is idempotent performance work, never a correctness gate."""
+        if changed and self._started and self.mode == "threaded":
+            with self._tick_mutex:
+                self._warm_shapes()  # stlint: disable=blocking-under-lock — deliberate: warm-up first-calls must exclude serving ticks (concurrent first-calls corrupt the jitted dispatch fastpath); runs post-recompile on the control plane
+
+    def _recompile_rules_locked(self) -> bool:
         flow = self.flow_rules.get()
         local_flow = [r for r in flow if not r.cluster_mode]
         cluster_flow = [r for r in flow if r.cluster_mode]
@@ -1367,17 +1390,16 @@ class SentinelClient:
                     self._tick = E.make_tick(
                         self.cfg, donate=True, features=feats
                     )
-        # compile the new tick NOW for BOTH batch shapes so the first
+        # the caller warms the changed tick for BOTH batch shapes once
+        # _cluster_lock is released (_warm_after_recompile) so the first
         # post-reload entry doesn't eat the XLA compile inside its
-        # entry_timeout_s window.  Under _tick_mutex: the warm-up ticks
-        # must not interleave with the serving loop's tick iterations —
-        # two threads first-calling the same jitted tick concurrently
-        # corrupts the dispatch fastpath on this jaxlib (observed as
-        # 'Execution supplied N buffers but compiled program expected
-        # N+1' on subsequent calls)
-        if changed and self._started and self.mode == "threaded":
-            with self._tick_mutex:
-                self._warm_shapes()
+        # entry_timeout_s window; warming under _tick_mutex keeps the
+        # warm-up ticks from interleaving with the serving loop's tick
+        # iterations — two threads first-calling the same jitted tick
+        # concurrently corrupts the dispatch fastpath on this jaxlib
+        # (observed as 'Execution supplied N buffers but compiled program
+        # expected N+1' on subsequent calls)
+        return changed
 
     # -- cluster consultation -----------------------------------------------
 
@@ -1413,22 +1435,29 @@ class SentinelClient:
         Transition mechanics (cooldown arithmetic, counters, gauge,
         journal) live in the shared adaptive.degrade.Hysteresis."""
         entered = False
+        changed = False
         with self._cluster_lock:
             entered = self._cluster_hy.enter(
                 cooldown_s=self.cluster_retry_interval_s
             )
             if entered:
-                self._recompile_rules()
+                changed = self._recompile_rules_noted()
         if entered:
+            self._warm_after_recompile(changed)
             # black box: freeze the state that produced the degrade —
             # outside the lock (bundle capture reads rule managers and
             # the registry) and rate-limited inside trigger()
             FL.FLIGHT.trigger("cluster-degrade-enter")
 
     def _exit_cluster_degraded(self) -> None:
+        changed = False
+        exited = False
         with self._cluster_lock:
-            if self._cluster_hy.exit():
-                self._recompile_rules()
+            exited = self._cluster_hy.exit()
+            if exited:
+                changed = self._recompile_rules_noted()
+        if exited:
+            self._warm_after_recompile(changed)
 
     def _authority_pre_blocks(self, resource: str, origin: str) -> bool:
         """True when the device authority gate is going to reject this
@@ -2257,7 +2286,7 @@ class SentinelClient:
         everything before returning idle.  Whole iterations serialize on
         _tick_mutex — sync-mode clients call this from request threads."""
         with self._tick_mutex:
-            self._tick_once_locked(now_ms)
+            self._tick_once_locked(now_ms)  # stlint: disable=blocking-under-lock — the tick IS the device dispatch: _tick_mutex exists to serialize exactly this work; readbacks ride the resolver pool, not this lock
         # hot-set promote/demote loop: one cheap cadence check per
         # iteration, outside the tick mutex (the manager takes its own
         # locks; a promotion-triggered rule recompile must not hold up
@@ -3313,8 +3342,28 @@ class SentinelClient:
             else:
                 self._resolve_tick(p)
         futs, self._resolve_futs = self._resolve_futs, []
+        # bounded drain: _resolve_tick fails its own tick closed, so a
+        # future that does not complete means the resolver thread is
+        # WEDGED (a readback that never returns), and stop() holds
+        # _tick_mutex through this drain — an unbounded result() would
+        # hang shutdown forever while blocking every admission thread.
+        # One shared deadline across the batch: the ticks resolve
+        # concurrently, so waiting entry_timeout_s per future would pay
+        # N timeouts for one wedged device.
+        deadline = mono_s() + max(2.0 * self.entry_timeout_s, 5.0)
+        abandoned = 0
         for f in futs:
-            f.result()
+            try:
+                f.result(timeout=max(0.0, deadline - mono_s()))  # stlint: disable=blocking-under-lock — the deadline above bounds the whole drain; see the wedge rationale
+            except _FutTimeout:
+                abandoned += 1  # still running; its watchdog fails it over
+        if abandoned:
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log().warning(
+                "resolve drain abandoned %d wedged tick(s) after %.1fs",
+                abandoned, max(2.0 * self.entry_timeout_s, 5.0),
+            )
         # the pipeline is empty here — zero the gauges so /metrics never
         # reports a stale occupancy while the loop idles
         _G_OCCUPANCY.set(0)

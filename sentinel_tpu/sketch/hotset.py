@@ -183,7 +183,7 @@ class HotSetManager:
         Serialized on its own lock: the body mutates the promote/demote
         bookkeeping outside ``self._lock`` (which fold's hot path takes)."""
         with self._eval_lock:
-            self._evaluate_locked()
+            self._evaluate_locked()  # stlint: disable=blocking-under-lock — hot-set promotion is an off-tick maintenance pass single-flighted by _eval_lock; its recompile must be atomic vs a concurrent evaluate
 
     def _evaluate_locked(self) -> None:
         c = self._c

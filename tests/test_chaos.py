@@ -37,6 +37,7 @@ def _disarm_guard():
 
 def _import_instrumented_modules():
     """Import every module that registers failpoints (idempotent)."""
+    import sentinel_tpu.analysis.concurrency.witness  # noqa: F401
     import sentinel_tpu.chaos.runner  # noqa: F401
     import sentinel_tpu.cluster.client  # noqa: F401
     import sentinel_tpu.cluster.front_door  # noqa: F401

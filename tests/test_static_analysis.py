@@ -736,7 +736,7 @@ def test_cli_zero_pass_selection_is_usage_error(tmp_path):
         capture_output=True, text=True, env=env, timeout=120,
     )
     assert r.returncode == 2, r.stdout + r.stderr
-    assert "no AST-tier pass selected" in r.stderr
+    assert "no pass selected for tier(s) ast" in r.stderr
 
 
 def test_scoped_update_baseline_preserves_out_of_scope_debt(tmp_path):
