@@ -1,4 +1,4 @@
-"""sentinel_tpu.analysis — the three-tier TPU-hazard analyzer.
+"""sentinel_tpu.analysis — the four-tier TPU-hazard analyzer.
 
 Tier 1 (this package's ``passes/``): five AST passes over source files
 (fail-open, host-sync, jit-recompile, time-source, unguarded-global).
@@ -8,9 +8,14 @@ recompile-fingerprint, flops-bytes-budget).
 Tier 3 (``analysis/concurrency/``): four whole-program concurrency
 passes over interprocedural lock/blocking summaries (lock-order-cycle,
 lock-order-new-edge, blocking-under-lock, thread-lifecycle) plus the
-opt-in runtime lock witness.  See README.md in this directory for the
-full rule catalog, suppression anchoring, and the fingerprint/budget/
-lock-order/baseline workflows.
+opt-in runtime lock witness.
+Tier 4 (``analysis/spmd/``): five SPMD/sharding passes over the entry
+points lowered under the blessed 8-device mesh (collective-ledger,
+implicit-reshard, replication-hazard, shard-divisibility,
+shard-hbm-budget); the mesh is forced in a subprocess so the calling
+process's jax topology never changes.  See README.md in this directory
+for the full rule catalog, suppression anchoring, and the fingerprint/
+budget/lock-order/collectives/baseline workflows.
 
 Programmatic surface::
 
@@ -20,6 +25,8 @@ Programmatic surface::
     findings = run_jaxpr_analysis()              # jaxpr tier
     from sentinel_tpu.analysis.concurrency import run_concurrency_analysis
     findings = run_concurrency_analysis()        # concurrency tier
+    from sentinel_tpu.analysis.spmd import run_spmd_analysis
+    findings = run_spmd_analysis()               # spmd tier
 
 CLI::
 
@@ -62,12 +69,14 @@ def rule_catalog() -> dict:
     summary building only happen when they run)."""
     from sentinel_tpu.analysis.concurrency.passes import ALL_CONCURRENCY_PASSES
     from sentinel_tpu.analysis.jaxpr.passes import ALL_JAXPR_PASSES
+    from sentinel_tpu.analysis.spmd.passes import ALL_SPMD_PASSES
 
     return {
         p.name: p.description
         for p in tuple(ALL_PASSES)
         + tuple(ALL_JAXPR_PASSES)
         + tuple(ALL_CONCURRENCY_PASSES)
+        + tuple(ALL_SPMD_PASSES)
     }
 
 

@@ -9,11 +9,16 @@ Runs ALL analyzer tiers by default:
 * tier 3 — the whole-program concurrency analyzer (interprocedural
   lock-order graph, blocking-under-lock, thread-lifecycle; repo-global
   like tier 2, skipped under explicit paths — ``--tier concurrency``
-  forces it).
+  forces it);
+* tier 4 — the SPMD/sharding analyzer (collective-cost ledger,
+  implicit-reshard/replication hazards, shard divisibility, per-shard
+  HBM budget; lowers the entry points under the blessed 8-device CPU
+  mesh in a SUBPROCESS, so the calling process's jax topology is never
+  touched — ``--tier spmd`` forces it).
 
 ``--jobs N`` runs the selected tiers concurrently (threads; the jaxpr
-trace dominates wall clock, so the AST and concurrency tiers ride along
-for free).
+trace and the spmd worker subprocess dominate wall clock, so the AST
+and concurrency tiers ride along for free).
 
 Exit status: 0 — no findings beyond the checked-in baseline;
 1 — new findings (print + fail, the CI contract); 2 — usage error.
@@ -69,7 +74,7 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--tier",
-        choices=("ast", "jaxpr", "concurrency", "both", "all", "metrics"),
+        choices=("ast", "jaxpr", "concurrency", "spmd", "both", "all", "metrics"),
         default=None,
         help=(
             "which analyzer tier(s) to run (default: all without explicit "
@@ -131,9 +136,19 @@ def main(argv=None) -> int:
         ),
     )
     ap.add_argument(
+        "--update-collectives",
+        action="store_true",
+        help=(
+            "re-lower the sharded entry points and rewrite the golden "
+            "collective ledger (sentinel_tpu/analysis/spmd/collectives.json); "
+            "commit the diff ONLY after reviewing each new collective — "
+            "every pinned transfer is per-tick interconnect traffic"
+        ),
+    )
+    ap.add_argument(
         "--rules",
         default="",
-        help="comma-separated pass names to run (default: all, both tiers)",
+        help="comma-separated pass names to run (default: all, all tiers)",
     )
     args = ap.parse_args(argv)
 
@@ -141,8 +156,13 @@ def main(argv=None) -> int:
         print("--json and --sarif are mutually exclusive", file=sys.stderr)
         return 2
 
-    # -- golden updates (tier-2/3 maintenance verbs) ------------------------
-    if args.update_fingerprints or args.update_budgets or args.update_lock_order:
+    # -- golden updates (tier-2/3/4 maintenance verbs) ----------------------
+    if (
+        args.update_fingerprints
+        or args.update_budgets
+        or args.update_lock_order
+        or args.update_collectives
+    ):
         if args.update_fingerprints or args.update_budgets:
             from sentinel_tpu.analysis import jaxpr as J
 
@@ -159,6 +179,14 @@ def main(argv=None) -> int:
 
             n = CC.update_lock_order()
             print(f"lock order updated: {n} edge(s) -> {CC.LOCK_ORDER_PATH}")
+        if args.update_collectives:
+            from sentinel_tpu.analysis import spmd as SP
+
+            n = SP.update_collectives()
+            print(
+                f"collective ledger updated: {n} entry point(s) -> "
+                f"{SP.COLLECTIVES_PATH}"
+            )
         return 0
 
     tier = args.tier or ("ast" if args.paths else "all")
@@ -181,8 +209,9 @@ def main(argv=None) -> int:
         "ast": ("ast",),
         "jaxpr": ("jaxpr",),
         "concurrency": ("concurrency",),
+        "spmd": ("spmd",),
         "both": ("ast", "jaxpr"),
-        "all": ("ast", "jaxpr", "concurrency"),
+        "all": ("ast", "jaxpr", "concurrency", "spmd"),
     }
     tiers = set(_TIER_SETS[tier])
 
@@ -191,17 +220,20 @@ def main(argv=None) -> int:
     jaxpr_passes = None  # None = all (resolved lazily: importing them is free,
     # but building the entry list costs a trace)
     conc_passes = None  # None = all tier-3 passes
+    spmd_passes = None  # None = all tier-4 passes
     if args.rules:
         from sentinel_tpu.analysis.concurrency.passes import (
             ALL_CONCURRENCY_PASSES,
         )
         from sentinel_tpu.analysis.jaxpr.passes import ALL_JAXPR_PASSES
+        from sentinel_tpu.analysis.spmd.passes import ALL_SPMD_PASSES
 
         wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
         known = (
             {p.name for p in ALL_PASSES}
             | {p.name for p in ALL_JAXPR_PASSES}
             | {p.name for p in ALL_CONCURRENCY_PASSES}
+            | {p.name for p in ALL_SPMD_PASSES}
         )
         unknown = wanted - known
         if unknown:
@@ -214,6 +246,7 @@ def main(argv=None) -> int:
         ast_passes = [p for p in ALL_PASSES if p.name in wanted]
         jaxpr_passes = [p for p in ALL_JAXPR_PASSES if p.name in wanted]
         conc_passes = [p for p in ALL_CONCURRENCY_PASSES if p.name in wanted]
+        spmd_passes = [p for p in ALL_SPMD_PASSES if p.name in wanted]
         # a --rules list naming only some tiers' passes narrows a
         # multi-tier run to those tiers (running the others with zero
         # passes is wasted tracing)...
@@ -224,6 +257,8 @@ def main(argv=None) -> int:
                 tiers.discard("jaxpr")
             if not conc_passes:
                 tiers.discard("concurrency")
+            if not spmd_passes:
+                tiers.discard("spmd")
         # ...and a selection that leaves the effective tier set with
         # ZERO passes must not masquerade as a clean run (exit 0 with
         # nothing executed): `--rules const-hoist some_file.py` pins the
@@ -233,14 +268,15 @@ def main(argv=None) -> int:
             "ast": ast_passes,
             "jaxpr": jaxpr_passes,
             "concurrency": conc_passes,
+            "spmd": spmd_passes,
         }
         empty = sorted(t for t in tiers if not _tier_passes[t])
         if empty or not tiers:
             print(
                 f"--rules {args.rules}: no pass selected for tier(s) "
                 f"{', '.join(empty) or tier} (explicit paths pin the run "
-                "to the ast tier; jaxpr/concurrency rules need --tier "
-                "without paths)",
+                "to the ast tier; jaxpr/concurrency/spmd rules need "
+                "--tier without paths)",
                 file=sys.stderr,
             )
             return 2
@@ -264,19 +300,51 @@ def main(argv=None) -> int:
 
         return run_concurrency_analysis(passes=conc_passes)
 
-    # ordered so sequential runs report tiers 1..3 in catalog order
+    def _run_spmd():
+        from sentinel_tpu.analysis.spmd import run_spmd_analysis
+
+        return run_spmd_analysis(passes=spmd_passes)
+
+    # ordered so sequential runs report tiers 1..4 in catalog order; the
+    # spmd worker is a subprocess, so under --jobs it overlaps the jaxpr
+    # trace instead of serializing behind it
     tasks = [
         t
         for t in (
             ("ast", _run_ast),
             ("jaxpr", _run_jaxpr),
             ("concurrency", _run_concurrency),
+            ("spmd", _run_spmd),
         )
         if t[0] in tiers
     ]
     findings = []
     if args.jobs > 1 and len(tasks) > 1:
         from concurrent.futures import ThreadPoolExecutor
+
+        # the tier runners import overlapping module graphs lazily;
+        # two threads resolving them concurrently can deadlock on
+        # Python's per-module import locks (A holds X wants Y, B holds
+        # Y wants X).  Importing is cheap — tracing/lowering happens at
+        # run time — so resolve every selected tier's imports here,
+        # single-threaded, before fanning out.
+        import importlib
+
+        _TIER_MODULES = {
+            "jaxpr": ("sentinel_tpu.analysis.jaxpr",
+                      "sentinel_tpu.analysis.jaxpr.entrypoints",
+                      "sentinel_tpu.analysis.jaxpr.passes"),
+            "concurrency": ("sentinel_tpu.analysis.concurrency",
+                            "sentinel_tpu.analysis.concurrency.summaries",
+                            "sentinel_tpu.analysis.concurrency.passes"),
+            "spmd": ("sentinel_tpu.analysis.spmd",
+                     "sentinel_tpu.analysis.spmd.entrypoints",
+                     "sentinel_tpu.analysis.spmd.runner",
+                     "sentinel_tpu.analysis.spmd.passes"),
+        }
+        for t in sorted(tiers):
+            for mod in _TIER_MODULES.get(t, ()):
+                importlib.import_module(mod)
 
         with ThreadPoolExecutor(max_workers=min(args.jobs, len(tasks))) as ex:
             for chunk in ex.map(lambda t: t[1](), tasks):
@@ -302,8 +370,12 @@ def main(argv=None) -> int:
         from sentinel_tpu.analysis.concurrency.passes import (
             ALL_CONCURRENCY_PASSES as _CC_PASSES,
         )
+        from sentinel_tpu.analysis.spmd.passes import (
+            ALL_SPMD_PASSES as _SP_PASSES,
+        )
 
         conc_rules = {p.name for p in _CC_PASSES}
+        spmd_rules = {p.name for p in _SP_PASSES}
 
         def _in_scope(key: str) -> bool:
             rule, _, path = key.partition(":")
@@ -313,9 +385,17 @@ def main(argv=None) -> int:
                 return "jaxpr" in tiers
             if path.startswith("concurrency://"):
                 return "concurrency" in tiers
-            # tier-3 rules also land on real files (blocking-under-lock
-            # et al.) — scope them by the concurrency tier, not ast
-            owner = "concurrency" if rule in conc_rules else "ast"
+            if path.startswith("spmd://"):
+                return "spmd" in tiers
+            # tier-3/4 rules also land on real files (blocking-under-lock,
+            # implicit-reshard et al.) — scope them by their own tier,
+            # not ast
+            if rule in spmd_rules:
+                owner = "spmd"
+            elif rule in conc_rules:
+                owner = "concurrency"
+            else:
+                owner = "ast"
             if owner not in tiers:
                 return False
             return any(

@@ -1,0 +1,239 @@
+"""sentinel_tpu.analysis.spmd — the tier-4 SPMD/sharding analyzer.
+
+Tier 2 pins the traced program, tier 3 the lock graph; this tier pins
+the PARTITIONED program: the real entry points (engine tick with the
+salsa sketch tier, ``ops/window.add_batch``, ``ops/token_col``) lowered
+under the blessed 8-device CPU mesh with the shardings
+``parallel/spmd.py`` declares, then five passes over the sharded HLO
+and the declared placements:
+
+* ``collective-ledger``   — all-gather/all-reduce/reduce-scatter/
+  collective-permute inventory with bytes-over-interconnect per tick,
+  golden-pinned in ``collectives.json`` (``--update-collectives``);
+* ``implicit-reshard``    — the silent all-gather class: XLA rebuilding
+  a supposedly sharded array at full size to resolve a mismatch;
+* ``replication-hazard``  — jaxpr consts and replicated state leaves
+  beyond size thresholds (the SALSA planes must stay sharded);
+* ``shard-divisibility``  — every sharded dim divides the mesh width
+  for every blessed config, no tracing needed;
+* ``shard-hbm-budget``    — per-shard bytes projected from the specs
+  for the 1M-resource sketch tier vs the HBM capacity SLO.
+
+The mesh is forced in a SUBPROCESS (runner.py) so running this tier
+never changes the calling process's jax topology — it is safe inside
+tier-1 pytest and pre-commit.
+
+Programmatic surface::
+
+    from sentinel_tpu.analysis.spmd import run_spmd_analysis
+    findings = run_spmd_analysis()
+
+CLI: ``python -m sentinel_tpu.analysis --tier spmd``.  See
+sentinel_tpu/analysis/README.md for rule IDs and the golden workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+from sentinel_tpu.analysis.framework import Finding
+from sentinel_tpu.analysis.spmd.framework import (  # noqa: F401
+    COLLECTIVES_PATH,
+    Collective,
+    ConfigCase,
+    ConstInfo,
+    LeafPlacement,
+    ShardedEntry,
+    SpmdPass,
+    SpmdProgram,
+    group_collectives,
+    ledger_bytes,
+    parse_hlo_collectives,
+)
+
+#: default per-chip HBM capacity SLO when SENTINEL_HBM_CAPACITY_BYTES is
+#: unset (a v5e chip's 16 GiB) — the obs ledger treats 0 as "no SLO",
+#: but the budgeter always has a ceiling to project against
+DEFAULT_CAPACITY_BYTES = 16 << 30
+
+
+def spmd_passes():
+    from sentinel_tpu.analysis.spmd.passes import ALL_SPMD_PASSES
+
+    return ALL_SPMD_PASSES
+
+
+def capacity_slo_bytes() -> int:
+    """The HBM capacity SLO: the obs ledger's env knob, else 16 GiB."""
+    try:
+        env = int(os.environ.get("SENTINEL_HBM_CAPACITY_BYTES", "0") or 0)
+    except ValueError:
+        env = 0
+    return env if env > 0 else DEFAULT_CAPACITY_BYTES
+
+
+def _report_entries(report: dict, placements_by_name: dict) -> List[ShardedEntry]:
+    entries = []
+    for e in report.get("entries", []):
+        entries.append(
+            ShardedEntry(
+                name=e["name"],
+                collectives=[
+                    Collective(
+                        kind=c["kind"],
+                        dtype=c["dtype"],
+                        shape=tuple(c["shape"]),
+                        source=c.get("source"),
+                        line=int(c.get("line", 0)),
+                    )
+                    for c in e.get("collectives", [])
+                ],
+                consts=[
+                    ConstInfo(
+                        dtype=c["dtype"],
+                        shape=tuple(c["shape"]),
+                        nbytes=int(c["nbytes"]),
+                    )
+                    for c in e.get("consts", [])
+                ],
+                placements=placements_by_name.get(e["name"], []),
+            )
+        )
+    return entries
+
+
+def _load_golden(path: str) -> Optional[dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def build_program(
+    golden_path: str = COLLECTIVES_PATH, refresh: bool = False
+) -> SpmdProgram:
+    """Assemble the pass input: worker HLO report (subprocess, cached
+    per process) + declared placements + blessed config cases."""
+    from sentinel_tpu.analysis.spmd import entrypoints as EP
+    from sentinel_tpu.analysis.spmd.runner import SpmdWorkerError, worker_report
+    from sentinel_tpu.parallel.meshspec import mesh_spec
+
+    spec = mesh_spec()
+    placements = EP.entry_placements()
+    worker_error = None
+    report = {}
+    try:
+        report = worker_report(spec.n_devices, refresh=refresh)
+    except SpmdWorkerError as e:
+        worker_error = str(e)
+    program = SpmdProgram(
+        n_devices=spec.n_devices,
+        axis=spec.axis,
+        entries=_report_entries(report, placements),
+        configs=[ConfigCase(name=n, placements=p) for n, p in EP.config_cases()],
+        budget_config=EP.BUDGET_CONFIG,
+        capacity_bytes=capacity_slo_bytes(),
+        golden=_load_golden(golden_path) if golden_path else None,
+        jax_version=report.get("jax_version", ""),
+        worker_error=worker_error,
+    )
+    _export_gauges(program)
+    return program
+
+
+def _export_gauges(program: SpmdProgram) -> None:
+    """Publish the analyzer's measurements on the obs registry so the
+    profiling plane (and the README catalog) can see what the mesh
+    costs: interconnect bytes per tick per entry, and the projected
+    per-shard HBM for the budgeted config."""
+    from sentinel_tpu.obs.registry import REGISTRY
+
+    for e in program.entries:
+        REGISTRY.gauge(
+            "sentinel_spmd_collective_bytes_per_tick",
+            "per-tick bytes over the interconnect placed by the GSPMD "
+            "partitioner for one lowered entry point (tier-4 analyzer)",
+            labels={"entry": e.name},
+        ).set(ledger_bytes(group_collectives(e.collectives)))
+    case = program.budget_case()
+    if case is not None:
+        REGISTRY.gauge(
+            "sentinel_spmd_shard_hbm_projected_bytes",
+            "per-device state bytes projected from the declared "
+            "shardings for the budgeted 1M-resource config (tier-4 "
+            "analyzer)",
+        ).set(case.shard_bytes)
+
+
+def run_spmd_analysis(
+    passes: Optional[Sequence[SpmdPass]] = None,
+    program: Optional[SpmdProgram] = None,
+) -> List[Finding]:
+    """Run the tier-4 passes; ``# stlint:`` suppressions on findings
+    anchored at real source lines are honored (pseudo-path findings are
+    managed through the golden/baseline, not comments)."""
+    from sentinel_tpu.analysis import REPO_ROOT
+    from sentinel_tpu.analysis.framework import _SEV_ORDER
+    from sentinel_tpu.analysis.jaxpr.framework import _source_suppressed
+
+    if program is None:
+        program = build_program()
+    if passes is None:
+        passes = spmd_passes()
+    findings: List[Finding] = []
+    sup_cache: dict = {}
+    for p in passes:
+        for f in p.run(program):
+            if not _source_suppressed(REPO_ROOT, sup_cache, f):
+                findings.append(f)
+    findings.sort(
+        key=lambda f: (_SEV_ORDER.get(f.severity, 9), f.path, f.line, f.rule)
+    )
+    return findings
+
+
+def update_collectives(path: str = COLLECTIVES_PATH) -> int:
+    """Re-pin the golden collective ledger from a fresh worker run;
+    returns the entry count.  Commit the diff ONLY after reviewing each
+    new collective — every pinned transfer is interconnect the tick pays
+    forever."""
+    from sentinel_tpu.analysis.spmd.runner import worker_report
+    from sentinel_tpu.parallel.meshspec import mesh_spec
+
+    spec = mesh_spec()
+    report = worker_report(spec.n_devices, refresh=True)
+    entries = {}
+    for e in report.get("entries", []):
+        colls = [
+            Collective(kind=c["kind"], dtype=c["dtype"], shape=tuple(c["shape"]))
+            for c in e.get("collectives", [])
+        ]
+        groups = group_collectives(colls)
+        entries[e["name"]] = {
+            "collectives": groups,
+            "bytes_per_tick": ledger_bytes(groups),
+        }
+    data = {
+        "comment": (
+            "Golden collective ledger per lowered entry point under the "
+            "blessed mesh (parallel/meshspec.py).  Shapes are per-device "
+            "HLO buffer shapes; bytes_per_tick is the summed transfer "
+            "size the GSPMD partitioner placed.  Regenerate with "
+            "`python -m sentinel_tpu.analysis --update-collectives` and "
+            "commit ONLY when the new interconnect traffic is the point "
+            "of the PR (see analysis/README.md)."
+        ),
+        "jax_version": report.get("jax_version", ""),
+        "mesh": {
+            "axis": report.get("axis", spec.axis),
+            "n_devices": report.get("n_devices", spec.n_devices),
+        },
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return len(entries)

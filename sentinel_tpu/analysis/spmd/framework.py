@@ -1,0 +1,268 @@
+"""SPMD-tier (tier-4) analysis framework.
+
+Tier 2 sees the traced PROGRAM; this tier sees the PARTITIONED program —
+each real entry point lowered under the blessed 8-device CPU mesh
+(``parallel/meshspec.py``) with the shardings ``parallel/spmd.py``
+declares, compiled through GSPMD, and read back as optimized HLO.  The
+objects of study are what partitioning ADDS: the collectives XLA placed
+(all-gather / all-reduce / reduce-scatter / collective-permute /
+all-to-all, each with its per-tick bytes over the interconnect), the
+implicit reshards it resolved silently, and the per-shard byte footprint
+the declared specs imply.
+
+Findings reuse the tier-1 :class:`Finding`/baseline machinery.  Where a
+collective carries HLO source metadata the finding lands on the real
+``file:line`` (so ``# stlint: disable=`` comments apply); program-level
+findings anchor on the entry's pseudo-path ``spmd://<entry-name>`` and
+config-level ones on ``spmd://config/<config-name>``.
+
+Everything in this module is mesh-free and jax-free-at-import: the
+passes run in the PARENT process over a plain-data report produced by
+the forced-topology subprocess (worker.py via runner.py), which keeps
+them unit-testable on synthetic fixtures and keeps the parent's jax
+device topology untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from sentinel_tpu.analysis.framework import ERROR, Finding
+
+#: directory of the golden file (collectives.json)
+SPMD_DIR = os.path.dirname(os.path.abspath(__file__))
+COLLECTIVES_PATH = os.path.join(SPMD_DIR, "collectives.json")
+
+#: HLO primitive byte widths (shapes printed by the partitioner are
+#: per-device buffer shapes)
+DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: collective ops the ledger tracks (async "-start" forms fold into the
+#: base kind; "-done" carries no new transfer)
+COLLECTIVE_KINDS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+
+@dataclass(frozen=True)
+class Collective:
+    """One collective instruction in the partitioned HLO."""
+
+    kind: str  # e.g. "all-gather"
+    dtype: str  # HLO dtype, e.g. "s32"
+    shape: Tuple[int, ...]  # per-device RESULT buffer shape
+    source: Optional[str] = None  # repo-relative path from HLO metadata
+    line: int = 0
+
+    @property
+    def nbytes(self) -> int:
+        n = DTYPE_BYTES.get(self.dtype, 4)
+        for d in self.shape:
+            n *= d
+        return n
+
+
+@dataclass(frozen=True)
+class ConstInfo:
+    """One jaxpr const closed over by an entry (replicated by construction)."""
+
+    dtype: str
+    shape: Tuple[int, ...]
+    nbytes: int
+
+
+@dataclass(frozen=True)
+class LeafPlacement:
+    """One state leaf folded with its declared PartitionSpec."""
+
+    name: str  # pytree key path, e.g. ".win_sec.counts"
+    dtype: str
+    shape: Tuple[int, ...]
+    spec: Tuple[Optional[str], ...]  # mesh axis (or None) per dimension
+    global_bytes: int
+    shard_bytes: int  # projected per-device bytes under the spec
+
+    @property
+    def sharded(self) -> bool:
+        return any(a is not None for a in self.spec)
+
+
+@dataclass
+class ShardedEntry:
+    """One lowered+partitioned entry point: the unit the HLO passes run over."""
+
+    name: str  # e.g. "tick/sketch-salsa"
+    collectives: List[Collective] = field(default_factory=list)
+    consts: List[ConstInfo] = field(default_factory=list)
+    placements: List[LeafPlacement] = field(default_factory=list)
+
+    @property
+    def pseudo_path(self) -> str:
+        return f"spmd://{self.name}"
+
+
+@dataclass
+class ConfigCase:
+    """One blessed config's state leaves folded with the declared specs —
+    enough for divisibility and byte math WITHOUT lowering anything."""
+
+    name: str  # e.g. "bench/sketch-1m"
+    placements: List[LeafPlacement] = field(default_factory=list)
+
+    @property
+    def pseudo_path(self) -> str:
+        return f"spmd://config/{self.name}"
+
+    @property
+    def shard_bytes(self) -> int:
+        return sum(p.shard_bytes for p in self.placements)
+
+
+@dataclass
+class SpmdProgram:
+    """Everything the tier-4 passes consume, as plain data."""
+
+    n_devices: int
+    axis: str
+    entries: List[ShardedEntry] = field(default_factory=list)
+    configs: List[ConfigCase] = field(default_factory=list)
+    #: name of the ConfigCase the HBM budgeter projects (the 1M-resource
+    #: sketch tier); None disables the budget pass
+    budget_config: Optional[str] = None
+    capacity_bytes: int = 0
+    golden: Optional[Dict[str, Any]] = None
+    jax_version: str = ""
+    #: non-None when the forced-topology subprocess failed — the ledger
+    #: pass surfaces it loudly instead of reporting a silently-empty tier
+    worker_error: Optional[str] = None
+
+    def budget_case(self) -> Optional[ConfigCase]:
+        for c in self.configs:
+            if c.name == self.budget_config:
+                return c
+        return None
+
+
+class SpmdPass:
+    """One pass over the partitioned program."""
+
+    name: str = ""
+    description: str = ""
+    severity: str = ERROR
+
+    def run(self, program: SpmdProgram) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(
+        self,
+        path: str,
+        message: str,
+        severity: Optional[str] = None,
+        line: int = 1,
+    ) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=path,
+            line=line,
+            col=0,
+            message=message,
+            severity=severity or self.severity,
+        )
+
+
+# -- HLO parsing -------------------------------------------------------------
+
+# `  %all-gather.12 = s32[2,512]{1,0} all-gather(...), ..., metadata={...
+# source_file="/abs/sentinel_tpu/ops/tables.py" source_line=246 ...}`
+_INSTR_RE = re.compile(
+    r"=\s+(?P<dtype>\w+)\[(?P<shape>[\d,]*)\]\S*\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\("
+)
+_SRC_RE = re.compile(r'source_file="([^"]+)"\s+source_line=(\d+)')
+
+
+def parse_hlo_collectives(
+    hlo_text: str, repo_root: Optional[str] = None
+) -> List[Collective]:
+    """Every collective instruction in an optimized-HLO dump.
+
+    Shapes are the per-device result buffers the partitioner printed;
+    tuple-shaped results (async forms) are skipped at the "-done" side so
+    each transfer counts once.
+    """
+    out: List[Collective] = []
+    for ln in hlo_text.splitlines():
+        m = _INSTR_RE.search(ln)
+        if not m:
+            continue
+        shape = tuple(int(d) for d in m.group("shape").split(",") if d)
+        src: Optional[str] = None
+        line = 0
+        sm = _SRC_RE.search(ln)
+        if sm:
+            fn = sm.group(1)
+            line = int(sm.group(2))
+            if repo_root:
+                try:
+                    rel = os.path.relpath(fn, repo_root).replace(os.sep, "/")
+                except ValueError:
+                    rel = fn
+                src = None if rel.startswith("..") else rel
+            else:
+                src = fn
+        out.append(
+            Collective(
+                kind=m.group("kind"),
+                dtype=m.group("dtype"),
+                shape=shape,
+                source=src,
+                line=line,
+            )
+        )
+    return out
+
+
+def group_collectives(colls: Iterable[Collective]) -> List[Dict[str, Any]]:
+    """Collectives grouped by (kind, dtype, shape) — the golden's unit.
+
+    Source lines are deliberately NOT part of the key: they drift with
+    every unrelated edit, while the (kind, shape, count) inventory only
+    moves when the partitioned program really changes.
+    """
+    acc: Dict[Tuple[str, str, Tuple[int, ...]], Dict[str, Any]] = {}
+    for c in colls:
+        key = (c.kind, c.dtype, c.shape)
+        g = acc.get(key)
+        if g is None:
+            acc[key] = {
+                "kind": c.kind,
+                "dtype": c.dtype,
+                "shape": list(c.shape),
+                "count": 1,
+                "bytes_each": c.nbytes,
+            }
+        else:
+            g["count"] += 1
+    return sorted(
+        acc.values(),
+        key=lambda g: (g["kind"], g["dtype"], tuple(g["shape"])),
+    )
+
+
+def ledger_bytes(groups: Iterable[Dict[str, Any]]) -> int:
+    """Per-tick bytes over the interconnect for a grouped inventory."""
+    return sum(int(g["count"]) * int(g["bytes_each"]) for g in groups)

@@ -1,0 +1,78 @@
+"""Parent-side runner for the forced-topology SPMD worker.
+
+The tier-4 analyzer needs an 8-device mesh, but it runs INSIDE tier-1
+pytest and pre-commit — processes whose jax topology must not change
+(``xla_force_host_platform_device_count`` is frozen at backend init).
+So the lowering happens in a subprocess whose env is prepared by the
+shared ``meshspec.force_cpu_mesh_env`` recipe, and the parent consumes a
+plain-JSON report.  The report is cached per process: every pass, test,
+and CLI invocation in one process shares a single ~15 s worker run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, Optional
+
+from sentinel_tpu.parallel.meshspec import force_cpu_mesh_env, mesh_spec
+
+#: generous ceiling — the tick compile dominates at ~12 s on CPU
+WORKER_TIMEOUT_S = 300
+
+_CACHE: Dict[int, dict] = {}
+_CACHE_LOCK = threading.Lock()
+
+
+class SpmdWorkerError(RuntimeError):
+    """Worker subprocess failed; str() carries the stderr tail."""
+
+
+def _run_worker(n_devices: int) -> dict:
+    from sentinel_tpu.analysis import REPO_ROOT
+
+    env = dict(os.environ)
+    force_cpu_mesh_env(env, n_devices)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "sentinel_tpu.analysis.spmd.worker"],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=REPO_ROOT,
+            timeout=WORKER_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise SpmdWorkerError(
+            f"spmd worker timed out after {WORKER_TIMEOUT_S}s"
+        ) from e
+    tail = "\n".join(proc.stderr.strip().splitlines()[-8:])
+    if proc.returncode != 0:
+        raise SpmdWorkerError(
+            f"spmd worker exited {proc.returncode}: {tail or '(no stderr)'}"
+        )
+    # protocol: the report is the LAST non-empty stdout line
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines:
+        raise SpmdWorkerError(f"spmd worker printed no report: {tail}")
+    try:
+        return json.loads(lines[-1])
+    except ValueError as e:
+        raise SpmdWorkerError(
+            f"spmd worker report is not JSON ({e}): {lines[-1][:200]}"
+        ) from e
+
+
+def worker_report(
+    n_devices: Optional[int] = None, refresh: bool = False
+) -> dict:
+    """The worker's report for the blessed mesh, cached per process."""
+    n = n_devices if n_devices is not None else mesh_spec().n_devices
+    with _CACHE_LOCK:
+        if refresh or n not in _CACHE:
+            _CACHE[n] = _run_worker(n)
+        return _CACHE[n]

@@ -243,6 +243,15 @@ def depth_gather_1col(
     ok = (cols >= 0) & (cols < width)
     flat_idx = (jnp.where(ok, cols, 0) + off).T.reshape(-1)  # [depth*N]
     flat_ok = ok.T.reshape(-1)
+    # The flatten destroys the width sharding, so under the SPMD mesh
+    # XLA all-gathers the full [depth, width] slice of the salsa running
+    # sums before the gather (pinned in analysis/spmd/collectives.json:
+    # 2 x s32[2,512] per tick at the CI config, scaling to 2 x 512 KiB
+    # per device per tick at the 1M tier).  The shard-local fix —
+    # partial gather on each width shard + all-reduce of the [depth, N]
+    # result — is scoped to MULTICHIP_r06 (ROADMAP open item 1); any
+    # NEW gather through this line still fails the collective-ledger pass.
+    # stlint: disable-next-line=implicit-reshard — known salsa read reshard, pinned in the ledger
     flat_tab = tab.reshape(depth * width)
     if cfg is None or not cfg.use_mxu_tables:
         g = jnp.where(flat_ok, flat_tab[flat_idx].astype(jnp.float32), 0.0)

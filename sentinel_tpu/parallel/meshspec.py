@@ -1,0 +1,78 @@
+"""The ONE mesh description every SPMD consumer shares.
+
+Three things need the same answer to "what mesh do we shard over, and
+how do we boot a virtual copy of it on CPU?":
+
+* ``parallel/spmd.py``      — builds the runtime ``Mesh`` and shardings;
+* ``__graft_entry__.py``    — the multichip dry-run re-execs a child with
+  a forced n-device CPU platform;
+* ``analysis/spmd/``        — the tier-4 analyzer lowers the real entry
+  points under the same mesh in a subprocess (runner.py) and its tests
+  run inside the tier-1 suite, whose conftest forces the same topology.
+
+Before this module each of those restated "8 devices, axis 'res',
+``--xla_force_host_platform_device_count``" by hand, and a drift between
+them would mean the analyzer blesses shardings the runtime never uses.
+
+IMPORT CONSTRAINT: stdlib only.  tests/conftest.py loads this file by
+path BEFORE jax is imported (the env mutation must precede backend
+init), so nothing here may import jax or any sentinel_tpu module.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import MutableMapping, Optional
+
+#: the resource/node-row mesh axis every sharded tensor splits on
+MESH_AXIS = "res"
+
+#: blessed virtual-mesh width: the dry-run, the tier-4 analyzer, and the
+#: test suite all force this many CPU devices (a v5e-8 tray's shape)
+MESH_DEVICES = 8
+
+_FORCE_FLAG = "xla_force_host_platform_device_count"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Shape of the blessed device mesh (1-D over the resource axis)."""
+
+    n_devices: int = MESH_DEVICES
+    axis: str = MESH_AXIS
+
+
+def mesh_spec() -> MeshSpec:
+    """The single source of truth consumed by runtime and analyzer."""
+    return MeshSpec()
+
+
+def force_cpu_mesh_env(
+    environ: MutableMapping[str, str],
+    n_devices: Optional[int] = None,
+    keep_existing_count: bool = False,
+) -> int:
+    """Mutate ``environ`` so JAX boots a virtual n-device CPU platform.
+
+    Must run before the target process initializes its jax backends
+    (XLA_FLAGS and JAX_PLATFORMS are read at backend init).  With
+    ``keep_existing_count`` a device count already forced in XLA_FLAGS
+    wins (the conftest contract: a caller who pre-forced a topology gets
+    to keep it); otherwise any prior forcing is stripped and replaced.
+    Returns the device count actually in effect.
+    """
+    n = n_devices if n_devices is not None else mesh_spec().n_devices
+    environ["JAX_PLATFORMS"] = "cpu"
+    flags = environ.get("XLA_FLAGS", "").split()
+    if keep_existing_count:
+        for f in flags:
+            if _FORCE_FLAG in f:
+                _, _, v = f.partition("=")
+                try:
+                    return int(v)
+                except ValueError:
+                    break  # malformed: fall through and replace it
+    flags = [f for f in flags if _FORCE_FLAG not in f]
+    flags.append(f"--{_FORCE_FLAG}={n}")
+    environ["XLA_FLAGS"] = " ".join(flags)
+    return n

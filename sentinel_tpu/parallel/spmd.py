@@ -17,6 +17,13 @@ batch and verdict tensors ride ICI once per tick.
 Controller/rule-slot state (per-rule tensors) is replicated: it is small
 (O(rules)) and every chip derives identical updates from the replicated
 batch, so no communication is needed for it.
+
+The layout is declared twice over: MESH-FREE ``PartitionSpec`` pytrees
+(``state_partition_specs`` and friends — what the tier-4 SPMD analyzer
+consumes, no devices needed) and their ``NamedSharding`` bindings to a
+live mesh (``state_shardings``).  The mesh shape itself comes from
+``meshspec.mesh_spec()`` — the same source the dry-run, the analyzer
+subprocess, and the test conftest force their virtual topology from.
 """
 
 from __future__ import annotations
@@ -31,56 +38,82 @@ from sentinel_tpu.core.config import EngineConfig
 from sentinel_tpu.ops import engine as E
 from sentinel_tpu.ops import gsketch as GS
 from sentinel_tpu.ops import rtq as RQ
+from sentinel_tpu.ops import token_col as TC
 from sentinel_tpu.ops import window as W
+from sentinel_tpu.parallel.meshspec import mesh_spec
 
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()[: n_devices or len(jax.devices())]
-    return Mesh(np.asarray(devices), axis_names=("res",))
+    return Mesh(np.asarray(devices), axis_names=(mesh_spec().axis,))
 
 
-def _sketch_shardings(cfg: EngineConfig, mesh: Mesh, rep):
-    """Sharding pytree for EngineState.gs, per the live sketch impl."""
+# -- mesh-free PartitionSpec pytrees ----------------------------------------
+#
+# These are the BLESSED shardings: pure data, no jax devices touched.
+# The tier-4 analyzer (analysis/spmd) folds them with eval_shape'd leaf
+# shapes to project per-shard bytes and check axis divisibility without
+# a mesh; state_shardings() below binds the same specs to a live mesh,
+# so runtime and analyzer cannot drift.
+
+
+def window_partition_specs(rows_sharded: bool = True) -> W.WindowState:
+    """PartitionSpec pytree for one WindowState: bucket/running tensors
+    split on their row axis, epoch/rotation scalars replicated."""
+    axis = mesh_spec().axis
+    r = PS(axis) if rows_sharded else PS()
+    rep = PS()
+    # the O(1) running sums are row-indexed like the bucket tensors,
+    # so they shard on the same axis; epoch/rotation scalars replicate
+    return W.WindowState(
+        counts=r, rt_sum=r, rt_min=r, epochs=rep,
+        run=r, run_rt=r, run_rt_min=r, rot_wid=rep,
+    )
+
+
+def token_col_partition_specs() -> TC.TokenColState:
+    """PartitionSpec pytree for the cluster token-column ledger: flow
+    slots are the scale-out axis (one row per flow), limits ride along."""
+    axis = mesh_spec().axis
+    return TC.TokenColState(
+        win=window_partition_specs(rows_sharded=True),
+        limits=PS(axis),
+    )
+
+
+def _sketch_partition_specs(cfg: EngineConfig):
+    """PartitionSpec pytree for EngineState.gs, per the live sketch impl."""
+    axis = mesh_spec().axis
+    rep = PS()
     if not cfg.sketch_stats:
         return GS.SketchState(counts=rep, epochs=rep)
     if cfg.sketch_salsa:
         from sentinel_tpu.sketch import salsa as SA
 
         return SA.SalsaState(
-            words=NamedSharding(mesh, PS(None, None, None, "res")),
-            lvlmap=NamedSharding(mesh, PS(None, None, None, "res")),
-            run=NamedSharding(mesh, PS(None, None, "res")),
+            words=PS(None, None, None, axis),
+            lvlmap=PS(None, None, None, axis),
+            run=PS(None, None, axis),
             epochs=rep,
             rot_wid=rep,
             # the unpacked current bucket shards on width like run
-            cur=NamedSharding(mesh, PS(None, None, "res")),
+            cur=PS(None, None, axis),
             cur_wid=rep,
         )
-    return GS.SketchState(
-        counts=NamedSharding(mesh, PS(None, None, "res", None)),
-        epochs=rep,
-    )
+    return GS.SketchState(counts=PS(None, None, axis, None), epochs=rep)
 
 
-def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
-    """Sharding pytree matching EngineState: node-row tensors split on
-    'res', per-rule tensors replicated."""
-    row = NamedSharding(mesh, PS("res"))
-    rep = NamedSharding(mesh, PS())
-
-    def win(ws_rows_sharded: bool) -> W.WindowState:
-        r = row if ws_rows_sharded else rep
-        # the O(1) running sums are row-indexed like the bucket tensors,
-        # so they shard on the same axis; epoch/rotation scalars replicate
-        return W.WindowState(
-            counts=r, rt_sum=r, rt_min=r, epochs=rep,
-            run=r, run_rt=r, run_rt_min=r, rot_wid=rep,
-        )
+def state_partition_specs(cfg: EngineConfig) -> E.EngineState:
+    """PartitionSpec pytree matching EngineState: node-row tensors split
+    on the mesh axis, per-rule tensors replicated."""
+    axis = mesh_spec().axis
+    row = PS(axis)
+    rep = PS()
 
     return E.EngineState(
-        win_sec=win(True),
-        win_min=win(cfg.enable_minute_window),
+        win_sec=window_partition_specs(True),
+        win_min=window_partition_specs(cfg.enable_minute_window),
         concurrency=row,
         latest_passed_ms=rep,
         warmup_tokens=rep,
@@ -92,20 +125,44 @@ def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
         cb_retry_ms=rep,
         cb_counts=rep,
         cb_epochs=rep,
-        # the hashed param store shards on its row axis (pcms [depth, Q, nb],
-        # pconc [depth, Q]) — per-(rule,value) budgets scale with chips
-        pcms=NamedSharding(mesh, PS(None, "res", None)),
+        # the hashed param store is REPLICATED, deliberately: the tier-4
+        # SPMD analyzer's collective ledger measured the width-sharded
+        # layout paying four partial-result all-reduces per tick
+        # (s32[2B] x2 + s32[2B,P] x2 — the param scatter/read computing
+        # per-shard partials and reducing them across the mesh; ~5 KiB
+        # per tick at CI scale, scaling with batch x depth x planes).
+        # The store is small (single-digit MiB even at the 1M-resource
+        # config) next to the row tables, so replication costs little
+        # HBM and removes those collectives entirely.  Re-shard only
+        # together with a shard-local param kernel, and re-pin
+        # analysis/spmd/collectives.json when you do.
+        pcms=rep,
         pcms_epochs=rep,
-        pconc=NamedSharding(mesh, PS(None, "res")),
+        pconc=rep,
         # the global sketch shards on its width axis so tail-resource
         # observability scales with chips; with the sketch off the state
         # is a unit dummy — replicate it.  The salsa tier (sketch/salsa)
         # shards its packed words/bitmap on the word axis and the running
         # sums on the logical width axis — all width-aligned, so the
         # shards stay co-local with the seed layout's
-        gs=_sketch_shardings(cfg, mesh, rep),
+        gs=_sketch_partition_specs(cfg),
         rtq=RQ.RtqState(counts=rep, epochs=rep),
     )
+
+
+def bind_shardings(specs, mesh: Mesh):
+    """PartitionSpec pytree -> NamedSharding pytree on ``mesh``."""
+    return jax.tree.map(
+        lambda ps: NamedSharding(mesh, ps),
+        specs,
+        is_leaf=lambda x: isinstance(x, PS),
+    )
+
+
+def state_shardings(cfg: EngineConfig, mesh: Mesh) -> E.EngineState:
+    """Sharding pytree matching EngineState (the blessed specs bound to
+    a live mesh)."""
+    return bind_shardings(state_partition_specs(cfg), mesh)
 
 
 def shard_state(state: E.EngineState, cfg: EngineConfig, mesh: Mesh) -> E.EngineState:

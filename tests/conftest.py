@@ -10,14 +10,32 @@ config.  Backends are still uninitialized at conftest-import time, so the
 override takes effect for every test.
 """
 
+import importlib.util
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# The mesh width/axis and the env recipe come from ONE shared helper
+# (sentinel_tpu/parallel/meshspec.py — also consumed by parallel/spmd.py,
+# the __graft_entry__ dry-run, and the tier-4 SPMD analyzer subprocess).
+# Loaded by FILE PATH: importing the sentinel_tpu package here would pull
+# jax in before the env mutation below, defeating the whole point.
+_ms_spec = importlib.util.spec_from_file_location(
+    "_sentinel_meshspec",
+    os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir,
+        "sentinel_tpu",
+        "parallel",
+        "meshspec.py",
+    ),
+)
+_meshspec = importlib.util.module_from_spec(_ms_spec)
+# registered so @dataclass can resolve the defining module at class
+# creation (dataclasses looks the module up in sys.modules)
+sys.modules[_ms_spec.name] = _meshspec
+_ms_spec.loader.exec_module(_meshspec)
+# keep_existing_count: a caller who pre-forced a topology keeps it
+_meshspec.force_cpu_mesh_env(os.environ, keep_existing_count=True)
 
 import jax  # noqa: E402
 
