@@ -1140,12 +1140,9 @@ def sketch_tier_bench(B: int = 2048, n_ticks: int = 12) -> dict:
 
 def _window_op_rate(
     rows: int,
-    B: int,
+    op,
     n_ticks: int,
     mode: str,
-    slack_frac: float = 0.0,
-    sample_count: int = 10,
-    window_ms: int = 100,
     step_ms: int = 37,
     span: str = "",
     repeats: int = 3,
@@ -1154,6 +1151,11 @@ def _window_op_rate(
     engine tick pays every tick: an ``add_batch`` (scatter write + the
     rotation it triggers) plus the two reads every tick consumes — the
     per-entry [B] gather and the fleet-wide [rows] flow sum.
+
+    ``op`` is a shared ``workload.OperatingPoint`` (the BENCH_WINDOW_*
+    presets) carrying the batch and window-shape knobs that used to be
+    hard-coded per bench row — the tuner, the simulator preset and
+    these rows now read ONE definition.
 
     ``mode="masked"`` is the pre-r14 read shape (epoch-masked reductions
     over the bucket axis on every read, O(rows*nb) per tick);
@@ -1166,8 +1168,11 @@ def _window_op_rate(
     from sentinel_tpu import obs
     from sentinel_tpu.ops import window as W
 
+    B = op.batch_size
     cfg = W.WindowConfig(
-        sample_count=sample_count, window_ms=window_ms, slack_frac=slack_frac
+        sample_count=op.sketch_sample_count,
+        window_ms=op.sketch_window_ms,
+        slack_frac=op.sketch_slack_frac,
     )
     rng = np.random.default_rng(11)
     slots = jnp.asarray(rng.integers(0, rows, B), jnp.int32)
@@ -1228,20 +1233,33 @@ def window_compare_bench(rows: int = 16384, B: int = 4096, n_ticks: int = 240) -
 
     from sentinel_tpu import obs
 
+    from sentinel_tpu.workload.operating_point import (
+        BENCH_WINDOW_EXACT,
+        BENCH_WINDOW_MINUTE,
+        BENCH_WINDOW_MINUTE_SLACK,
+    )
+
+    # the shared operating-point presets, re-batched to this run's B —
+    # no more per-row literal knobs (they lived here pre-r19)
+    op_exact = BENCH_WINDOW_EXACT.replace(batch_size=B, complete_batch_size=B)
+    op_minute = BENCH_WINDOW_MINUTE.replace(batch_size=B, complete_batch_size=B)
+    op_slack = BENCH_WINDOW_MINUTE_SLACK.replace(
+        batch_size=B, complete_batch_size=B
+    )
     obs.TRACER.reset()
     obs.enable()
-    dps_before = _window_op_rate(rows, B, n_ticks, "masked")
-    dps_after = _window_op_rate(rows, B, n_ticks, "run")
+    dps_before = _window_op_rate(rows, op_exact, n_ticks, "masked")
+    dps_after = _window_op_rate(rows, op_exact, n_ticks, "run")
     rot_exact = _window_op_rate(
-        rows, B, n_ticks, "run",
-        sample_count=60, window_ms=1000, step_ms=1000, span="rotate_exact",
+        rows, op_minute, n_ticks, "run", step_ms=1000, span="rotate_exact",
     )
     rot_slack = _window_op_rate(
-        rows, B, n_ticks, "run", slack_frac=0.05,
-        sample_count=60, window_ms=1000, step_ms=1000, span="rotate_slack",
+        rows, op_slack, n_ticks, "run", step_ms=1000, span="rotate_slack",
     )
     obs.disable()
-    g = max(1, math.ceil(0.05 * 60))
+    g = max(
+        1, math.ceil(op_slack.sketch_slack_frac * op_slack.sketch_sample_count)
+    )
     rotations = -(-n_ticks // g)  # ceil: the cond purge fires every g-th
 
     def _row(dps: float, **extra) -> dict:
@@ -1472,6 +1490,15 @@ DEFAULT_TOLERANCES = {
     # rotating sketch-accuracy audit vs the identical ambient client —
     # the plane must stay always-on-cheap, so the ceiling is absolute
     "profile_overhead_pct": {"max_abs": 2.0},
+    # closed-loop autotuner (PR 19): the tuned run's whole-run SLO-bad
+    # fraction over the static default's on the seeded flash-crowd shape
+    # — virtual-time arithmetic, so the ratio is DETERMINISTIC and the
+    # ceiling is tight: a tuner that stops converging (ratio → 1.0)
+    # fails CI.  Surprise retraces during tuning are an exact invariant.
+    "workload_smoke_bad_frac_ratio": {"max_abs": 0.75},
+    "workload_smoke_surprise_retraces": {"max_abs": 0.0},
+    # wall-clock drive at the converged point — noisy, loose floor only
+    "workload_smoke_dps": {"min_ratio": 0.3},
 }
 
 
@@ -1582,7 +1609,12 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
     sk_err_pct = _sketch_estimate_err_pct()
     # the exact-tier window op through the O(1) running-sum path — the
     # r14 floor (the full before/after row lives in --window-compare)
-    window_op_dps = _window_op_rate(8192, B, 60, "run")
+    from sentinel_tpu.workload.operating_point import BENCH_WINDOW_EXACT
+
+    window_op_dps = _window_op_rate(
+        8192, BENCH_WINDOW_EXACT.replace(batch_size=B, complete_batch_size=B),
+        60, "run",
+    )
 
     # client path: public bulk API on a sync client (one process, CPU)
     c = SentinelClient(cfg=small_engine_config(batch_size=1024), mode="sync")
@@ -1645,6 +1677,7 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             "wire_bytes_per_tick_tx": round(wire_tx),
             "profile_overhead_pct": round(_profile_overhead_pct(), 2),
             **_cluster_smoke_metrics(),
+            **_workload_smoke_metrics(),
         },
         "batch": B,
         "platform": jax.devices()[0].platform,
@@ -1784,6 +1817,111 @@ def wire_compare_bench(B: int = 4096, n_blocks: int = 48) -> dict:
             pk[phase]["dps"] / max(cl[phase]["dps"], 1), 3
         )
     return rows
+
+
+# -- workload engine + closed-loop autotuner (--workload + BENCH_r19) --------
+
+
+def workload_bench(steps: int = 300, seed: int = 7, small: bool = False) -> dict:
+    """BENCH_r19: the closed-loop autotuner against the static seed
+    default on the seeded flash-crowd-at-2× shape (workload/).
+
+    Three runs of the SAME offered stream through a real sync client on
+    virtual time: (1) static at the seed-default operating point, (2)
+    tuned — the autotuner walks its candidate grid live against the
+    ``workload_latency`` SLO-burn objective, guarded by the PR-15
+    instruments, (3) a wall-clock drive at the converged point for dps.
+    The burn comparison is virtual-time arithmetic — deterministic and
+    CPU-reproducible; only the dps row is wall-clock."""
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.obs import profile as PROF
+    from sentinel_tpu.runtime.client import SentinelClient
+    from sentinel_tpu.utils.time_source import VirtualTimeSource
+    import sentinel_tpu.workload as WL
+
+    def mk(op):
+        c = SentinelClient(
+            cfg=op.apply_to_config(small_engine_config()),
+            time_source=VirtualTimeSource(start_ms=1_000),
+            mode="sync",
+            pipeline_depth=op.pipeline_depth,
+        )
+        c.start()
+        return c
+
+    spec = WL.flash_crowd_2x(seed=seed, steps=steps)
+    op0 = WL.sim_default_op()
+    cands = [
+        op0.replace(batch_size=16, complete_batch_size=16),
+        op0.replace(batch_size=8, complete_batch_size=8),
+    ]
+    if not small:
+        cands += [
+            op0.replace(batch_size=16, complete_batch_size=16, pipeline_depth=2),
+            op0.replace(audit_period=8),
+            op0.replace(pipeline_depth=2),
+        ]
+
+    c = mk(op0)
+    static = WL.run_closed_loop(c, spec, op0, tune=False)
+    c.stop()
+    surprises0 = PROF.RETRACE.surprise_count()
+    c = mk(op0)
+    tuned = WL.run_closed_loop(c, spec, op0, cands, tune=True)
+    c.stop()
+    surprises = PROF.RETRACE.surprise_count() - surprises0
+
+    # wall-clock decisions/s through the driven client path AT the
+    # converged point (fresh client so compile cost stays off the clock
+    # for neither side — both pay first-tick compiles in the drive)
+    conv = tuned.converged_op
+    c = mk(conv)
+    gen = WL.TrafficGenerator(spec, start_ms=c.time.now_ms())
+    t0 = time.perf_counter()
+    drive = WL.drive_client(c, gen)
+    wall = time.perf_counter() - t0
+    c.stop()
+
+    sb, tb = static.bad_frac(), tuned.bad_frac()
+    return {
+        "shape": "flash_crowd_2x",
+        "seed": seed,
+        "steps": steps,
+        "static_op": op0.describe(),
+        "converged_op": conv.describe(),
+        "candidates": len(cands),
+        "decisions": tuned.decisions,
+        "static_bad_frac": round(sb, 4),
+        "tuned_bad_frac": round(tb, 4),
+        "bad_frac_ratio_tuned_over_static": round(tb / max(sb, 1e-9), 4),
+        "static_p99_ms": round(static.p99_ms(), 2),
+        "tuned_p99_ms": round(tuned.p99_ms(), 2),
+        "final_burn_static": round(static.objective_burn, 4),
+        "final_burn_tuned": round(tuned.objective_burn, 4),
+        "surprise_retraces_during_tuning": surprises,
+        "converged_dps": round(drive.submitted / max(wall, 1e-9)),
+        "platform": _platform_name(),
+    }
+
+
+def _platform_name() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _workload_smoke_metrics(steps: int = 160, seed: int = 7) -> dict:
+    """Autotuner convergence sentry: the seeded flash-crowd loop must
+    keep converging to a lower-SLO-burn point than the static default
+    (the bad-frac ratio is virtual-time arithmetic — deterministic), and
+    the driven client path at the converged point must hold wall-clock
+    throughput."""
+    row = workload_bench(steps=steps, seed=seed, small=True)
+    return {
+        "workload_smoke_bad_frac_ratio": row["bad_frac_ratio_tuned_over_static"],
+        "workload_smoke_surprise_retraces": row["surprise_retraces_during_tuning"],
+        "workload_smoke_dps": row["converged_dps"],
+    }
 
 
 def compare_to_baseline(measured: dict, baseline: dict) -> list:
@@ -2087,5 +2225,18 @@ if __name__ == "__main__":
         # the adaptive row alone (engine-time pure — CPU-reproducible;
         # how BENCH_r07 captured it)
         print(json.dumps({"adaptive_overload": adaptive_overload_bench()}))
+    elif "--workload" in sys.argv:
+        # the closed-loop autotuner row (PR 19): converged-vs-static SLO
+        # burn on the seeded flash-crowd shape + dps at the converged
+        # point (burn math is virtual-time pure — CPU-reproducible);
+        # writes BENCH_r19.json
+        doc = {"workload": workload_bench()}
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r19.json"
+        )
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(json.dumps({"workload": doc["workload"], "written": path}))
     else:
         main()

@@ -26,16 +26,26 @@ from typing import List, Optional
 import numpy as np
 
 
-def storm_controller_preset():
+def storm_controller_preset(op=None):
     """Controller tuning for the simulator's scales, shared by BOTH
     consumers (the ``overload_storm`` chaos scenario and the
     ``adaptive_overload`` bench row) so the invariant-gated experiment
     and the published BENCH numbers can never desynchronize: host-CPU
     input disabled (a busy CI box must not steer the ladder), blocking
     pressure on (the sim's overload shows up as sustained shedding),
-    engine-time holds sized to the 10 ms step."""
+    engine-time holds sized to the 10 ms step.
+
+    ``op`` is the serving ``workload.OperatingPoint`` (default
+    ``sim_default_op()``): the admission queue bound follows its
+    pipeline depth, so the preset can never drift from the point the
+    tuner/bench actually run — the same shared definition bench rows
+    consume."""
     from sentinel_tpu.adaptive.controller import AdaptiveConfig
 
+    if op is None:
+        from sentinel_tpu.workload.operating_point import sim_default_op
+
+        op = sim_default_op()
     return AdaptiveConfig(
         rt_tolerance=3.0,
         cpu_high=2.0,
@@ -43,7 +53,7 @@ def storm_controller_preset():
         climb_hold_ms=50,
         cool_hold_ms=300,
         block_pressure_ratio=1.0,
-        queue_max=0,
+        queue_max=int(op.pipeline_depth),
     )
 
 
@@ -94,16 +104,29 @@ def run_overload_sim(
     base_svc_steps: int = 2,
     prio_every: int = 2,
     resource: str = "storm/api",
+    op=None,
 ) -> SimResult:
-    """One full healthy→storm→recover run; see module docstring."""
+    """One full healthy→storm→recover run; see module docstring.
+
+    ``op`` (a ``workload.OperatingPoint``, default ``sim_default_op()``
+    — identity against the small config, so seeded goldens are
+    unchanged) decides the client's engine config and pipeline depth:
+    the one shared operating-point definition."""
     from sentinel_tpu.core.config import small_engine_config
     from sentinel_tpu.core import errors as ERR
     from sentinel_tpu.runtime.client import SentinelClient
     from sentinel_tpu.utils.time_source import VirtualTimeSource
 
+    if op is None:
+        from sentinel_tpu.workload.operating_point import sim_default_op
+
+        op = sim_default_op()
     vt = VirtualTimeSource(start_ms=1_000)
     client = SentinelClient(
-        cfg=small_engine_config(), time_source=vt, mode="sync"
+        cfg=op.apply_to_config(small_engine_config()),
+        time_source=vt,
+        mode="sync",
+        pipeline_depth=op.pipeline_depth,
     )
     client.start()
     rid = client.registry.resource_id(resource)

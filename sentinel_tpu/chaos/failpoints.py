@@ -56,7 +56,7 @@ _SITE_RE = re.compile(
 )
 _LAYERS = (
     "transport", "cluster", "runtime", "parallel", "datasource", "obs",
-    "sketch",
+    "sketch", "workload",
 )
 
 #: actions a call style supports: ``hit`` sites can only raise or stall,

@@ -44,6 +44,11 @@ Scenarios (the acceptance set):
                       heals and enforces exactly; a second window proves
                       the profiling plane (shadow audit + deep capture)
                       fails OPEN with exact counter accounting
+  tuner_fail_open     workload autotuner faults: a quiet closed loop
+                      retunes the operating point live (expected
+                      retraces only), then raising tuner steps fail
+                      OPEN to the last-good point and dropped generator
+                      emissions are counted exactly
 """
 
 from __future__ import annotations
@@ -1405,6 +1410,149 @@ def _scn_hotset_promote_fail(seed: int) -> ScenarioResult:
     return _result("hotset_promote_fail", seed, session, verdicts, t0)
 
 
+def _scn_tuner_fail_open(seed: int) -> ScenarioResult:
+    """Workload autotuner chaos (workload/tuner.py + generator.py).
+
+    Phase 1 (quiet): a seeded flash-crowd closed loop retunes the live
+    operating point at least once — expected retraces only, HBM breach
+    counter flat.  Phase 2 (armed): ``workload.tuner.step`` raises on a
+    hit-index burst and ``workload.gen.emit`` drops seeded generator
+    steps.  A raising tuner step must fail OPEN — serving verdicts
+    untouched (accounting stays exact), the point rolled back to
+    last-good, failures counted exactly in
+    ``sentinel_tuner_step_failures_total`` — and dropped emissions land
+    only in ``sentinel_workload_emit_drops_total`` (never offered, so
+    verdict accounting is green by construction).  All injected counts
+    are hit-index/max_fires gated on single-threaded sites: seed-pure."""
+    from sentinel_tpu.obs import profile as PROF
+    from sentinel_tpu.workload import (
+        TunerConfig,
+        flash_crowd_2x,
+        run_closed_loop,
+        sim_default_op,
+    )
+
+    t0 = mono_s()
+    metrics = MetricsDelta()
+    session = _Session()
+    surprises0 = PROF.RETRACE.surprise_count()
+    client = _make_client()
+    op0 = sim_default_op()
+    cands = [
+        op0.replace(batch_size=16, complete_batch_size=16),
+        op0.replace(batch_size=8, complete_batch_size=8),
+    ]
+    tcfg = TunerConfig(settle_steps=3, warmup_steps=1)
+    extra = {}
+    try:
+        # -- phase 1: quiet closed loop — the tuner must actually move --
+        quiet = run_closed_loop(
+            client,
+            flash_crowd_2x(seed=seed, base=3.0, steps=60, start_step=10),
+            op0,
+            cands,
+            tune=True,
+            tune_every=4,
+            tcfg=tcfg,
+        )
+        extra["retuned_live"] = any(
+            d["action"] == "applied" for d in quiet.decisions
+        )
+        # -- phase 2: armed window -------------------------------------
+        tuner_fires, emit_fires = 2, 2
+        plan = FaultPlan(
+            name="tuner_fail_open",
+            seed=seed,
+            faults=[
+                FaultSpec(
+                    "workload.tuner.step", "raise",
+                    burst_start=1, burst_len=tuner_fires,
+                    exc="RuntimeError",
+                ),
+                FaultSpec(
+                    "workload.gen.emit", "raise",
+                    every_nth=7, max_fires=emit_fires, exc="RuntimeError",
+                ),
+            ],
+        )
+        with session.window(plan):
+            armed = run_closed_loop(
+                client,
+                flash_crowd_2x(
+                    seed=seed + 1, base=3.0, steps=40, start_step=8
+                ),
+                op0.replace(
+                    batch_size=client.cfg.batch_size,
+                    complete_batch_size=client.cfg.complete_batch_size,
+                ),
+                cands,
+                tune=True,
+                tune_every=4,
+                tcfg=tcfg,
+            )
+        fail_opens = [
+            d for d in armed.decisions if d["action"] == "fail_open"
+        ]
+        best = armed.converged_op
+        extra["fail_open_exact"] = len(fail_opens) == tuner_fires
+        # fail-open target: the engine must END the armed phase ON the
+        # tuner's last-good point, not stranded on a mid-walk candidate
+        extra["on_last_good"] = (
+            client.cfg.batch_size == best.batch_size
+            and client.cfg.complete_batch_size == best.complete_batch_size
+        )
+        extra["zero_surprise_retraces"] = (
+            PROF.RETRACE.surprise_count() == surprises0
+        )
+        submitted = quiet.submitted + armed.submitted
+        passed = quiet.passed + armed.passed
+        blocked = quiet.blocked + armed.blocked
+    finally:
+        client.stop()
+    extra["expect_metric_deltas"] = {
+        "sentinel_tuner_step_failures_total": float(tuner_fires),
+        "sentinel_workload_emit_drops_total": float(emit_fires),
+        # retuning must never trade latency for capacity headroom
+        "sentinel_hbm_capacity_breaches_total": 0.0,
+    }
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=client,
+        submitted=submitted,
+        passed=passed,
+        blocked=blocked,
+        injected=session.injected,
+        expect_injected={
+            "workload.tuner.step:raise": tuner_fires,
+            "workload.gen.emit:raise": emit_fires,
+        },
+        extra=extra,
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "pipeline-drained",
+            "injected-as-planned",
+            "metric-deltas",
+        ],
+        ctx,
+    )
+    for nm, key, detail in (
+        ("retuned-live", "retuned_live",
+         "the quiet phase must apply at least one live retune"),
+        ("fail-open-exact", "fail_open_exact",
+         "each injected tuner-step raise must journal exactly one "
+         "fail-open decision"),
+        ("fail-open-to-last-good", "on_last_good",
+         "after the armed window the engine must sit on the tuner's "
+         "last-good operating point"),
+        ("zero-surprise-retraces", "zero_surprise_retraces",
+         "every retune recompile must journal an expected_retrace cause"),
+    ):
+        verdicts.append(Verdict(nm, bool(extra.get(key)), detail))
+    return _result("tuner_fail_open", seed, session, verdicts, t0)
+
+
 def _result(name, seed, session, verdicts, t0) -> ScenarioResult:
     return ScenarioResult(
         name=name,
@@ -1480,6 +1628,13 @@ SCENARIOS: Dict[str, Scenario] = {
             _scn_hotset_promote_fail,
             "hot-set promotion + profiling-plane faults: stats/audit/capture "
             "fail open, tail verdicts fail closed",
+        ),
+        Scenario(
+            "tuner_fail_open",
+            _scn_tuner_fail_open,
+            "workload autotuner faults: raising steps fail OPEN to the "
+            "last-good operating point, dropped emissions counted exactly",
+            eager=True,
         ),
     )
 }

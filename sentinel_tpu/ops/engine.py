@@ -2805,12 +2805,19 @@ def migrate_state(
     which the reference handles by resetting node metrics; here the
     current windowed totals MIGRATE so admission budgets don't reopen).
 
-    Only window shapes may differ (second/minute sample counts + lengths);
-    capacity knobs must match — the caller (SentinelClient.
-    update_window_shape) guarantees it.  Sliding detail below bucket
-    granularity is coarsened: the old window's TOTALS land in the new
-    shape's current bucket, so the new window initially sees the whole old
-    window (budgets stay conservative) and decays after one new interval.
+    Only OPERATING-POINT knobs may differ: window shapes (second/minute
+    sample counts + lengths), batch shapes (``batch_size`` /
+    ``complete_batch_size`` — safe because no ``init_state`` leaf is
+    batch-shaped; only the traced tick signature changes) and the sketch
+    window shape (``sketch_sample_count`` / ``sketch_window_ms`` /
+    ``sketch_slack_frac`` — gs restarts fresh below when its grid
+    changes, the same dashboard-only transient a window reshape has).
+    Capacity knobs must match — the callers (SentinelClient.
+    update_window_shape / apply_operating_point) guarantee it.  Sliding
+    detail below bucket granularity is coarsened: the old window's
+    TOTALS land in the new shape's current bucket, so the new window
+    initially sees the whole old window (budgets stay conservative) and
+    decays after one new interval.
 
     gs/rtq observability re-initializes when their bucket grid changes —
     a transient visible only to dashboards, never to rule checks."""
@@ -2822,9 +2829,17 @@ def migrate_state(
         second_window_ms=new_cfg.second_window_ms,
         minute_sample_count=new_cfg.minute_sample_count,
         minute_window_ms=new_cfg.minute_window_ms,
+        batch_size=new_cfg.batch_size,
+        complete_batch_size=new_cfg.complete_batch_size,
+        sketch_sample_count=new_cfg.sketch_sample_count,
+        sketch_window_ms=new_cfg.sketch_window_ms,
+        sketch_slack_frac=new_cfg.sketch_slack_frac,
     )
     if same_caps != new_cfg:
-        raise ValueError("migrate_state only supports window-shape changes")
+        raise ValueError(
+            "migrate_state only supports operating-point changes "
+            "(window/batch/sketch shapes)"
+        )
 
     now = jnp.int32(now_ms)
     out = init_state(new_cfg)

@@ -2543,10 +2543,18 @@ class SentinelClient:
         new_cfg = dataclasses.replace(self.cfg, **changes)
         if new_cfg == self.cfg:
             return
-        _h = OT.TRACER.begin("client.window_reshape", **changes)
+        self._swap_engine(new_cfg, "window-reshape", **changes)
+
+    def _swap_engine(self, new_cfg, cause: str, **span_attrs) -> None:
+        """Compile-then-swap the engine onto ``new_cfg`` LIVE: compile +
+        warm the new tick while the old engine keeps serving, then
+        migrate state under the engine lock.  Every caller's recompile
+        journals as an EXPECTED retrace under ``cause`` — a tuning or
+        reshaping session must keep the surprise-retrace count flat."""
+        _h = OT.TRACER.begin("client.engine_swap", cause=cause, **span_attrs)
         try:
             with PROF.ledger_owner(self._ledger_name), \
-                    PROF.expected_retrace("window-reshape"):
+                    PROF.expected_retrace(cause):
                 new_tick = E.make_tick(
                     new_cfg, donate=True, features=self._features
                 )
@@ -2587,6 +2595,40 @@ class SentinelClient:
             self._recompile_rules()
         finally:
             OT.TRACER.end(_h)
+
+    def apply_operating_point(self, op, cause: str = "tuner-retune") -> dict:
+        """Apply a ``workload.OperatingPoint`` LIVE — the autotuner's
+        actuator.  Host-only knobs (pipeline depth, audit cadence) are
+        plain attribute writes with no compiled-program impact; engine
+        knobs (batch/sketch shapes) ride the same compile-then-swap path
+        as ``update_window_shape``, journaled as one expected retrace
+        under ``cause``.  ``op`` is duck-typed (``engine_changes`` +
+        the knob attributes) so runtime never imports workload.
+
+        Returns ``{"engine": bool, "host": [knob, ...]}`` describing
+        what actually changed (an identity apply returns all-empty)."""
+        import dataclasses
+
+        applied = {"engine": False, "host": []}
+        depth = getattr(op, "pipeline_depth", None)
+        if depth is not None and int(depth) != self._pipeline_depth:
+            self._pipeline_depth = max(0, int(depth))
+            applied["host"].append("pipeline_depth")
+        period = getattr(op, "audit_period", None)
+        if (
+            period is not None
+            and self._audit is not None
+            and max(1, int(period)) != self._audit.period
+        ):
+            self._audit.period = max(1, int(period))
+            applied["host"].append("audit_period")
+        changes = op.engine_changes(self.cfg)
+        if changes:
+            self._swap_engine(
+                dataclasses.replace(self.cfg, **changes), cause, **changes
+            )
+            applied["engine"] = True
+        return applied
 
     def register_window_property(self, prop) -> None:
         """Subscribe window shape to a SentinelProperty pushing dicts like

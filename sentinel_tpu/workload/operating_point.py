@@ -1,0 +1,121 @@
+"""The serving operating point as ONE shared frozen dataclass.
+
+Before this module the knobs that decide how the engine is driven —
+batch size, pipeline depth, sketch window shape, `slack_frac`, audit
+cadence — lived as hard-coded per-row literals in `bench.py` and as
+scattered `SentinelClient` constructor arguments, so the benchmarked
+point and the served point could silently drift.  `OperatingPoint` is
+the single definition all three consumers share:
+
+* **bench rows** (`bench.py` `_window_op_rate` / `workload_bench`) take
+  an `OperatingPoint` instead of loose keyword literals;
+* **the autotuner** (`workload/tuner.py`) explores a candidate grid of
+  `OperatingPoint`s and applies the winner LIVE via
+  `SentinelClient.apply_operating_point`;
+* **the overload simulator preset** (`adaptive/simload.
+  storm_controller_preset`) derives its queue bound from the same
+  point, so the chaos scenario and the bench row can never
+  desynchronize from the tuner's world.
+
+Engine-compiled knobs (batch/sketch shape) are separated from host-only
+knobs (pipeline depth, audit cadence) because applying them has very
+different costs: the former require an `expected_retrace`-journaled
+recompile + state migration, the latter are a plain attribute write.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+#: EngineConfig fields an OperatingPoint owns — exactly the knobs a
+#: LIVE retune may change (see ops/engine.migrate_state's contract).
+ENGINE_FIELDS: Tuple[str, ...] = (
+    "batch_size",
+    "complete_batch_size",
+    "sketch_sample_count",
+    "sketch_window_ms",
+    "sketch_slack_frac",
+)
+
+
+@dataclass(frozen=True)
+class OperatingPoint:
+    """One serving configuration the tuner/bench/simulator agree on."""
+
+    # engine-compiled knobs (changing any = one expected retrace)
+    batch_size: int = 2048
+    complete_batch_size: int = 2048
+    sketch_sample_count: int = 0  # 0 inherits the second window shape
+    sketch_window_ms: int = 0
+    sketch_slack_frac: float = 0.05
+    # host-only knobs (applied without touching the traced program)
+    pipeline_depth: int = 0
+    audit_period: int = 16
+
+    @classmethod
+    def from_engine_config(
+        cls, cfg: Any, pipeline_depth: int = 0, audit_period: int = 16
+    ) -> "OperatingPoint":
+        """The point a config already runs at (identity apply)."""
+        return cls(
+            pipeline_depth=int(pipeline_depth),
+            audit_period=int(audit_period),
+            **{f: getattr(cfg, f) for f in ENGINE_FIELDS},
+        )
+
+    def engine_changes(self, cfg: Any) -> Dict[str, Any]:
+        """The EngineConfig field replacements this point requires on
+        top of ``cfg`` — empty when the compiled program can stay."""
+        return {
+            f: getattr(self, f)
+            for f in ENGINE_FIELDS
+            if getattr(self, f) != getattr(cfg, f)
+        }
+
+    def apply_to_config(self, cfg: Any) -> Any:
+        changes = self.engine_changes(cfg)
+        return dataclasses.replace(cfg, **changes) if changes else cfg
+
+    def replace(self, **kw: Any) -> "OperatingPoint":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def describe(self) -> str:
+        """Compact stable label (decision journals, bench rows)."""
+        return (
+            f"b{self.batch_size}/c{self.complete_batch_size}"
+            f"/p{self.pipeline_depth}"
+            f"/s{self.sketch_sample_count}x{self.sketch_window_ms}ms"
+            f"@{self.sketch_slack_frac:g}/a{self.audit_period}"
+        )
+
+
+def sim_default_op() -> OperatingPoint:
+    """The small-config point the overload simulator and the chaos
+    scenarios drive — identity against ``small_engine_config()`` so the
+    shared definition changes no seeded goldens."""
+    from sentinel_tpu.core.config import small_engine_config
+
+    return OperatingPoint.from_engine_config(small_engine_config())
+
+
+#: bench.py window-compare rows (previously hard-coded literals at the
+#: ``_window_op_rate`` signature): the exact-tier second-window shape
+#: and the minute-scale rotation shape with/without slack.
+BENCH_WINDOW_EXACT = OperatingPoint(
+    batch_size=4096,
+    complete_batch_size=4096,
+    sketch_sample_count=10,
+    sketch_window_ms=100,
+    sketch_slack_frac=0.0,
+)
+BENCH_WINDOW_MINUTE = BENCH_WINDOW_EXACT.replace(
+    sketch_sample_count=60, sketch_window_ms=1000
+)
+BENCH_WINDOW_MINUTE_SLACK = BENCH_WINDOW_MINUTE.replace(
+    sketch_slack_frac=0.05
+)

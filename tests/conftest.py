@@ -68,6 +68,8 @@ _EAGER_MODULES = {
     "test_tail_rules",
     "test_adapters",
     "test_mxu_table",
+    "test_workload",
+    "test_workload_adapters",
 }
 
 

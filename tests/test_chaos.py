@@ -51,6 +51,8 @@ def _import_instrumented_modules():
     import sentinel_tpu.sketch.hotset  # noqa: F401
     import sentinel_tpu.transport.heartbeat  # noqa: F401
     import sentinel_tpu.transport.http_server  # noqa: F401
+    import sentinel_tpu.workload.generator  # noqa: F401
+    import sentinel_tpu.workload.tuner  # noqa: F401
 
 
 # ---------------------------------------------------------------------------
@@ -60,7 +62,7 @@ def _import_instrumented_modules():
 _SCHEME = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
 _LAYERS = {
     "transport", "cluster", "runtime", "parallel", "datasource", "obs",
-    "sketch",
+    "sketch", "workload",
 }
 
 

@@ -1,0 +1,94 @@
+"""Generated flash-crowd traffic through the REAL adapter surfaces
+(ISSUE 19 satellite: gateway + streaming under the workload engine),
+with exact verdict accounting and timeline rows — every offered event
+is accounted pass-or-block, and the persisted per-second metric rows
+sum to the driver's own counts."""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu import workload as WL
+from sentinel_tpu.adapters import GatewayAdapter, GatewayFlowRule, SentinelASGIMiddleware
+from sentinel_tpu.obs import timeline as TL
+
+BIG = 1 << 60
+
+
+def _spec(seed=7, steps=24, base=1.5, start=8, prefix=None, n_keys=4):
+    keys = WL.ZipfKeys(n_keys=n_keys, alpha=1.2, prefix=prefix) if prefix else None
+    return WL.flash_crowd_2x(
+        seed=seed, base=base, steps=steps, step_ms=10, start_step=start, keys=keys
+    )
+
+
+def test_gateway_flash_crowd_verdicts_and_timeline(tmp_path, vt, client_factory):
+    log = TL.MetricLog(str(tmp_path))
+    c = client_factory(timeline_log=log)
+    gw = GatewayAdapter(c)
+    gw.rules.load_rules([GatewayFlowRule(resource="wl-route", count=20)])
+    spec = _spec()
+    events = WL.TrafficGenerator(spec).all_events()
+    res = WL.drive_gateway(gw, WL.TrafficGenerator(spec))
+    # exact verdict accounting: every offered event landed pass-or-block
+    assert res.submitted == len(events) > 0
+    assert res.passed + res.blocked == res.submitted
+    assert res.passed > 0 and res.blocked > 0
+    assert c.stats.resource("wl-route")["curThreadNum"] == 0  # entries exited
+    c.stop()  # final timeline flush
+    rows = TL.MetricLog(str(tmp_path)).find("wl-route", 0, BIG)
+    assert sum(r.pass_count for r in rows) == res.passed
+    assert sum(r.block_count for r in rows) == res.blocked
+
+
+def test_streaming_flash_crowd_verdicts_and_timeline(tmp_path, vt, client_factory):
+    log = TL.MetricLog(str(tmp_path))
+    c = client_factory(timeline_log=log)
+    c.flow_rules.load([st.FlowRule(resource="wl/s0", count=2)])
+    spec = _spec(seed=9, steps=20, prefix="wl/s")
+    events = WL.TrafficGenerator(spec).all_events()
+    res = WL.drive_streaming(c, WL.TrafficGenerator(spec))
+    assert res.submitted == len(events) > 0
+    assert res.passed + res.blocked == res.submitted
+    # only wl/s0 carries a rule: exactly its overflow is blocked
+    offered_s0 = sum(1 for ev in events if ev.key == "wl/s0")
+    assert offered_s0 > 2  # zipf head actually hit the limited key
+    assert res.blocked == offered_s0 - 2
+    c.stop()
+    cold = TL.MetricLog(str(tmp_path))
+    keys = sorted({ev.key for ev in events})
+    rows = {k: cold.find(k, 0, BIG) for k in keys}
+    assert sum(r.pass_count for k in keys for r in rows[k]) == res.passed
+    assert sum(r.block_count for k in keys for r in rows[k]) == res.blocked
+    # ...and the blocks all sit on the limited key's rows
+    assert sum(r.block_count for r in rows["wl/s0"]) == res.blocked
+
+
+def test_asgi_driver_accounts_verdicts(client_factory):
+    async def app(scope, receive, send):
+        await send({"type": "http.response.start", "status": 200, "headers": []})
+        await send({"type": "http.response.body", "body": b"ok"})
+
+    c = client_factory()
+    mw = SentinelASGIMiddleware(app, client=c)
+    c.flow_rules.load([st.FlowRule(resource="GET:/wl/a0", count=3)])
+    spec = _spec(seed=3, steps=12, prefix="wl/a", n_keys=2)
+    events = WL.TrafficGenerator(spec).all_events()
+    res = WL.drive_asgi(mw, WL.TrafficGenerator(spec))
+    assert res.submitted == len(events) > 0
+    assert res.passed + res.blocked == res.submitted
+    offered_a0 = sum(1 for ev in events if ev.key == "wl/a0")
+    assert res.blocked == max(0, offered_a0 - 3) > 0
+
+
+def test_grpc_driver_accounts_verdicts(client_factory):
+    c = client_factory()
+    c.flow_rules.load([st.FlowRule(resource="/wl/g0", count=3)])
+    spec = _spec(seed=4, steps=12, prefix="wl/g", n_keys=2)
+    res = WL.drive_grpc(c, WL.TrafficGenerator(spec))
+    if res is None:
+        pytest.skip("grpc not installed")
+    events = WL.TrafficGenerator(spec).all_events()
+    assert res.submitted == len(events) > 0
+    assert res.passed + res.blocked == res.submitted
+    offered_g0 = sum(1 for ev in events if ev.key == "wl/g0")
+    assert res.blocked == max(0, offered_g0 - 3) > 0
