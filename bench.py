@@ -1499,6 +1499,12 @@ DEFAULT_TOLERANCES = {
     "workload_smoke_surprise_retraces": {"max_abs": 0.0},
     # wall-clock drive at the converged point — noisy, loose floor only
     "workload_smoke_dps": {"min_ratio": 0.3},
+    # verdict provenance plane (PR 20): the device explain section
+    # (explain_k record gathers + checksum packed into the fused wire
+    # buffer) vs the identical packed tick with the section off, on
+    # all-blocked traffic — the acceptance bound is absolute: the
+    # always-on explain records must stay under 2%
+    "explain_overhead_pct": {"max_abs": 2.0},
 }
 
 
@@ -1676,6 +1682,7 @@ def smoke_bench(B: int = 4096, n_ticks: int = 12) -> dict:
             "wire_bytes_per_tick_rx": round(wire_rx),
             "wire_bytes_per_tick_tx": round(wire_tx),
             "profile_overhead_pct": round(_profile_overhead_pct(), 2),
+            "explain_overhead_pct": round(_explain_overhead_pct(), 2),
             **_cluster_smoke_metrics(),
             **_workload_smoke_metrics(),
         },
@@ -1921,6 +1928,135 @@ def _workload_smoke_metrics(steps: int = 160, seed: int = 7) -> dict:
         "workload_smoke_bad_frac_ratio": row["bad_frac_ratio_tuned_over_static"],
         "workload_smoke_surprise_retraces": row["surprise_retraces_during_tuning"],
         "workload_smoke_dps": row["converged_dps"],
+    }
+
+
+def _explain_dps_pair(B: int = 4096, n_ticks: int = 12) -> tuple:
+    """Packed-wire engine tick dps with the device explain section OFF
+    vs ON (cfg.explain_k), on traffic where the flow window keeps most
+    of the batch genuinely BLOCKED — empty-section ticks would measure
+    nothing.  Returns ``(dps_off, dps_on)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.ops import engine as E
+
+    class _Reg:
+        def resource_id(self, n):
+            return 1
+
+    def dps(explain_k: int) -> float:
+        cfg = small_engine_config(
+            batch_size=B,
+            complete_batch_size=B,
+            device_telemetry=True,
+            packed_wire=True,
+            explain_k=explain_k,
+        )
+        tick = E.make_tick(cfg, donate=False, features=E.ALL_FEATURES)
+        # one tight QPS rule on the single traffic resource: the window
+        # fills during warmup and every later decision blocks, so the
+        # explain_k gathers run against real blocked rows every tick
+        rules = E._compile_ruleset(
+            cfg, _Reg(), [FlowRule(resource="bench/expl", count=64.0)],
+            [], [], [], [], None,
+        )
+        state = E.init_state(cfg)
+        acq = E.empty_acquire(cfg)._replace(
+            res=jnp.full((B,), 1, jnp.int32),
+            count=jnp.ones(B, jnp.int32),
+            inbound=jnp.ones(B, jnp.int32),
+        )
+        comp = E.empty_complete(cfg)
+        z = jnp.float32(0.0)
+        for w in range(2):  # compile + warm (fills the flow window)
+            state, out = tick(state, rules, acq, comp, jnp.int32(w), z, z)
+        jax.block_until_ready(out.wire)
+
+        def once() -> float:
+            nonlocal state
+            t0 = time.perf_counter()
+            for t in range(n_ticks):
+                state, out = tick(
+                    state, rules, acq, comp, jnp.int32(1000 + 7 * t), z, z
+                )
+            jax.block_until_ready(out.wire)
+            return n_ticks * B / (time.perf_counter() - t0)
+
+        return _best_of(once, repeats=5)
+
+    return dps(0), dps(32)
+
+
+def _explain_overhead_pct(B: int = 4096, n_ticks: int = 12) -> float:
+    """BENCH_r20 sentry metric: % tick-throughput cost of packing the
+    device provenance records (clamped at 0 — noise can make ON faster)."""
+    dps_off, dps_on = _explain_dps_pair(B, n_ticks)
+    return max((dps_off / max(dps_on, 1.0) - 1.0) * 100.0, 0.0)
+
+
+def _explain_coverage_row(ticks: int = 24, B: int = 128) -> dict:
+    """End-to-end explainability under a flash crowd: a sync client on
+    virtual time drives 2x-limit traffic and the host plane must explain
+    (nearly) every blocked decision.  ``explain_k`` is sized to the
+    batch — the operator knob for block-heavy workloads; the default 32
+    covers ordinary block rates."""
+    import dataclasses
+
+    from sentinel_tpu.core import errors as ERR
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.runtime.client import SentinelClient
+    from sentinel_tpu.utils.time_source import VirtualTimeSource
+
+    cfg = dataclasses.replace(small_engine_config(), explain_k=B)
+    c = SentinelClient(
+        cfg=cfg, mode="sync", time_source=VirtualTimeSource(start_ms=1_000)
+    )
+    c.start()
+    try:
+        names = [f"crowd-{i}" for i in range(8)]
+        # 2x flash crowd: each tick offers twice what the windows admit
+        c.flow_rules.load(
+            [FlowRule(resource=n, count=B // (2 * len(names))) for n in names]
+        )
+        blocked = 0
+        for t in range(ticks):
+            got = c.check_batch([names[i % len(names)] for i in range(B)])
+            blocked += sum(
+                1 for v, _ in got if v not in (ERR.PASS, ERR.PASS_WAIT)
+            )
+            c.time.advance(40)
+        cov = c.explain_coverage()
+    finally:
+        c.stop()
+    return {
+        "ticks": ticks,
+        "batch": B,
+        "blocked_decisions": blocked,
+        "explained": cov["explained"],
+        "explained_frac": round(cov["frac"], 4),
+    }
+
+
+def explain_bench() -> dict:
+    """BENCH_r20: the verdict provenance plane — packed-tick throughput
+    with the device explain section off vs on (the <2% acceptance row),
+    the section's added wire bytes, and end-to-end flash-crowd
+    explainability through the host plane."""
+    from sentinel_tpu.ops import wire as WIRE
+
+    dps_off, dps_on = _explain_dps_pair()
+    return {
+        "engine_dps_explain_off": round(dps_off),
+        "engine_dps_explain_on": round(dps_on),
+        "explain_overhead_pct": round(
+            max((dps_off / max(dps_on, 1.0) - 1.0) * 100.0, 0.0), 2
+        ),
+        "explain_wire_bytes_k32": (2 + 32 * WIRE.EXPLAIN_WORDS) * 4,
+        "flash_crowd": _explain_coverage_row(),
     }
 
 
@@ -2225,6 +2361,19 @@ if __name__ == "__main__":
         # the adaptive row alone (engine-time pure — CPU-reproducible;
         # how BENCH_r07 captured it)
         print(json.dumps({"adaptive_overload": adaptive_overload_bench()}))
+    elif "--explain-plane" in sys.argv:
+        # the verdict-provenance-plane row (PR 20): packed-tick dps with
+        # the device explain section off vs on (<2% acceptance), the
+        # section's wire bytes, flash-crowd end-to-end explainability
+        # (CPU-reproducible); writes BENCH_r20.json
+        doc = {"explain": explain_bench()}
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r20.json"
+        )
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(json.dumps({"explain": doc["explain"], "written": path}))
     elif "--workload" in sys.argv:
         # the closed-loop autotuner row (PR 19): converged-vs-static SLO
         # burn on the seeded flash-crowd shape + dps at the converged

@@ -261,7 +261,7 @@ def sharded_jobs() -> List[Tuple[str, Callable, Tuple[Any, ...]]]:
             jax.jit(
                 functools.partial(TC.decide_batch, cfg=TC.DEFAULT_CFG),
                 in_shardings=(tc_sh, rep, rep, rep, rep, rep, rep),
-                out_shardings=(rep, tc_sh),
+                out_shardings=(rep, rep, tc_sh),
             ),
             t_args,
         )

@@ -44,6 +44,10 @@ Scenarios (the acceptance set):
                       heals and enforces exactly; a second window proves
                       the profiling plane (shadow audit + deep capture)
                       fails OPEN with exact counter accounting
+  explain_fail_open   explain-section decode corrupt/raise: provenance
+                      drops and is counted, while the verdict stream is
+                      bit-identical to an unfaulted control run — the
+                      provenance plane is strictly observational
   tuner_fail_open     workload autotuner faults: a quiet closed loop
                       retunes the operating point live (expected
                       retraces only), then raising tuner steps fail
@@ -1553,6 +1557,130 @@ def _scn_tuner_fail_open(seed: int) -> ScenarioResult:
     return _result("tuner_fail_open", seed, session, verdicts, t0)
 
 
+def _scn_explain_fail_open(seed: int) -> ScenarioResult:
+    """The verdict provenance plane is strictly observational: with the
+    ``obs.explain.decode`` failpoint mangling (corrupt window) and then
+    raising inside (raise window) the explain-section decode, the verdict
+    stream must be BIT-IDENTICAL to an unfaulted control run over the
+    same traffic — explanation loss is counted
+    (``sentinel_explain_decode_failures_total``) and records demonstrably
+    go missing from the plane, but no decision ever changes."""
+    from sentinel_tpu.core import errors as ERR
+    from sentinel_tpu.core import rules as R
+
+    t0 = mono_s()
+    resource = "chaos/explain"
+    rule = [R.FlowRule(resource=resource, count=2.0)]
+    ticks, per_tick = 6, 4
+
+    def _drive(client):
+        """Identical deterministic traffic: one warm tick, then `ticks`
+        batches inside one unadvanced window so the filled window keeps
+        every later item BLOCKED (explain records on every tick)."""
+        client.flow_rules.load(rule)
+        client.check_batch([resource])  # warm XLA compile outside windows
+        out = []
+        for _ in range(ticks):
+            out.extend(client.check_batch([resource] * per_tick))
+        return out
+
+    metrics = MetricsDelta()
+    session = _Session()
+    control = _make_client()
+    faulted = _make_client()
+    corrupt_fires, raise_fires = 2, 1
+    try:
+        baseline = _drive(control)
+        control_explained = control.explain_coverage()["explained"]
+        faulted.flow_rules.load(rule)
+        faulted.check_batch([resource])  # same warm tick, outside windows
+        got = []
+        # window 1: mangled section bytes on decode hits 2 and 4
+        plan = FaultPlan(
+            name="explain-corrupt", seed=seed,
+            faults=[FaultSpec(
+                "obs.explain.decode", "corrupt",
+                every_nth=2, max_fires=corrupt_fires,
+            )],
+        )
+        with session.window(plan):
+            for _ in range(4):
+                got.extend(faulted.check_batch([resource] * per_tick))
+        # window 2: the decode path itself raises (same fail-open contract)
+        plan = FaultPlan(
+            name="explain-raise", seed=seed,
+            faults=[FaultSpec(
+                "obs.explain.decode", "raise",
+                max_fires=raise_fires, exc="RuntimeError",
+            )],
+        )
+        with session.window(plan):
+            for _ in range(2):
+                got.extend(faulted.check_batch([resource] * per_tick))
+    finally:
+        control.stop()
+        faulted.stop()
+    passed = sum(1 for v, _w in got if v in (ERR.PASS, ERR.PASS_WAIT))
+    blocked = len(got) - passed
+    ctx = ScenarioContext(
+        metrics=metrics,
+        client=faulted,
+        submitted=ticks * per_tick,
+        passed=passed,
+        blocked=blocked,
+        injected=session.injected,
+        expect_injected={
+            "obs.explain.decode:corrupt": corrupt_fires,
+            "obs.explain.decode:raise": raise_fires,
+        },
+        extra={
+            "expect_metric_deltas": {
+                # every injected mangle/raise is one dropped section —
+                # and zero of them touched the verdict decode path
+                "sentinel_explain_decode_failures_total": (
+                    corrupt_fires + raise_fires
+                ),
+                "sentinel_packed_decode_failures_total": 0,
+                "sentinel_resolve_failures_total": 0,
+            },
+        },
+    )
+    verdicts = evaluate(
+        [
+            "verdict-accounting",
+            "metric-deltas",
+            "pipeline-drained",
+            "injected-as-planned",
+        ],
+        ctx,
+    )
+    verdicts.append(
+        Verdict(
+            "verdicts-bit-identical",
+            got == baseline,
+            f"faulted run diverged from control: {got} != {baseline}"
+            if got != baseline else "",
+        )
+    )
+    verdicts.append(
+        Verdict(
+            "blocks-under-fault",
+            blocked > 0,
+            f"blocked={blocked}: the armed windows must cover real blocks",
+        )
+    )
+    lost = control_explained - faulted.explain_coverage()["explained"]
+    verdicts.append(
+        Verdict(
+            "explanations-actually-lost",
+            lost > 0,
+            f"control explained {control_explained}, faulted explained "
+            f"{control_explained - lost} — the faults must cost records",
+        )
+    )
+    return _result("explain_fail_open", seed, session, verdicts, t0)
+
+
 def _result(name, seed, session, verdicts, t0) -> ScenarioResult:
     return ScenarioResult(
         name=name,
@@ -1628,6 +1756,12 @@ SCENARIOS: Dict[str, Scenario] = {
             _scn_hotset_promote_fail,
             "hot-set promotion + profiling-plane faults: stats/audit/capture "
             "fail open, tail verdicts fail closed",
+        ),
+        Scenario(
+            "explain_fail_open",
+            _scn_explain_fail_open,
+            "explain-section decode faults: provenance drops (counted), "
+            "verdicts bit-identical to the unfaulted control run",
         ),
         Scenario(
             "tuner_fail_open",

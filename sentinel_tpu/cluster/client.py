@@ -223,7 +223,10 @@ class ClusterTokenClient(TokenService):
                     and rsp.status == C.STATUS_OK
                     and rsp.remaining >= 2
                 ):
-                    self._peer_version = 2
+                    # speak the highest version BOTH sides know
+                    self._peer_version = min(
+                        C.PROTOCOL_VERSION, int(rsp.remaining)
+                    )
 
             hf.add_done_callback(_hello_done)
             self._pend_add(hx, hf)
@@ -402,6 +405,10 @@ class ClusterTokenClient(TokenService):
         return TokenResult(rsp.status, remaining=rsp.remaining, wait_ms=rsp.wait_ms)
 
     def request_token_batch(self, flow_id: int, units: int) -> TokenResult:
+        if self._peer_version >= 3:
+            # v3 peers answer over a BATCH frame so a deny carries its
+            # provenance (_T_PROV); one entry is still one round trip
+            return self.request_batch([(C.BATCH_KIND_FLOW_BATCH, flow_id, units)])[0]
         rsp = self._roundtrip(
             P.ClusterRequest(
                 self._next_xid(), C.MSG_TYPE_FLOW_BATCH, flow_id=flow_id, count=units
@@ -440,14 +447,17 @@ class ClusterTokenClient(TokenService):
 
     def _request_batch_v2(self, entries) -> List[TokenResult]:
         n = len(entries)
+        flags = np.array([e[3] if len(e) > 3 else 0 for e in entries], np.uint8)
+        if self._peer_version >= 3:
+            # ask a v3 server to explain its denies (_T_PROV block); a v2
+            # server never sees the flag, so its frames stay byte-identical
+            flags |= np.uint8(C.BATCH_FLAG_EXPLAIN)
         req = P.ClusterBatchRequest(
             xid=self._next_xid(),
             kinds=np.array([e[0] for e in entries], np.uint8),
             ids=np.array([e[1] for e in entries], np.int64),
             counts=np.array([e[2] for e in entries], np.int32),
-            flags=np.array(
-                [e[3] if len(e) > 3 else 0 for e in entries], np.uint8
-            ),
+            flags=flags,
         )
         _t = OT.t0()
         _attrs = None
@@ -505,15 +515,22 @@ class ClusterTokenClient(TokenService):
             or len(rsp) != n
         ):
             return [TokenResult(C.STATUS_FAIL)] * n
-        return [
-            TokenResult(
-                int(rsp.statuses[i]),
-                remaining=int(rsp.remainings[i]),
-                wait_ms=int(rsp.waits[i]),
-                token_id=int(rsp.token_ids[i]),
+        out = []
+        for i in range(n):
+            pv = rsp.prov[i] if rsp.prov is not None else None
+            out.append(
+                TokenResult(
+                    int(rsp.statuses[i]),
+                    remaining=int(rsp.remainings[i]),
+                    wait_ms=int(rsp.waits[i]),
+                    token_id=int(rsp.token_ids[i]),
+                    prov_kind=pv[0] if pv else None,
+                    prov_rule=pv[1] if pv else None,
+                    prov_observed=pv[2] if pv else None,
+                    prov_limit=pv[3] if pv else None,
+                )
             )
-            for i in range(n)
-        ]
+        return out
 
     _BATCH_KIND_TO_MSG = {
         C.BATCH_KIND_FLOW: C.MSG_TYPE_FLOW,

@@ -82,7 +82,14 @@ FLOW_THRESHOLD_AVG_LOCAL = 0
 FLOW_THRESHOLD_GLOBAL = 1
 
 # -- protocol v2 (BATCH frames) ----------------------------------------------
-PROTOCOL_VERSION = 2
+# v3 adds deny provenance: a client that sets BATCH_FLAG_EXPLAIN on an
+# entry asks the server to append a _T_PROV block to the batch response —
+# (kind, rule, observed, limit) for each BLOCKED entry whose cause is
+# known — so a remote block explains itself like a local one
+# (obs/explain.py).  Negotiated via the same HELLO exchange; a v2 peer
+# never sees the flag or the block, and frames without it are
+# byte-identical to v2.
+PROTOCOL_VERSION = 3
 # per-entry kinds inside a BATCH frame (NOT wire message types — the
 # frame's type byte is MSG_TYPE_BATCH; these select the per-entry
 # decision semantics)
@@ -91,6 +98,10 @@ BATCH_KIND_FLOW_BATCH = 2  # partial-grant acquire (granted k in remaining)
 BATCH_KIND_LEASE = 3  # bounded-slack lease top-up (granted k + TTL)
 # per-entry flag bits
 BATCH_FLAG_PRIORITIZED = 0x01
+# v3: request deny provenance for this entry (set only after HELLO
+# negotiated version >= 3; a v2 server treats unknown flag bits as
+# garbage, so the client gates it on the negotiated version)
+BATCH_FLAG_EXPLAIN = 0x02
 # hard ceiling on entries per BATCH frame: 14 B/entry keeps the frame
 # comfortably under MAX_FRAME (65535) and bounds one coalesced device
 # decision batch

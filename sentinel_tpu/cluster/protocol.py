@@ -34,6 +34,7 @@ a v1 peer never receives one; all v1 frames above stay byte-identical):
              [optional 17-byte trace tail]
   response → [xid:int32][type=14:uint8][status:int8][n:uint16]
              n × [status:int8][remaining:int32][waitMs:int32][tokenId:int64]  (17 B)
+             [optional v3 _T_PROV deny-provenance block — see _T_PROV]
              [optional 17-byte trace tail]
 
 Entry columns are fixed-width big-endian, so pack/unpack is a single
@@ -58,6 +59,7 @@ import numpy as np
 
 from sentinel_tpu.cluster import constants as C
 from sentinel_tpu.native import ring as _NR
+from sentinel_tpu.obs.explain import fx_decode, fx_encode
 from sentinel_tpu.obs.registry import REGISTRY as _OBS
 
 MAX_FRAME = 65535  # 2-byte length prefix ceiling; RES_CHECK batches chunk
@@ -106,6 +108,19 @@ _T_BOOL = 4
 #: malformed frame (caller times out and degrades, never crashes).
 _T_TRACE = 7
 _TRACE_BLOCK = struct.Struct(">BQQ")
+#: deny-provenance tag (protocol v3): an optional block in BATCH
+#: responses — ``[0x08][count:u16]`` then ``count`` records of
+#: ``[entry_idx:u16][kind:u8][rule:u64][observed:u32][limit:u32]``,
+#: one per BLOCKED entry whose cause the server knows.  observed/limit
+#: use the obs/explain.py fixed-point encoding (×256, 0xFFFFFFFF =
+#: unknown) — the same words the device explain records carry, so a
+#: remote block folds into the provenance plane exactly like a local
+#: one.  Placement: after the result slab, BEFORE the trace tail.  Sent
+#: only when the client requested it (BATCH_FLAG_EXPLAIN, v3+ peers);
+#: frames without it are byte-identical to v2.
+_T_PROV = 8
+_PROV_HEAD = struct.Struct(">BH")
+_PROV_ENTRY = struct.Struct(">HBQII")
 
 
 @dataclass
@@ -374,6 +389,10 @@ class ClusterBatchResponse:
     token_ids: np.ndarray  # int64[n] — concurrent token ids (0 otherwise)
     trace_id: int = 0
     span_id: int = 0
+    # v3 deny provenance, entry-aligned: ``prov[i]`` is ``(kind, rule,
+    # observed|None, limit|None)`` for a BLOCKED entry whose cause the
+    # server knows, else None; the whole field is None on v2 frames
+    prov: Optional[List[Optional[Tuple[int, int, Optional[float], Optional[float]]]]] = None
 
     def __len__(self) -> int:
         return len(self.statuses)
@@ -395,19 +414,62 @@ def encode_batch_request(req: ClusterBatchRequest) -> bytes:
     return struct.pack(">H", len(body)) + body
 
 
-def _batch_payload(p: bytes, n: int, entry_size: int) -> Tuple[bytes, int, int]:
-    """Strict-length entry slab + trace context.  The remainder after the
-    count header must be EXACTLY ``n`` entries, optionally followed by a
-    well-formed trace block — anything else (bit-flipped count byte,
-    short read, trailing garbage) raises, and the caller rejects the
-    whole frame: a corrupted BATCH frame never yields partial answers."""
+def _prov_tail(prov) -> bytes:
+    """Optional v3 deny-provenance block (entry-aligned list as stored on
+    ClusterBatchResponse.prov); empty when no entry has provenance — the
+    frame stays byte-identical to v2."""
+    if not prov:
+        return b""
+    recs = [(i, pv) for i, pv in enumerate(prov) if pv is not None]
+    if not recs:
+        return b""
+    out = bytearray(_PROV_HEAD.pack(_T_PROV, len(recs)))
+    for i, (kind, rule, observed, limit) in recs:
+        out += _PROV_ENTRY.pack(
+            i,
+            int(kind) & 0xFF,
+            int(rule) & 2**64 - 1,
+            fx_encode(observed),
+            fx_encode(limit),
+        )
+    return bytes(out)
+
+
+def _batch_payload(
+    p: bytes, n: int, entry_size: int
+) -> Tuple[bytes, int, int, Optional[list]]:
+    """Strict-length entry slab + optional blocks.  The remainder after
+    the count header must be EXACTLY ``n`` entries, optionally followed
+    by a well-formed _T_PROV block (v3) and/or trace block — anything
+    else (bit-flipped count byte, short read, trailing garbage) raises,
+    and the caller rejects the whole frame: a corrupted BATCH frame
+    never yields partial answers."""
     want = n * entry_size
-    if len(p) == want:
-        return p, 0, 0
-    if len(p) == want + _TRACE_BLOCK.size and p[want] == _T_TRACE:
-        tid, sid = _read_trace_tail(p, want)
-        return p[:want], tid, sid
-    raise ValueError(f"bad batch frame length {len(p)} for {n} entries")
+    if len(p) < want:
+        raise ValueError(f"bad batch frame length {len(p)} for {n} entries")
+    off = want
+    prov: Optional[list] = None
+    if off < len(p) and p[off] == _T_PROV:
+        if off + _PROV_HEAD.size > len(p):
+            raise ValueError("truncated prov block")
+        _tag, k = _PROV_HEAD.unpack_from(p, off)
+        off += _PROV_HEAD.size
+        if k > n or off + k * _PROV_ENTRY.size > len(p):
+            raise ValueError(f"bad prov block count {k} for {n} entries")
+        prov = [None] * n
+        for _ in range(k):
+            idx, kind, rule, obs_w, lim_w = _PROV_ENTRY.unpack_from(p, off)
+            off += _PROV_ENTRY.size
+            if idx >= n:
+                raise ValueError(f"prov entry index {idx} out of range")
+            prov[idx] = (kind, rule, fx_decode(obs_w), fx_decode(lim_w))
+    tid = sid = 0
+    if off < len(p):
+        if len(p) == off + _TRACE_BLOCK.size and p[off] == _T_TRACE:
+            tid, sid = _read_trace_tail(p, off)
+        else:
+            raise ValueError(f"bad batch frame length {len(p)} for {n} entries")
+    return p[:want], tid, sid, prov
 
 
 def decode_batch_request(body: bytes) -> ClusterBatchRequest:
@@ -418,7 +480,7 @@ def decode_batch_request(body: bytes) -> ClusterBatchRequest:
         raise ValueError(f"not a batch frame (type {t})")
     if not 0 < n <= C.MAX_BATCH_ENTRIES:
         raise ValueError(f"bad batch size {n}")
-    slab, tid, sid = _batch_payload(body[_BATCH_REQ_HEAD.size :], n, _NR.BATCH_ENTRY_SIZE)
+    slab, tid, sid, _ = _batch_payload(body[_BATCH_REQ_HEAD.size :], n, _NR.BATCH_ENTRY_SIZE)
     kinds, ids, counts, flags = _NR.unpack_batch_entries(slab)
     return ClusterBatchRequest(
         xid=xid, kinds=kinds, ids=ids, counts=counts, flags=flags,
@@ -433,6 +495,7 @@ def encode_batch_response(rsp: ClusterBatchResponse) -> bytes:
         body += _NR.pack_batch_results(
             rsp.statuses, rsp.remainings, rsp.waits, rsp.token_ids
         )
+        body += _prov_tail(rsp.prov)
     body += _trace_tail(rsp.trace_id, rsp.span_id)
     if len(body) > MAX_FRAME:
         raise ValueError("frame too large")
@@ -449,11 +512,11 @@ def decode_batch_response(body: bytes) -> ClusterBatchResponse:
         raise ValueError(f"not a batch frame (type {t})")
     if not 0 <= n <= C.MAX_BATCH_ENTRIES:
         raise ValueError(f"bad batch size {n}")
-    slab, tid, sid = _batch_payload(body[_BATCH_RSP_HEAD.size :], n, _NR.BATCH_RESULT_SIZE)
+    slab, tid, sid, prov = _batch_payload(body[_BATCH_RSP_HEAD.size :], n, _NR.BATCH_RESULT_SIZE)
     statuses, remainings, waits, tokens = _NR.unpack_batch_results(slab)
     return ClusterBatchResponse(
         xid=xid, status=status, statuses=statuses, remainings=remainings,
-        waits=waits, token_ids=tokens, trace_id=tid, span_id=sid,
+        waits=waits, token_ids=tokens, trace_id=tid, span_id=sid, prov=prov,
     )
 
 

@@ -361,10 +361,19 @@ class ClusterTokenServer:
         with OT.maybe_ctx(breq.trace_id, breq.span_id):
             try:
                 FP.hit(_FP_PROCESS)
-                statuses, remainings, waits, token_ids = self.service.decide_frame(
+                statuses, remainings, waits, token_ids, prov = self.service.decide_frame(
                     breq.kinds, breq.ids, breq.counts, breq.flags
                 )
                 status = C.STATUS_OK
+                # v3 deny provenance: attach only for entries that asked
+                # (BATCH_FLAG_EXPLAIN) — a pre-v3 client never set the
+                # flag, so its response stays byte-identical to v2
+                prov = [
+                    pv if int(breq.flags[i]) & C.BATCH_FLAG_EXPLAIN else None
+                    for i, pv in enumerate(prov)
+                ]
+                if not any(pv is not None for pv in prov):
+                    prov = None
             except Exception:  # stlint: disable=fail-open — whole-frame STATUS_FAIL: every entry degrades, none passes
                 record_log().exception("batch frame processing failed")
                 statuses = np.full(n, C.STATUS_FAIL, np.int8)
@@ -372,9 +381,10 @@ class ClusterTokenServer:
                 waits = np.zeros(n, np.int32)
                 token_ids = np.zeros(n, np.int64)
                 status = C.STATUS_FAIL
+                prov = None
         return P.ClusterBatchResponse(
             breq.xid, status, statuses, remainings, waits, token_ids,
-            trace_id=breq.trace_id, span_id=breq.span_id,
+            trace_id=breq.trace_id, span_id=breq.span_id, prov=prov,
         )
 
     def _process(self, req: P.ClusterRequest) -> P.ClusterResponse:

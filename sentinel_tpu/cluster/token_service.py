@@ -90,6 +90,15 @@ class TokenResult:
     remaining: int = 0
     wait_ms: int = 0
     token_id: int = 0
+    # deny provenance (protocol v3 _T_PROV, obs/explain.py): populated on
+    # STATUS_BLOCKED by services that know WHY — verdict kind, blamed rule
+    # (flow id), observed usage at decision time, and the limit it hit.
+    # None on OK results, on pre-v3 peers, and on transport failures, so
+    # every consumer must treat provenance as best-effort.
+    prov_kind: Optional[int] = None
+    prov_rule: Optional[int] = None
+    prov_observed: Optional[float] = None
+    prov_limit: Optional[float] = None
 
     @property
     def ok(self) -> bool:
@@ -282,6 +291,10 @@ class TokenColumnBatcher:
         self._slots: Dict[int, int] = {}
         self._free: List[int] = []
         self._next_slot = 0
+        # flow id -> projected global threshold, for deny provenance
+        # (replaced wholesale in project(); dict swap is GIL-atomic so
+        # the worker thread reads it lock-free)
+        self._limits_by_fid: Dict[int, float] = {}
         self._cap = 8
         self._state = TC.init_state(self._cap)
         # memory ledger (obs/profile.py): token-column device state under
@@ -302,10 +315,12 @@ class TokenColumnBatcher:
     def submit(
         self, flow_id: int, units: int, partial: bool, forced: bool = False
     ) -> "Future":
-        """Enqueue one decision entry; resolves to granted units (int).
-        A flow whose rule dropped between guard and decide grants 0 —
-        fail closed, like every ambiguity on this path.  ``forced``
-        charges unconditionally (the occupy-ahead emulation)."""
+        """Enqueue one decision entry; resolves to ``(granted, observed,
+        limit)`` — granted units plus the window usage and threshold the
+        entry was decided against (deny provenance, obs/explain.py).  A
+        flow whose rule dropped between guard and decide grants 0 — fail
+        closed, like every ambiguity on this path.  ``forced`` charges
+        unconditionally (the occupy-ahead emulation)."""
         f: Future = Future()
         with self._cv:
             if self._closed:
@@ -339,7 +354,7 @@ class TokenColumnBatcher:
         heads = np.arange(self.CAPACITY, dtype=np.int32)
         partial = np.zeros(self.CAPACITY, bool)
         forced = np.zeros(self.CAPACITY, bool)
-        g, self._state = self._decide(
+        g, _obs, self._state = self._decide(
             self._state, now, slots, units, heads, partial, forced
         )
         np.asarray(g)  # block until the executable is built
@@ -416,6 +431,7 @@ class TokenColumnBatcher:
             limits = np.zeros(cap, np.float32)
             for fid, thr in thresholds.items():
                 limits[self._slots[fid]] = thr
+            self._limits_by_fid = dict(thresholds)
             self._state = TC.set_limits(self._state, jnp.asarray(limits))
             if grew:
                 # rule pushes pay the new shape's compile, requests don't
@@ -475,16 +491,21 @@ class TokenColumnBatcher:
             heads[:n] = np.maximum.accumulate(
                 np.where(newseg, np.arange(n), 0)
             ).astype(np.int32)
-        g, self._state = self._decide(
+        g, obs, self._state = self._decide(
             self._state, np.int32(now), slots, units, heads, partial, forced
         )
         granted = np.empty(n, np.int32)
         granted[order] = np.asarray(g)[:n]
+        observed = np.empty(n, np.float32)
+        observed[order] = np.asarray(obs)[:n]
         _C_BATCHED.inc(n)
         self._note_timeline(chunk, granted, now)
-        for i, (_fid, _u, _p, _fo, f) in enumerate(chunk):
+        lims = self._limits_by_fid
+        for i, (fid, _u, _p, _fo, f) in enumerate(chunk):
             if not f.done():
-                f.set_result(int(granted[i]))
+                f.set_result(
+                    (int(granted[i]), float(observed[i]), lims.get(fid, 0.0))
+                )
 
     def _note_timeline(self, chunk: List[tuple], granted: np.ndarray, now: int) -> None:
         """Land this chunk's verdicts in the decision client's timeline.
@@ -695,7 +716,7 @@ class DefaultTokenService(TokenService):
                         attrs=_span.attrs,
                     )
                 try:
-                    granted = fut.result()
+                    granted, observed, limit = fut.result()
                 except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
                     done.set_result(TokenResult(C.STATUS_FAIL))
                     return
@@ -703,7 +724,15 @@ class DefaultTokenService(TokenService):
                     done.set_result(TokenResult(C.STATUS_OK))
                     return
                 if not prioritized:
-                    done.set_result(TokenResult(C.STATUS_BLOCKED))
+                    done.set_result(
+                        TokenResult(
+                            C.STATUS_BLOCKED,
+                            prov_kind=ERR.BLOCK_FLOW,
+                            prov_rule=flow_id,
+                            prov_observed=observed,
+                            prov_limit=limit,
+                        )
+                    )
                     return
                 # occupy-ahead emulation: charge the ask unconditionally
                 # (debits the CURRENT bucket — one earlier than the
@@ -770,7 +799,15 @@ class DefaultTokenService(TokenService):
             elif verdict == ERR.PASS_WAIT:
                 done.set_result(TokenResult(C.STATUS_SHOULD_WAIT, wait_ms=wait_ms))
             else:
-                done.set_result(TokenResult(C.STATUS_BLOCKED))
+                # engine path: the verdict code names the kind; observed/
+                # limit stay unknown (the tick already consumed them)
+                done.set_result(
+                    TokenResult(
+                        C.STATUS_BLOCKED,
+                        prov_kind=int(verdict),
+                        prov_rule=flow_id,
+                    )
+                )
 
         f.add_done_callback(_chain)
         return done
@@ -792,16 +829,22 @@ class DefaultTokenService(TokenService):
         if self.col is not None:
             with OT.TRACER.span("token.decision_batch", flow_id=flow_id, units=units):
                 try:
-                    granted = int(
-                        self.col.submit(flow_id, units, partial=True).result(
-                            timeout=self.client.entry_timeout_s
-                        )
-                    )
+                    granted, observed, limit = self.col.submit(
+                        flow_id, units, partial=True
+                    ).result(timeout=self.client.entry_timeout_s)
+                    granted = int(granted)
                 except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
                     return TokenResult(C.STATUS_FAIL)
             _C_DECISIONS.inc(units)
             if granted == 0:
-                return TokenResult(C.STATUS_BLOCKED, remaining=0)
+                return TokenResult(
+                    C.STATUS_BLOCKED,
+                    remaining=0,
+                    prov_kind=ERR.BLOCK_FLOW,
+                    prov_rule=flow_id,
+                    prov_observed=observed,
+                    prov_limit=limit,
+                )
             return TokenResult(C.STATUS_OK, remaining=granted)
         with OT.TRACER.span("token.decision_batch", flow_id=flow_id, units=units):
             results = self.client.check_batch([flow_resource(flow_id)] * units)
@@ -809,7 +852,12 @@ class DefaultTokenService(TokenService):
         granted = sum(1 for v, _ in results if v in (ERR.PASS, ERR.PASS_WAIT))
         wait = max((w for v, w in results if v == ERR.PASS_WAIT), default=0)
         if granted == 0:
-            return TokenResult(C.STATUS_BLOCKED, remaining=0)
+            return TokenResult(
+                C.STATUS_BLOCKED,
+                remaining=0,
+                prov_kind=ERR.BLOCK_FLOW,
+                prov_rule=flow_id,
+            )
         return TokenResult(C.STATUS_OK, remaining=granted, wait_ms=wait)
 
     def request_param_token(self, flow_id: int, count: int, params: List[Any]) -> TokenResult:
@@ -833,7 +881,9 @@ class DefaultTokenService(TokenService):
         _C_DECISIONS.inc(len(params))
         if all(v == ERR.PASS for v, _ in results):
             return TokenResult(C.STATUS_OK)
-        return TokenResult(C.STATUS_BLOCKED)
+        return TokenResult(
+            C.STATUS_BLOCKED, prov_kind=ERR.BLOCK_PARAM, prov_rule=flow_id
+        )
 
     # request_lease: the TokenService base implementation already rides
     # request_token_batch with the MAX_LEASE_UNITS clamp and honors this
@@ -849,7 +899,12 @@ class DefaultTokenService(TokenService):
             flow_id, count, limit, self.client.time.now_ms()
         )
         if tid is None:
-            return TokenResult(C.STATUS_BLOCKED)
+            return TokenResult(
+                C.STATUS_BLOCKED,
+                prov_kind=ERR.BLOCK_FLOW,
+                prov_rule=flow_id,
+                prov_limit=limit,
+            )
         return TokenResult(C.STATUS_OK, token_id=tid)
 
     def release_concurrent_token(self, token_id: int) -> TokenResult:
@@ -860,7 +915,7 @@ class DefaultTokenService(TokenService):
 
     def decide_frame(
         self, kinds, ids, counts, flags
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, list]:
         """Answer one protocol-v2 BATCH frame's entry columns.
 
         Host-side guards (rule lookup, namespace limiter, validation) run
@@ -876,7 +931,10 @@ class DefaultTokenService(TokenService):
         The prioritized flag has no occupy-ahead on the column path: an
         over-limit prioritized entry is BLOCKED (fail closed), never
         SHOULD_WAIT.  Returns (statuses i8, remainings i32, waits i32,
-        token_ids i64) aligned with the request entries.
+        token_ids i64, prov) aligned with the request entries; ``prov[i]``
+        is ``(kind, rule, observed|None, limit|None)`` on BLOCKED entries
+        whose cause is known, else None — the server ships it back only
+        when the client set BATCH_FLAG_EXPLAIN (protocol v3 _T_PROV).
         """
         n = len(kinds)
         # seed FAIL, not OK: any entry a bug leaves untouched must read as
@@ -885,6 +943,9 @@ class DefaultTokenService(TokenService):
         remainings = np.zeros(n, np.int32)
         waits = np.zeros(n, np.int32)
         token_ids = np.zeros(n, np.int64)
+        prov: List[Optional[Tuple[int, int, Optional[float], Optional[float]]]] = [
+            None
+        ] * n
         if self.col is None:
             for i in range(n):
                 kind, fid, cnt = int(kinds[i]), int(ids[i]), int(counts[i])
@@ -901,7 +962,14 @@ class DefaultTokenService(TokenService):
                 remainings[i] = r.remaining
                 waits[i] = r.wait_ms
                 token_ids[i] = r.token_id
-            return statuses, remainings, waits, token_ids
+                if r.prov_kind is not None:
+                    prov[i] = (
+                        r.prov_kind,
+                        r.prov_rule if r.prov_rule is not None else fid,
+                        r.prov_observed,
+                        r.prov_limit,
+                    )
+            return statuses, remainings, waits, token_ids, prov
         now = self.client.time.now_ms()
         futs: List[Future] = []
         meta: List[Tuple[int, int, int]] = []
@@ -937,22 +1005,26 @@ class DefaultTokenService(TokenService):
             futs.append(
                 self.col.submit(fid, units, partial=kind != C.BATCH_KIND_FLOW)
             )
-            meta.append((i, kind, units))
+            meta.append((i, kind, units, fid))
         timeout = self.client.entry_timeout_s
-        for f, (i, kind, units) in zip(futs, meta):
+        for f, (i, kind, units, fid) in zip(futs, meta):
             try:
-                granted = int(f.result(timeout=timeout))
+                granted, observed, limit = f.result(timeout=timeout)
+                granted = int(granted)
             except Exception:  # stlint: disable=fail-open — STATUS_FAIL makes the caller degrade to local enforcement, never PASS
                 statuses[i] = C.STATUS_FAIL
                 continue
             _C_DECISIONS.inc(1 if kind == C.BATCH_KIND_FLOW else units)
-            if kind == C.BATCH_KIND_FLOW:
-                statuses[i] = C.STATUS_OK if granted >= units else C.STATUS_BLOCKED
-            elif granted == 0:
+            blocked = (
+                granted < units if kind == C.BATCH_KIND_FLOW else granted == 0
+            )
+            if blocked:
                 statuses[i] = C.STATUS_BLOCKED
+                prov[i] = (ERR.BLOCK_FLOW, fid, observed, limit)
             else:
                 statuses[i] = C.STATUS_OK
-                remainings[i] = granted
-                if kind == C.BATCH_KIND_LEASE:
-                    waits[i] = self.lease_ttl_ms
-        return statuses, remainings, waits, token_ids
+                if kind != C.BATCH_KIND_FLOW:
+                    remainings[i] = granted
+                    if kind == C.BATCH_KIND_LEASE:
+                        waits[i] = self.lease_ttl_ms
+        return statuses, remainings, waits, token_ids, prov

@@ -277,6 +277,17 @@ class EngineConfig:
     # as the sidecar-overflow escape hatch; everything else rides the
     # fused buffer.
     packed_wire: Optional[bool] = None
+    # verdict provenance plane (ops/wire.py explain section + obs/
+    # explain.py): with the packed wire on, the tick additionally packs
+    # up to explain_k fixed-point "explain" records — one per BLOCKED
+    # item: rule slot + verdict kind + sketch-tier flag, observed value
+    # vs threshold — into a separately-checksummed trailing section of
+    # the SAME fused readback.  Corruption of that section drops the
+    # explanations for the tick (fail-OPEN for the explanation only);
+    # the main section's checksum still fails the verdicts CLOSED.
+    # 0 disables the section (wire layout and traced program unchanged);
+    # ignored without packed_wire (provenance rides only the fused wire).
+    explain_k: int = 32
 
     def __post_init__(self):
         # the native completion ring transports exactly four hot-param

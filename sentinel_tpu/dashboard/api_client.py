@@ -137,6 +137,20 @@ class SentinelApiClient:
             self._get(ip, port, "api/flight", stored=stored)
         )
 
+    def fetch_explain(
+        self,
+        ip: str,
+        port: int,
+        resource: Optional[str] = None,
+        top: Optional[int] = None,
+    ) -> dict:
+        """``GET /api/explain`` — the machine's verdict provenance plane:
+        coverage, the top block-cause leaderboard, and the newest
+        device-packed block explanations (obs/explain.py)."""
+        return json.loads(
+            self._get(ip, port, "api/explain", resource=resource, top=top)
+        )
+
     def fetch_json_tree(self, ip: str, port: int) -> dict:
         return json.loads(self._get(ip, port, "jsonTree"))
 

@@ -205,6 +205,7 @@ class DashboardServer:
             ("POST", "/cluster/mode"): self._set_cluster_mode,
             ("POST", "/cluster/assign"): self._cluster_assign,
             ("GET", "/tree"): self._tree,
+            ("GET", "/explain"): self._explain,
         }
 
     # -- handlers ----------------------------------------------------------
@@ -373,3 +374,15 @@ class DashboardServer:
     def _tree(self, params, body):
         ip, port = self._machine_of(params)
         return 200, self.api.fetch_json_tree(ip, port)
+
+    def _explain(self, params, body):
+        """Proxy to the machine's ``GET /api/explain`` — the "top block
+        causes" panel's data source (same SSRF allowlist as the other
+        proxy routes)."""
+        ip, port = self._machine_of(params)
+        top = params.get("top")
+        return 200, self.api.fetch_explain(
+            ip, port,
+            resource=params.get("resource"),
+            top=int(top) if top else None,
+        )

@@ -3,7 +3,9 @@
 The reference ships an AngularJS 1.x SPA with ECharts; this is the same
 idea at minimum viable scale with zero dependencies (vanilla JS + canvas):
 machine discovery table, per-app top resources, live QPS chart polling
-/metric once a second, and a rule MANAGER (list/add/edit/delete for
+/metric once a second, a "top block causes" verdict-provenance panel
+(GET /explain — which rule blocked, observed vs threshold, sketch-tier /
+possibly-false flags), and a rule MANAGER (list/add/edit/delete for
 flow / degrade / paramFlow / system / authority rules — the
 flow_v1.html / degrade.html / param_flow.html / system.html /
 authority.html pages of the reference SPA) publishing the full per-type
@@ -48,6 +50,13 @@ PAGE = r"""<!doctype html>
 
 <h2>top resources <span class="muted">(last second)</span></h2>
 <table id="top"><tr><th>resource</th><th>pass/s</th><th>block/s</th><th>avg rt</th><th>threads</th></tr></table>
+
+<h2>top block causes <span class="muted" id="explcov"></span></h2>
+<div class="muted">verdict provenance (GET /explain via the selected rule
+machine): which rule blocked, what it observed vs its threshold; ~ marks
+sketch-tier estimates, ! marks possibly-false blocks (margin within the
+audit eps bound)</div>
+<table id="explain"><tr><th>count</th><th>kind</th><th>rule</th><th>origin</th><th>resource</th><th>last observed/threshold</th></tr></table>
 
 <h2>rules</h2>
 <div>
@@ -351,6 +360,37 @@ $("rsave").onclick = async () => {
   } catch (e) { $("rout").textContent = String(e); }
 };
 
+async function refreshExplain() {
+  const m = rmachine();
+  const t = $("explain");
+  const head = "<tr><th>count</th><th>kind</th><th>rule</th><th>origin</th>" +
+    "<th>resource</th><th>last observed/threshold</th></tr>";
+  if (!m) { t.innerHTML = head; $("explcov").textContent = ""; return; }
+  const d = await j(`/explain?ip=${m.ip}&port=${m.port}&top=8`);
+  const cov = d.coverage || {};
+  $("explcov").textContent = d.enabled === false
+    ? "(explain plane off)"
+    : `${cov.explained || 0}/${cov.blocked || 0} blocked decisions explained`;
+  // newest record per (resource, kind, rule, origin) → the numbers column
+  const latest = {};
+  for (const r of d.recent || []) {
+    const k = `${r.resource}|${r.kind}|${r.rule}|${r.origin}`;
+    if (!(k in latest)) latest[k] = r;
+  }
+  t.innerHTML = head;
+  for (const c of d.top_causes || []) {
+    const r = latest[`${c.resource}|${c.kind}|${c.rule}|${c.origin}`];
+    const num = r && r.observed != null && r.threshold != null
+      ? `${r.observed} / ${r.threshold}` +
+        (r.sketch_tier ? " ~" : "") + (r.possibly_false ? " !" : "")
+      : "-";
+    const row = t.insertRow();
+    row.innerHTML = `<td>${esc(c.count)}</td><td>${esc(c.kind)}</td>` +
+      `<td>${c.rule == null ? "-" : esc(c.rule)}</td><td>${esc(c.origin)}</td>` +
+      `<td>${esc(c.name || c.resource)}</td><td>${esc(num)}</td>`;
+  }
+}
+
 async function refreshAssign() {
   const app = $("app").value;
   const sel = $("srv"), cur = sel.value;
@@ -395,6 +435,7 @@ async function tick() {
       rulesLoadedOnce = true;
       await loadRules();
     }
+    await refreshExplain();
     await refreshAssign();
     $("err").textContent = "";
   } catch (e) { $("err").textContent = String(e); }

@@ -2,14 +2,24 @@
 
 The analog of LogSlot → EagleEyeLogUtil.java:24-36 backed by the embedded
 EagleEye StatLogger: every blocked request is recorded, but writes are
-aggregated per (resource, exception, origin) per second so a block storm
-costs one line per distinct key per second, not one line per request.
+aggregated per (resource, exception, origin, provenance) per second so a
+block storm costs one line per distinct key per second, not one line per
+request.
 
 Aggregation is inline (flushed when the wall second advances) instead of
 the reference's async appender thread — the host tick loop already gives
 us a natural cadence and this keeps the writer allocation-free.
 
-Line format:  timestamp|resource|exceptionName|count|origin
+Line formats (both are valid; ``parse_line`` reads either):
+
+  legacy:   timestamp|resource|exceptionName|count|origin
+  explain:  timestamp|resource|exceptionName|count|origin|kind|rule
+
+The two trailing fields are the verdict provenance key from the explain
+plane (obs/explain.py): the cause name ("flow"/"degrade"/…) and the
+blamed rule slot (empty when unattributable).  Lines carry them only
+when the caller supplied provenance, so a client without the explain
+plane writes byte-identical legacy lines.
 """
 
 from __future__ import annotations
@@ -17,6 +27,33 @@ from __future__ import annotations
 import os
 import threading
 from typing import Dict, Optional, Tuple
+
+
+def parse_line(line: str) -> Optional[dict]:
+    """One log line -> dict, accepting BOTH the legacy 5-field format and
+    the 7-field explain format.  Returns None on a malformed line."""
+    parts = line.rstrip("\n").split("|")
+    if len(parts) not in (5, 7):
+        return None
+    try:
+        out = {
+            "ts": int(parts[0]),
+            "resource": parts[1],
+            "exception": parts[2],
+            "count": int(parts[3]),
+            "origin": parts[4],
+            "kind": None,
+            "rule": None,
+        }
+    except ValueError:
+        return None
+    if len(parts) == 7:
+        out["kind"] = parts[5] or None
+        try:
+            out["rule"] = int(parts[6]) if parts[6] else None
+        except ValueError:
+            return None
+    return out
 
 
 class BlockLogger:
@@ -33,15 +70,24 @@ class BlockLogger:
         self.backup_count = backup_count
         self._lock = threading.Lock()
         self._cur_sec = -1
-        self._pending: Dict[Tuple[str, str, str], int] = {}
+        self._pending: Dict[Tuple[str, str, str, Optional[str], Optional[int]], int] = {}
 
-    def log(self, now_ms: int, resource: str, exception_name: str, origin: str = "", count: int = 1) -> None:
+    def log(
+        self,
+        now_ms: int,
+        resource: str,
+        exception_name: str,
+        origin: str = "",
+        count: int = 1,
+        kind: Optional[str] = None,
+        rule: Optional[int] = None,
+    ) -> None:
         sec = now_ms // 1000
         with self._lock:
             if sec != self._cur_sec:
                 self._flush_locked()
                 self._cur_sec = sec
-            key = (resource, exception_name, origin)
+            key = (resource, exception_name, origin, kind, rule)
             self._pending[key] = self._pending.get(key, 0) + count
 
     def flush(self) -> None:
@@ -52,10 +98,15 @@ class BlockLogger:
         if not self._pending:
             return
         ts = self._cur_sec * 1000
-        lines = [
-            f"{ts}|{res}|{exc}|{cnt}|{origin}\n"
-            for (res, exc, origin), cnt in self._pending.items()
-        ]
+        lines = []
+        for (res, exc, origin, kind, rule), cnt in self._pending.items():
+            if kind is None and rule is None:
+                lines.append(f"{ts}|{res}|{exc}|{cnt}|{origin}\n")
+            else:
+                lines.append(
+                    f"{ts}|{res}|{exc}|{cnt}|{origin}"
+                    f"|{kind or ''}|{'' if rule is None else rule}\n"
+                )
         self._pending.clear()
         try:
             self._roll_if_needed()

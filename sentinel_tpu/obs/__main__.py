@@ -1,4 +1,5 @@
-"""CLI: dump / summarize / merge span traces, analyze flight bundles.
+"""CLI: dump / summarize / merge span traces, analyze flight bundles,
+query the verdict provenance plane.
 
 Usage:
 
@@ -8,6 +9,17 @@ Usage:
     python -m sentinel_tpu.obs --merge a.json b.json ... -o merged.json
     python -m sentinel_tpu.obs --postmortem bundle.json
     python -m sentinel_tpu.obs --profile [ms] [-o capture.json]
+    python -m sentinel_tpu.obs explain [--target host:port]
+                                       [--resource NAME] [--top N] [--json]
+
+``explain`` prints the provenance plane (obs/explain.py): coverage, the
+top block-cause leaderboard, and the newest block explanations — each
+one the device-packed record of WHY a decision was blocked (rule slot +
+verdict kind, observed value vs threshold, sketch-tier / eps-confidence
+flags).  With ``--target`` it queries a live process's ``GET
+/api/explain``; with no target it SELF-CAPTURES: drives a small
+``SentinelClient`` past a tight flow limit and explains the resulting
+blocks — the zero-setup demo of the plane.
 
 With a ``trace.json`` argument (a Chrome-trace file from ``GET
 /api/traces`` or ``SpanTracer.dump``) the CLI reads it; with no input it
@@ -385,7 +397,141 @@ def _print_summary(spans: List[dict], out=None) -> None:
         print(f"(tick stages absent from this trace: {', '.join(missing)})", file=out)
 
 
+def _explain_self_capture() -> dict:
+    """Drive a small client past a tight flow limit and return its
+    provenance-plane payload — the zero-setup ``explain`` demo (CPU,
+    semantics only; same philosophy as ``_self_capture``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from sentinel_tpu.core.config import small_engine_config
+    from sentinel_tpu.core.rules import FlowRule
+    from sentinel_tpu.runtime.client import SentinelClient
+
+    c = SentinelClient(cfg=small_engine_config(), mode="sync")
+    c.start()
+    try:
+        names = ["cli/checkout", "cli/search"]
+        c.flow_rules.load([FlowRule(resource=n, count=2.0) for n in names])
+        for _ in range(4):  # one window: 2 pass per resource, rest block
+            c.check_batch(names * 2)
+        payload = {
+            "coverage": c.explain_coverage(),
+            "top_causes": c.explain_top_causes(10),
+            "recent": [r.to_dict() for r in c.explain_plane.recent(64)],
+        }
+    finally:
+        c.stop()
+    return payload
+
+
+def _print_explain(payload: dict, resource: Optional[str], top: int, out=None) -> None:
+    out = out or sys.stdout
+    cov = payload.get("coverage") or {}
+    print(
+        f"explain coverage: blocked={cov.get('blocked', 0)} "
+        f"explained={cov.get('explained', 0)} "
+        f"({100.0 * float(cov.get('frac', 1.0)):.1f}%)",
+        file=out,
+    )
+    causes = payload.get("top_causes") or []
+    if causes:
+        print(f"top block causes ({min(top, len(causes))}):", file=out)
+        print(
+            f"  {'count':>7}  {'kind':<9} {'rule':>5}  {'origin':<8} resource",
+            file=out,
+        )
+        for c in causes[:top]:
+            res = c.get("name") or str(c.get("resource", "?"))
+            rule = c.get("rule")
+            print(
+                f"  {c.get('count', 0):>7}  {c.get('kind', '?'):<9} "
+                f"{'-' if rule is None else rule:>5}  "
+                f"{c.get('origin', ''):<8} {res}",
+                file=out,
+            )
+    recs = payload.get("recent") or []
+    if resource:
+        recs = [
+            r for r in recs
+            if r.get("name") == resource or str(r.get("resource")) == resource
+        ]
+    print(f"recent explanations ({len(recs)}, newest first):", file=out)
+    for r in recs:
+        res = r.get("name") or str(r.get("resource", "?"))
+        obs_v, thr, margin = r.get("observed"), r.get("threshold"), r.get("margin")
+        fmt = lambda v: "?" if v is None else f"{v:g}"  # noqa: E731
+        flags = "".join(
+            tag
+            for cond, tag in (
+                (r.get("sketch_tier"), "~sketch"),
+                (r.get("forced"), " forced"),
+                (r.get("possibly_false"), " possibly-false"),
+            )
+            if cond
+        )
+        eps = r.get("eps")
+        if eps is not None:
+            flags += f" eps={eps:g}"
+        rule = r.get("rule")
+        print(
+            f"  {r.get('ts_ms', 0):>13}ms  {res:<24} {r.get('kind', '?'):<9} "
+            f"rule={'-' if rule is None else rule:<4} "
+            f"observed={fmt(obs_v)} threshold={fmt(thr)} "
+            f"margin={fmt(margin)}  [{r.get('origin', '')}]{flags}",
+            file=out,
+        )
+
+
+def _explain_cli(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sentinel_tpu.obs explain",
+        description="query the verdict provenance plane: why were "
+        "decisions blocked?",
+    )
+    ap.add_argument(
+        "--target",
+        metavar="HOST:PORT",
+        help="live process to query (GET /api/explain); omitted => "
+        "self-capture demo",
+    )
+    ap.add_argument("--resource", help="restrict records to one resource")
+    ap.add_argument(
+        "--top", type=int, default=10, help="cause-leaderboard rows (default 10)"
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json", help="raw JSON payload"
+    )
+    args = ap.parse_args(argv)
+    if args.target:
+        from sentinel_tpu.obs.fleet import _http_fetch
+
+        base = (
+            args.target
+            if args.target.startswith(("http://", "https://"))
+            else f"http://{args.target}"
+        )
+        url = base.rstrip("/") + "/api/explain"
+        if args.resource:
+            import urllib.parse as _up
+
+            url += f"?resource={_up.quote(args.resource)}"
+        payload = json.loads(_http_fetch(url))
+    else:
+        payload = _explain_self_capture()
+    if args.as_json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        _print_explain(payload, args.resource, max(1, args.top))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "explain":
+        return _explain_cli(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m sentinel_tpu.obs",
         description="dump / summarize a sentinel-tpu span trace",

@@ -492,8 +492,176 @@ def _device_hot_candidates(cfg: EngineConfig, state: EngineState, acq, valid, no
     return jnp.stack([acq.res[i].astype(jnp.float32), v], axis=1)
 
 
+def explain_k(cfg: EngineConfig) -> int:
+    """Effective explain-record row count (0 = wire explain block off).
+    Provenance rides ONLY the fused packed wire — the classic multi-array
+    TickOutput is unchanged for direct tick callers."""
+    if not cfg.packed_wire or cfg.explain_k <= 0:
+        return 0
+    return int(cfg.explain_k)
+
+
+# fixed-point encoding for observed/threshold words — canonical
+# constants live with the host decoder (obs/explain.py, jax-free) and
+# are shared with the cluster _T_PROV block
+from sentinel_tpu.obs.explain import (  # noqa: E402
+    FX as EXPLAIN_FX,
+    FX_MAX as _EXPLAIN_FX_MAX,
+    FX_UNKNOWN as EXPLAIN_UNKNOWN,
+)
+
+
+def _explain_fx(x, known):
+    """float -> x256 fixed-point uint32; EXPLAIN_UNKNOWN where not known."""
+    v = jnp.clip(x.astype(jnp.float32) * EXPLAIN_FX, 0.0, _EXPLAIN_FX_MAX)
+    return jnp.where(known, v.astype(jnp.uint32), jnp.uint32(EXPLAIN_UNKNOWN))
+
+
+def _device_explain(
+    cfg: EngineConfig,
+    state: EngineState,
+    rules: RuleSet,
+    acq,
+    verdict,
+    valid,
+    forced,
+    fslots,
+    now_ms,
+):
+    """Provenance records for up to explain_k BLOCKED rows of this tick.
+
+    Per record (4 uint32 words — obs/explain.py owns the host decode):
+      w0  resource id (node_rows + sketch_capacity < 2**24, id-exact)
+      w1  verdict kind (bits 0..2) | sketch-tier flag (bit 3) | forced
+          flag (bit 4) | blamed rule slot + 1 in bits 16..31 (0 = n/a)
+      w2  observed value, x256 fixed point (EXPLAIN_UNKNOWN = n/a)
+      w3  threshold, same encoding
+    All attribution reads are K-row gathers against state the tick
+    already holds, so the marginal cost is O(K), not O(B).  The blamed
+    slot is the resource's FIRST rule lane — exact whenever
+    *_rules_per_resource == 1 (the common shape), first-of-several
+    otherwise; observed/threshold always come from that blamed slot.
+    Runs at the tick tail (after effects), matching the hot-candidate
+    convention: observed values include this tick."""
+    b = acq.res.shape[0]
+    K = min(explain_k(cfg), b)
+    is_blocked = valid & (verdict >= BLOCK_FLOW) & (verdict <= BLOCK_AUTHORITY)
+    n_blocked = jnp.sum(is_blocked).astype(jnp.uint32)
+    # first-K blocked rows in batch order; score 0 rows are padding
+    score = jnp.where(is_blocked, b - jnp.arange(b, dtype=jnp.int32), 0)
+    score_v, rows = jax.lax.top_k(score, K)
+    live = score_v > 0
+    res = acq.res[rows]
+    kind = jnp.where(live, verdict[rows].astype(jnp.uint32), 0)
+    is_tail = res >= cfg.node_rows
+    frc = forced[rows]
+
+    flow = kind == BLOCK_FLOW
+    degr = kind == BLOCK_DEGRADE
+    parm = kind == BLOCK_PARAM
+    syst = kind == BLOCK_SYSTEM
+    auth = kind == BLOCK_AUTHORITY
+    attributable = ~frc  # forced rows carry a host pre_verdict, no rule
+
+    slot = jnp.full((K,), -1, jnp.int32)
+    obs = jnp.zeros((K,), jnp.float32)
+    obs_known = jnp.zeros((K,), bool)
+    thr = jnp.zeros((K,), jnp.float32)
+    thr_known = jnp.zeros((K,), bool)
+
+    # FLOW exact tier: blamed slot from the check's slot lanes; observed
+    # is the node's windowed pass run (O(1) running-sum gather)
+    if fslots is not None:
+        Kf = cfg.flow_rules_per_resource
+        slot_f = fslots.reshape(b, Kf)[rows, 0]
+        f_ok = flow & ~is_tail & attributable & (slot_f < cfg.max_flow_rules)
+        slot = jnp.where(f_ok, slot_f, slot)
+        thr_f = jnp.asarray(rules.flow.count)[jnp.minimum(slot_f, cfg.max_flow_rules)]
+        thr = jnp.where(f_ok, thr_f, thr)
+        thr_known = thr_known | f_ok
+        obs_f = W.gather_window_event_run(
+            state.win_sec, jnp.minimum(res, cfg.node_rows - 1), W.EV_PASS
+        ).astype(jnp.float32)
+        obs = jnp.where(f_ok, obs_f, obs)
+        obs_known = obs_known | f_ok
+
+    # FLOW sketch tier: threshold from the depth-hashed cells, observed
+    # from the windowed pass CMS estimate (both K-row reads)
+    if cfg.sketch_stats:
+        t_cols = P.cms_cell(res, cfg.sketch_depth, cfg.sketch_width)
+        t_cells = T.depth_gather_1col(
+            cfg, jnp.asarray(rules.tail.thr), t_cols, cfg.sketch_width
+        )
+        thr_t = jnp.max(
+            jnp.where(is_tail[None, :], t_cells, RT.TAIL_UNRULED), axis=0
+        )
+        t_ok = flow & is_tail & attributable
+        thr = jnp.where(t_ok, thr_t, thr)
+        thr_known = thr_known | (t_ok & (thr_t < RT.TAIL_UNRULED / 2))
+        obs_t = _sketch(cfg).estimate_plane_mxu(
+            cfg, state.gs, now_ms, res, W.EV_PASS, sketch_config(cfg)
+        )
+        obs = jnp.where(t_ok, obs_t, obs)
+        obs_known = obs_known | t_ok
+
+    # DEGRADE: blamed breaker slot; observed is its circuit state
+    # (0 closed / 1 open / 2 half-open), threshold the rule's count
+    res_d = jnp.minimum(res, cfg.max_resources)
+    slot_d = jnp.asarray(rules.degrade.res_cbs)[res_d, 0]
+    slot_dc = jnp.minimum(slot_d, cfg.max_degrade_rules)
+    d_ok = degr & attributable & (slot_d < cfg.max_degrade_rules)
+    slot = jnp.where(d_ok, slot_d, slot)
+    thr = jnp.where(d_ok, jnp.asarray(rules.degrade.count)[slot_dc], thr)
+    thr_known = thr_known | d_ok
+    obs = jnp.where(d_ok, state.cb_state[slot_dc].astype(jnp.float32), obs)
+    obs_known = obs_known | d_ok
+
+    # PARAM: blamed rule slot + window budget; the offending hashed value
+    # is not recoverable from the CMS, so observed stays unknown
+    rp = jnp.asarray(rules.param.res_params)
+    slot_p = rp[jnp.minimum(res, rp.shape[0] - 1), 0]
+    slot_pc = jnp.minimum(slot_p, cfg.max_param_rules)
+    p_ok = parm & attributable & (slot_p < cfg.max_param_rules)
+    slot = jnp.where(p_ok, slot_p, slot)
+    thr = jnp.where(p_ok, jnp.asarray(rules.param.threshold)[slot_pc], thr)
+    thr_known = thr_known | p_ok
+
+    # SYSTEM: global gate — report the entry node's windowed pass run
+    # against the qps ceiling (the most common trip; load/cpu/rt trips
+    # still carry the kind, with threshold unknown when qps is unset)
+    s_ok = syst & attributable
+    qps = jnp.asarray(rules.system.qps).astype(jnp.float32)
+    thr = jnp.where(s_ok, qps, thr)
+    thr_known = thr_known | (s_ok & (qps >= 0))
+    entry = jnp.full((K,), cfg.entry_node_row, jnp.int32)
+    obs_s = W.gather_window_event_run(state.win_sec, entry, W.EV_PASS)
+    obs = jnp.where(s_ok, obs_s.astype(jnp.float32), obs)
+    obs_known = obs_known | s_ok
+
+    # AUTHORITY: observed is the rule mode (1 white / 2 black)
+    a_ok = auth & attributable
+    mode = jnp.asarray(rules.auth.mode)
+    obs_a = mode[jnp.minimum(res, mode.shape[0] - 1)].astype(jnp.float32)
+    obs = jnp.where(a_ok, obs_a, obs)
+    obs_known = obs_known | a_ok
+
+    w0 = jnp.where(live, res.astype(jnp.uint32), 0)
+    slot_word = jnp.minimum(slot + 1, 0xFFFF).astype(jnp.uint32)
+    w1 = (
+        kind
+        | (jnp.where(flow & is_tail, 1, 0).astype(jnp.uint32) << 3)
+        | (frc.astype(jnp.uint32) << 4)
+        | (slot_word << 16)
+    )
+    w1 = jnp.where(live, w1, 0)
+    w2 = jnp.where(live, _explain_fx(obs, obs_known & live), 0)
+    w3 = jnp.where(live, _explain_fx(thr, thr_known & live), 0)
+    return n_blocked, jnp.stack([w0, w1, w2, w3], axis=1)
+
+
 def _tick_output(
-    cfg: EngineConfig, verdict, wait_ms, seg_dropped, stats, res_stats, hot
+    cfg: EngineConfig, verdict, wait_ms, seg_dropped, stats, res_stats, hot,
+    expl=None,
 ) -> TickOutput:
     """Assemble the TickOutput — classic multi-array form, or (under
     cfg.packed_wire) everything packed into the single fused wire buffer
@@ -508,7 +676,8 @@ def _tick_output(
             res_stats=None,
             hot=None,
             wire=WIRE.pack_tick_output(
-                cfg, verdict, wait_ms, seg_dropped, stats, res_stats, hot
+                cfg, verdict, wait_ms, seg_dropped, stats, res_stats, hot,
+                expl,
             ),
         )
     return TickOutput(
@@ -2547,8 +2716,13 @@ def tick(
         hot = None
         if hotset_k(cfg) > 0:
             hot = _device_hot_candidates(cfg, state, acq, valid, now_ms)
+        expl = None
+        if explain_k(cfg) > 0:
+            expl = _device_explain(
+                cfg, state, rules, acq, verdict, valid, forced, fslots, now_ms
+            )
         return state, _tick_output(
-            cfg, verdict, wait_ms, seg_dropped, stats, res_stats, hot
+            cfg, verdict, wait_ms, seg_dropped, stats, res_stats, hot, expl
         )
 
     with_nodes = "nodes" in features
@@ -2675,7 +2849,14 @@ def tick(
     hot = None
     if hotset_k(cfg) > 0:
         hot = _device_hot_candidates(cfg, state, acq, valid, now_ms)
-    return state, _tick_output(cfg, verdict, wait_ms, 0, stats, res_stats, hot)
+    expl = None
+    if explain_k(cfg) > 0:
+        expl = _device_explain(
+            cfg, state, rules, acq, verdict, valid, forced, fslots, now_ms
+        )
+    return state, _tick_output(
+        cfg, verdict, wait_ms, 0, stats, res_stats, hot, expl
+    )
 
 
 def replace_system_columns(ruleset: RuleSet, system: RT.SystemTensors) -> RuleSet:

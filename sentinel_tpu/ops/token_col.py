@@ -73,8 +73,13 @@ def decide_batch(
     partial: jax.Array,  # bool [B] — partial-grant vs all-or-nothing
     forced: jax.Array,  # bool [B] — unconditional charge (occupy-ahead)
     cfg: W.WindowConfig = DEFAULT_CFG,
-) -> Tuple[jax.Array, TokenColState]:
-    """granted int32 [B] plus the updated ledger state."""
+) -> Tuple[jax.Array, jax.Array, TokenColState]:
+    """(granted int32 [B], observed float32 [B], updated ledger state).
+
+    ``observed`` is the window usage each entry was decided against
+    (used + same-batch prefix) — the deny-provenance value the protocol
+    v3 _T_PROV block ships back to clients, so a remote block can report
+    "observed N of limit M" like a local one (obs/explain.py)."""
     # rotate once up front so the O(1) running sums are exact at this
     # now_ms, then the ledger read is a single [B] gather instead of the
     # old masked [B, nb] reduction per batch
@@ -87,11 +92,8 @@ def decide_batch(
     # exclusive prefix of requested units, rebased per slot run
     ex = jnp.cumsum(units) - units
     prefix = ex - ex[heads]
-    avail = (
-        state.limits[slots]
-        - used.astype(jnp.float32)
-        - prefix.astype(jnp.float32)
-    )
+    observed = used.astype(jnp.float32) + prefix.astype(jnp.float32)
+    avail = state.limits[slots] - observed
     units_f = units.astype(jnp.float32)
     grant_partial = jnp.clip(jnp.floor(avail), 0.0, units_f)
     grant_strict = jnp.where(avail >= units_f, units_f, 0.0)
@@ -101,7 +103,7 @@ def decide_batch(
     deltas = deltas.at[:, W.EV_PASS].set(granted)
     deltas = deltas.at[:, W.EV_BLOCK].set(units - granted)
     win = W.add_batch(win, now_ms, slots, deltas, cfg=cfg)
-    return granted, TokenColState(win=win, limits=state.limits)
+    return granted, observed, TokenColState(win=win, limits=state.limits)
 
 
 def ms_to_next_bucket(now_ms: int, cfg: W.WindowConfig = DEFAULT_CFG) -> int:

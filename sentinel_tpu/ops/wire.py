@@ -23,6 +23,16 @@ packed on-device so only packed bytes ever cross the transport::
     [stats]           N_STATS words — float32 telemetry row, bitcast
     [timeline]        timeline_k * TL_COLS words — float32, bitcast
     [hot]             hotset_k * 2 words — float32, bitcast
+    [explain]         2 + explain_k * EXPLAIN_WORDS words — verdict
+                      provenance records for up to explain_k BLOCKED
+                      rows (obs/explain.py owns the record encoding):
+                      ``[n_blocked, sec_sum, records...]`` with its OWN
+                      additive checksum ``sec_sum`` seeded with
+                      EXPLAIN_MAGIC.  The section sits OUTSIDE the main
+                      checksum: a corrupt explain section drops the
+                      tick's explanations only (fail-OPEN for the
+                      provenance), while main-section corruption still
+                      fails every verdict CLOSED.
 
 Optional blocks appear iff the config emits them, so the layout is a
 pure function of (EngineConfig, batch shape) — the host unpacks by a
@@ -30,7 +40,9 @@ static offset table, no per-tick negotiation.  The additive checksum
 detects any single-flipped-byte corruption (the chaos ``corrupt``
 action's exact fault model) plus truncation/drop via the length check;
 ``unpack`` raises :class:`WireDecodeError` and the client fails the tick
-CLOSED (runtime/client._resolve_tick).
+CLOSED (runtime/client._resolve_tick).  ``unpack`` validates the main
+section ONLY and hands the explain words back raw — decode + sec_sum
+validation live in obs/explain.py behind their own chaos failpoint.
 
 Upload — batch columns whose value range is statically bounded travel
 narrow and widen on-device at tick entry (``widen_acquire`` /
@@ -65,6 +77,11 @@ HDR_WORDS = 4
 #: (flow rules with RATE_LIMITER behavior); 64 rows = 512 B covers the
 #: normal tick, and an overflow tick reads the full wait column instead
 EXC_K = 64
+#: seed of the explain section's own checksum — distinct from the main
+#: checksum so a flip in either section is attributed to that section
+EXPLAIN_MAGIC = 0x0B_5E_CF_A1
+#: uint32 words per explain record (obs/explain.py packs/unpacks them)
+EXPLAIN_WORDS = 4
 
 
 class WireDecodeError(Exception):
@@ -81,12 +98,14 @@ class WireLayout(NamedTuple):
     tl_rows: int  # timeline rows (0 = block absent)
     tl_cols: int
     hot_rows: int  # hot-candidate rows (0 = block absent)
+    expl_k: int  # explain record rows (0 = block absent)
     off_bitmap: int
     n_bitmap: int
     off_exc: int
     off_stats: int
     off_tl: int
     off_hot: int
+    off_expl: int  # == total when the explain block is absent
     total: int  # whole-buffer length in words
 
 
@@ -99,6 +118,7 @@ def layout_for(cfg: EngineConfig, b: int) -> WireLayout:
     tl_rows = E.timeline_k(cfg) if cfg.device_telemetry else 0
     # hot candidates clamp to the batch shape (engine._device_hot_candidates)
     hot_rows = min(E.hotset_k(cfg), b)
+    expl_k = min(E.explain_k(cfg), b)
     exc_k = min(EXC_K, b)
     n_bitmap = -(-b // VERDICTS_PER_WORD)
     off_bitmap = HDR_WORDS
@@ -106,7 +126,8 @@ def layout_for(cfg: EngineConfig, b: int) -> WireLayout:
     off_stats = off_exc + 2 * exc_k
     off_tl = off_stats + n_stats
     off_hot = off_tl + tl_rows * E.TL_COLS
-    total = off_hot + hot_rows * 2
+    off_expl = off_hot + hot_rows * 2
+    total = off_expl + (2 + expl_k * EXPLAIN_WORDS if expl_k else 0)
     return WireLayout(
         b=b,
         exc_k=exc_k,
@@ -114,12 +135,14 @@ def layout_for(cfg: EngineConfig, b: int) -> WireLayout:
         tl_rows=tl_rows,
         tl_cols=E.TL_COLS,
         hot_rows=hot_rows,
+        expl_k=expl_k,
         off_bitmap=off_bitmap,
         n_bitmap=n_bitmap,
         off_exc=off_exc,
         off_stats=off_stats,
         off_tl=off_tl,
         off_hot=off_hot,
+        off_expl=off_expl,
         total=total,
     )
 
@@ -135,6 +158,7 @@ def pack_tick_output(
     stats,  # float32 [N_STATS] or None
     res_stats,  # float32 [K, TL_COLS] or None
     hot,  # float32 [K, 2] or None
+    expl=None,  # (n_blocked uint32 scalar, records uint32 [K, 4]) or None
 ):
     """Pack one tick's outputs into the flat uint32 wire buffer.
 
@@ -168,13 +192,28 @@ def pack_tick_output(
     payload = jnp.concatenate(parts)
     magic = jnp.uint32(WIRE_MAGIC)
     dropped = jnp.asarray(seg_dropped).astype(jnp.uint32).reshape(())
+    # the MAIN checksum stops at off_expl: the explain section carries
+    # its own sec_sum so its corruption fails OPEN (provenance dropped)
+    # without poisoning the verdict path's fail-CLOSED check
     cksum = (
         magic
         + n_wait
         + dropped
         + jnp.sum(payload, dtype=jnp.uint32)
     )
-    return jnp.concatenate([jnp.stack([magic, n_wait, dropped, cksum]), payload])
+    out = [jnp.stack([magic, n_wait, dropped, cksum]), payload]
+    if lo.expl_k:
+        n_blocked, records = expl
+        n_blocked = jnp.asarray(n_blocked).astype(jnp.uint32).reshape(())
+        flat = records.astype(jnp.uint32).reshape(-1)
+        sec_sum = (
+            jnp.uint32(EXPLAIN_MAGIC)
+            + n_blocked
+            + jnp.sum(flat, dtype=jnp.uint32)
+        )
+        out.append(jnp.stack([n_blocked, sec_sum]))
+        out.append(flat)
+    return jnp.concatenate(out)
 
 
 # -- host side (resolver thread) --------------------------------------------
@@ -190,6 +229,7 @@ class WireFrame(NamedTuple):
     stats: Optional[np.ndarray]  # float32 [N_STATS]
     res_stats: Optional[np.ndarray]  # float32 [K, TL_COLS]
     hot: Optional[np.ndarray]  # float32 [K, 2]
+    expl: Optional[np.ndarray]  # RAW uint32 explain words (unvalidated)
 
 
 def unpack(data: bytes, lo: WireLayout) -> WireFrame:
@@ -206,9 +246,11 @@ def unpack(data: bytes, lo: WireLayout) -> WireFrame:
     buf = np.frombuffer(data, dtype=np.uint32)
     if int(buf[0]) != WIRE_MAGIC:
         raise WireDecodeError(f"bad wire magic {int(buf[0]):#x}")
+    # main checksum stops at off_expl — the explain section fails open
+    # on its own sec_sum (obs/explain.decode_records), never the tick
     expect = (
         int(buf[0]) + int(buf[1]) + int(buf[2])
-        + int(np.sum(buf[HDR_WORDS:], dtype=np.uint64))
+        + int(np.sum(buf[HDR_WORDS : lo.off_expl], dtype=np.uint64))
     ) & 0xFFFFFFFF
     if int(buf[3]) != expect:
         raise WireDecodeError(
@@ -245,7 +287,11 @@ def unpack(data: bytes, lo: WireLayout) -> WireFrame:
             .reshape(lo.tl_rows, lo.tl_cols)
         )
     if lo.hot_rows:
-        hot = buf[lo.off_hot : lo.total].view(np.float32).reshape(lo.hot_rows, 2)
+        hot = (
+            buf[lo.off_hot : lo.off_expl].view(np.float32)
+            .reshape(lo.hot_rows, 2)
+        )
+    expl = buf[lo.off_expl : lo.total].copy() if lo.expl_k else None
     return WireFrame(
         verdict=verdict,
         wait=wait,
@@ -254,6 +300,7 @@ def unpack(data: bytes, lo: WireLayout) -> WireFrame:
         stats=stats,
         res_stats=res_stats,
         hot=hot,
+        expl=expl,
     )
 
 

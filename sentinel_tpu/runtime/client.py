@@ -817,6 +817,28 @@ class SentinelClient:
 
             self.block_log = default_block_logger()
 
+        # verdict provenance plane (obs/explain.py): decodes the fused
+        # readback's explain section into per-resource "why blocked"
+        # rings.  Rides only the packed wire (E.explain_k gates on
+        # cfg.packed_wire); eps annotation comes from the sketch audit
+        # when armed, names from the registry.  The plane carries no
+        # client reference — both inputs are injected callables.
+        self.explain_plane = None
+        self._explain_provider = None
+        if E.explain_k(self.cfg) > 0:
+            from sentinel_tpu.obs.explain import ExplainPlane
+
+            def _audit_eps() -> Optional[float]:
+                au = self._audit
+                if au is None:
+                    return None
+                return au._last_audit.get("eps_budget")
+
+            self.explain_plane = ExplainPlane(
+                eps_source=_audit_eps,
+                name_source=self.registry.resource_name,
+            )
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
@@ -890,6 +912,9 @@ class SentinelClient:
         if self._audit is not None:
             self._audit_provider = self._audit.flight_section
             FL.FLIGHT.register_provider("audit", self._audit_provider)
+        if self.explain_plane is not None:
+            self._explain_provider = self.explain_plane.flight_section
+            FL.FLIGHT.register_provider("explain", self._explain_provider)
 
     def _flight_state(self) -> dict:
         """Flight-bundle section: what a post-mortem needs to know about
@@ -946,6 +971,10 @@ class SentinelClient:
         if ap is not None:
             FL.FLIGHT.unregister_provider("audit", ap)
             self._audit_provider = None
+        ep = getattr(self, "_explain_provider", None)
+        if ep is not None:
+            FL.FLIGHT.unregister_provider("explain", ep)
+            self._explain_provider = None
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
@@ -1541,6 +1570,7 @@ class SentinelClient:
             if r.status == CC.STATUS_BLOCKED:
                 if degraded:
                     self._exit_cluster_degraded()
+                self._fold_remote_deny(resource, r, ERR.BLOCK_FLOW)
                 return ERR.BLOCK_FLOW, 0
             if r.status == CC.STATUS_SHOULD_WAIT:
                 wait_total += r.wait_ms
@@ -1560,11 +1590,39 @@ class SentinelClient:
             if r.status == CC.STATUS_BLOCKED:
                 if degraded:
                     self._exit_cluster_degraded()
+                self._fold_remote_deny(resource, r, ERR.BLOCK_PARAM)
                 return ERR.BLOCK_PARAM, 0
 
         if degraded and responded:
             self._exit_cluster_degraded()  # probe succeeded: back to remote
         return 0, wait_total
+
+    def _fold_remote_deny(self, resource: str, r, default_kind: int, n: int = 1) -> None:
+        """Land a cluster deny's provenance in the explain plane.  A v3
+        peer's TokenResult carries (kind, rule, observed, limit); an
+        embedded service fills the same fields; a pre-v3 peer leaves them
+        None and the deny is counted unexplained — coverage stays honest."""
+        plane = self.explain_plane
+        if plane is None:
+            return
+        rid = self.registry.peek_resource_id(resource)
+        if rid is None or n <= 0:
+            return
+        if r.prov_kind is None:
+            plane.count_unexplained(n)
+            return
+        from sentinel_tpu.obs.explain import KIND_NAMES
+
+        kind = int(r.prov_kind) if int(r.prov_kind) in KIND_NAMES else default_kind
+        for _ in range(n):
+            plane.fold_remote(
+                rid,
+                kind,
+                r.prov_rule,
+                r.prov_observed,
+                r.prov_limit,
+                ts_ms=int(self.time.wall_ms()),
+            )
 
     def _cluster_check_bulk(
         self, resource: str, item_counts: List[int], param_value
@@ -1606,12 +1664,18 @@ class SentinelClient:
             if r.status in (CC.STATUS_OK, CC.STATUS_SHOULD_WAIT, CC.STATUS_BLOCKED):
                 granted = r.remaining if r.status != CC.STATUS_BLOCKED else 0
                 acc = 0
+                blocked_items = 0
                 for i, c in enumerate(item_counts):
                     if acc + c <= granted:
                         acc += c
                         waits[i] = r.wait_ms
                     else:
                         verdicts[i] = ERR.BLOCK_FLOW
+                        blocked_items += 1
+                if blocked_items:
+                    self._fold_remote_deny(
+                        resource, r, ERR.BLOCK_FLOW, n=blocked_items
+                    )
             # NO_RULE → proceed
 
         if prule is not None and param_value is not None:
@@ -1632,6 +1696,9 @@ class SentinelClient:
                 if r.status == CC.STATUS_BLOCKED:
                     for i in live:
                         verdicts[i] = ERR.BLOCK_PARAM
+                    self._fold_remote_deny(
+                        resource, r, ERR.BLOCK_PARAM, n=len(live)
+                    )
 
         if degraded and responded:
             self._exit_cluster_degraded()
@@ -1807,8 +1874,18 @@ class SentinelClient:
                 else ERR.exception_for_verdict(verdict, resource)
             )
             if self.block_log is not None:
+                kind_name = rule_slot = None
+                if self.explain_plane is not None:
+                    from sentinel_tpu.obs.explain import KIND_NAMES
+
+                    kind_name = KIND_NAMES.get(int(verdict))
+                    # the resolver folded this tick's explain records
+                    # BEFORE resolving our future, so the newest matching
+                    # record is this block's provenance
+                    rule_slot = self.explain_plane.latest_rule(rid, int(verdict))
                 self.block_log.log(
-                    self.time.wall_ms(), resource, type(exc).__name__, origin or "", count
+                    self.time.wall_ms(), resource, type(exc).__name__,
+                    origin or "", count, kind=kind_name, rule=rule_slot,
                 )
             MEXT.safe_dispatch("on_block", resource, count, origin or "", exc, args)
             if entered_slots:
@@ -1899,6 +1976,34 @@ class SentinelClient:
         with self._hot_params_lock:
             counter = dict(self._hot_params.get(resource, {}))
         return sorted(counter.items(), key=lambda kv: -kv[1])[:n]
+
+    def explain(self, resource: str, limit: int = 0) -> list:
+        """Why was ``resource`` blocked?  Newest-first provenance records
+        (obs/explain.ExplainRecord) from the device-packed explain section
+        plus any cluster deny provenance.  Empty when the plane is off
+        (cfg.packed_wire falsy or cfg.explain_k == 0) or nothing was
+        blocked.  Accepts a resource name or a raw device id."""
+        if self.explain_plane is None:
+            return []
+        if isinstance(resource, int):
+            rid: Optional[int] = resource
+        else:
+            rid = self.registry.peek_resource_id(resource)
+        if rid is None:
+            return []
+        return self.explain_plane.explain(rid, limit=limit)
+
+    def explain_top_causes(self, n: int = 10) -> list:
+        """Most frequent (resource, kind, rule, origin) block causes."""
+        if self.explain_plane is None:
+            return []
+        return self.explain_plane.top_causes(n)
+
+    def explain_coverage(self) -> dict:
+        """Blocked-decision explainability: {blocked, explained, frac}."""
+        if self.explain_plane is None:
+            return {"blocked": 0, "explained": 0, "frac": 1.0}
+        return self.explain_plane.coverage()
 
     def param_lane(self, resource: str, param_idx: int) -> Optional[int]:
         """Hash lane the compile assigned to ``param_idx`` on ``resource``,
@@ -3514,8 +3619,17 @@ class SentinelClient:
                 # timeline rows keep their own wire accounting path
                 TLM._C_WIRE["rx"].inc(tl_bytes)
             # chaos: mangled bytes must be DETECTED and fail the tick
-            # CLOSED — never fan out garbage verdicts
-            data = FP.pipe(_FP_PACKED_DECODE, raw.tobytes())
+            # CLOSED — never fan out garbage verdicts.  The pipe covers
+            # only the fail-CLOSED main section; the trailing explain
+            # section fails OPEN by design and has its own failpoint
+            # (obs.explain.decode), so this site's corrupt action stays
+            # a deterministic BLOCK_SYSTEM for every seed.
+            buf = raw.tobytes()
+            split = lo.off_expl * 4
+            if lo.expl_k and len(buf) > split:
+                data = FP.pipe(_FP_PACKED_DECODE, buf[:split]) + buf[split:]
+            else:
+                data = FP.pipe(_FP_PACKED_DECODE, buf)
             try:
                 frame = WIRE.unpack(data, lo)
             except WIRE.WireDecodeError:
@@ -3559,6 +3673,11 @@ class SentinelClient:
                 )
             if frame.hot is not None and self.hotset is not None:
                 self.hotset.fold(frame.hot)
+            if frame.expl is not None and self.explain_plane is not None:
+                # BEFORE the verdict fan-out below, so an entry() that
+                # raises a BlockException can already look itself up in
+                # the provenance rings (block-log key, explain())
+                self.explain_plane.ingest_section(frame.expl, ts_ms=p.now_ms)
         else:
             if out.stats is not None:
                 stats = np.asarray(out.stats)  # stlint: disable=host-sync — readback point

@@ -294,6 +294,37 @@ class DefaultHandlerGroup:
 
         return CommandResponse.of_success(describe_fleets())
 
+    @command_mapping("api/explain", "verdict provenance: why decisions blocked")
+    def api_explain(self, req: CommandRequest) -> CommandResponse:
+        """``GET /api/explain`` — the verdict provenance plane
+        (obs/explain.py): coverage (what fraction of blocked decisions
+        carry an explanation), the top block-cause leaderboard, and the
+        newest device-packed block explanations.  ``?resource=NAME``
+        restricts the record list to one resource's provenance ring;
+        ``?top=N`` sizes the leaderboard.  Also the backing surface for
+        ``python -m sentinel_tpu.obs explain --target``."""
+        plane = getattr(self.client, "explain_plane", None)
+        if plane is None:
+            return CommandResponse.of_success(
+                {"enabled": False, "coverage": {"blocked": 0, "explained": 0,
+                                                "frac": 1.0},
+                 "top_causes": [], "recent": []}
+            )
+        top = int(req.param("top") or 10)
+        resource = req.param("resource")
+        if resource:
+            recs = self.client.explain(resource, limit=64)
+        else:
+            recs = plane.recent(64)
+        return CommandResponse.of_success(
+            {
+                "enabled": True,
+                "coverage": plane.coverage(),
+                "top_causes": plane.top_causes(top),
+                "recent": [r.to_dict() for r in recs],
+            }
+        )
+
     @command_mapping("rtQuantiles", "inbound RT quantiles (p50/p90/p99)")
     def rt_quantiles(self, req: CommandRequest) -> CommandResponse:
         qs = [float(x) for x in (req.param("q") or "0.5,0.9,0.99").split(",")]

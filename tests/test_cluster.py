@@ -732,11 +732,16 @@ def test_request_batch_v2_end_to_end(tcp_cluster):
         (C.BATCH_KIND_FLOW, 101, 1),
         (C.BATCH_KIND_FLOW, 31337, 1),
     ])
-    assert tok.peer_version == 2
+    assert tok.peer_version == C.PROTOCOL_VERSION
     assert results[0].status == C.STATUS_OK
     # partial grant: 1 unit already spent above, 1 by entry 0 -> 1 left
     assert results[1].status == C.STATUS_OK and results[1].remaining == 1
     assert results[2].status == C.STATUS_BLOCKED
+    # v3: the deny explains itself (_T_PROV rode the response)
+    assert results[2].prov_kind == ERR.BLOCK_FLOW
+    assert results[2].prov_rule == 101
+    assert results[2].prov_limit == 3.0
+    assert results[2].prov_observed is not None
     assert results[3].status == C.STATUS_NO_RULE
 
 

@@ -294,7 +294,10 @@ def test_client_defaults_to_packed_and_delta_skips_clean_columns(client):
 def test_corrupt_fused_readback_fails_tick_closed(client_factory):
     """chaos transport.packed.decode corrupt: the decoder must DETECT the
     mangled buffer (checksum), count it, and the tick must fail CLOSED —
-    every caller gets BLOCK_SYSTEM, nothing hangs or passes."""
+    every caller gets BLOCK_SYSTEM, nothing hangs or passes.  The site
+    pipes only the fail-CLOSED main section (the trailing explain block
+    fails OPEN via its own obs.explain.decode site — test_explain.py),
+    so this holds with the explain section present."""
     c = client_factory()
     c.flow_rules.load([FlowRule(resource="fc/r", count=100.0)])
     assert [v for v, _ in c.check_batch(["fc/r"] * 4)] == [int(ERR.PASS)] * 4
